@@ -1,0 +1,37 @@
+"""Config system: strict Pydantic schemas + YAML loader."""
+
+from .loader import (
+    ConfigLoadError,
+    load_and_validate_config,
+    load_yaml_config,
+    resolve_config_path,
+)
+from .schemas import (
+    DataConfig,
+    DistributedConfig,
+    LoggingConfig,
+    MeshConfig,
+    MLflowConfig,
+    ModelConfig,
+    OutputConfig,
+    RunConfig,
+    RunSectionConfig,
+    TrainerConfig,
+)
+
+__all__ = [
+    "ConfigLoadError",
+    "DataConfig",
+    "DistributedConfig",
+    "LoggingConfig",
+    "MeshConfig",
+    "MLflowConfig",
+    "ModelConfig",
+    "OutputConfig",
+    "RunConfig",
+    "RunSectionConfig",
+    "TrainerConfig",
+    "load_and_validate_config",
+    "load_yaml_config",
+    "resolve_config_path",
+]

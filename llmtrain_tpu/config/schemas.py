@@ -1,0 +1,231 @@
+"""Strict, frozen Pydantic configuration tree.
+
+Parity target: reference ``src/llmtrain/config/schemas.py`` (8 frozen sections,
+``extra="forbid"``, ``validate_default=True``, cross-field validators, plugin
+``extra`` escape hatches, ``schema_version``). Intentional TPU divergences:
+
+* ``run.device`` is ``cpu|tpu`` (reference restricts to ``cpu|mps``,
+  schemas.py:13 — MPS is meaningless on TPU hardware).
+* The ``ddp:`` section (reference schemas.py:102-120, torch/gloo runtime hints)
+  is replaced by ``distributed:`` — JAX multi-process rendezvous fields plus a
+  named device-mesh spec (data/fsdp/tensor/sequence/pipeline/expert axes).
+  Env-beats-config resolution semantics are preserved (see
+  ``llmtrain_tpu/distributed``).
+* ``model.dtype`` / ``model.param_dtype`` add first-class bfloat16 compute
+  (the reference has no mixed precision at all, SURVEY §2.4).
+"""
+
+from typing import Any, Literal, Self
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+_STRICT = ConfigDict(extra="forbid", frozen=True, validate_default=True)
+
+
+class RunSectionConfig(BaseModel):
+    """Run-level identity, seeding and device selection."""
+
+    name: str
+    seed: int = 1337
+    device: Literal["cpu", "tpu"] = "cpu"
+    deterministic: bool = True
+    notes: str | None = None
+
+    model_config = _STRICT
+
+
+class ModelConfig(BaseModel):
+    """Architecture hyper-parameters handed to the model adapter.
+
+    Field names and constraints mirror reference schemas.py:24-51 so configs
+    translate 1:1; ``dtype``/``param_dtype`` are TPU additions.
+    """
+
+    name: str
+    init: Literal["random"] = "random"
+    block_size: int = Field(256, ge=8)
+    d_model: int = Field(384, ge=8)
+    n_layers: int = Field(6, ge=1)
+    n_heads: int = Field(6, ge=1)
+    d_ff: int = Field(1536, ge=8)
+    dropout: float = Field(0.1, ge=0.0, lt=1.0)
+    tie_embeddings: bool = True
+    vocab_size: int | None = None
+    dtype: Literal["float32", "bfloat16"] = "float32"
+    param_dtype: Literal["float32", "bfloat16"] = "float32"
+    remat: bool = False
+    attention: Literal["dense", "flash", "ring"] = "dense"
+    extra: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_model_dimensions(self) -> Self:
+        if self.d_model % self.n_heads != 0:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.d_ff < self.d_model:
+            raise ValueError("d_ff must be greater than or equal to d_model")
+        return self
+
+
+class DataConfig(BaseModel):
+    """Dataset selection, splits, and HuggingFace overrides.
+
+    Mirrors reference schemas.py:54-71 (``num_workers`` kept for config
+    compatibility; the JAX input pipeline is synchronous prefetch, not torch
+    worker processes).
+    """
+
+    name: str
+    cache_dir: str = ".cache/datasets"
+    num_workers: int = Field(2, ge=0)
+    train_split: str = "train"
+    val_split: str = "validation"
+    dataset_name: str | None = None
+    dataset_config: str | None = None
+    text_column: str | None = None
+    extra: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = _STRICT
+
+
+class TrainerConfig(BaseModel):
+    """Training-loop pacing, optimizer and logging cadence.
+
+    Mirrors reference schemas.py:74-99 incl. the warmup<=max_steps validator.
+    """
+
+    max_steps: int = Field(1000, ge=1)
+    micro_batch_size: int = Field(8, ge=1)
+    grad_accum_steps: int = Field(4, ge=1)
+    lr: float = Field(3e-4, gt=0.0)
+    weight_decay: float = Field(0.1, ge=0.0)
+    warmup_steps: int = Field(100, ge=0)
+    max_grad_norm: float = Field(1.0, gt=0.0)
+    log_every_steps: int = Field(10, ge=1)
+    eval_every_steps: int = Field(100, ge=1)
+    save_every_steps: int = Field(500, ge=1)
+    extra: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_steps(self) -> Self:
+        if self.warmup_steps > self.max_steps:
+            raise ValueError("warmup_steps cannot exceed max_steps")
+        return self
+
+
+class MeshConfig(BaseModel):
+    """Named device-mesh axis sizes.
+
+    ``-1`` on exactly one axis means "fill with all remaining devices" (like a
+    reshape wildcard). Axis order is the physical iteration order — ``data``
+    outermost so data-parallel replicas land on distinct hosts and
+    tensor/sequence shards ride ICI.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    pipeline: int = 1
+    expert: int = 1
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_axes(self) -> Self:
+        sizes = self.axis_sizes()
+        wildcards = sum(1 for v in sizes.values() if v == -1)
+        if wildcards > 1:
+            raise ValueError("at most one mesh axis may be -1 (wildcard)")
+        for axis, v in sizes.items():
+            if v == 0 or v < -1:
+                raise ValueError(f"mesh axis {axis!r} must be a positive int or -1")
+        return self
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "sequence": self.sequence,
+            "pipeline": self.pipeline,
+            "expert": self.expert,
+        }
+
+
+class DistributedConfig(BaseModel):
+    """JAX multi-process runtime hints and the device mesh.
+
+    Replaces the reference's ``DDPConfig`` (schemas.py:102-120). The
+    rendezvous fields map torch's env contract onto
+    ``jax.distributed.initialize``: RANK→process_id, WORLD_SIZE→num_processes,
+    MASTER_ADDR/PORT→coordinator. Env vars beat config values, matching
+    reference distributed/__init__.py:100-118.
+    """
+
+    enabled: bool = False
+    backend: Literal["jax"] = "jax"
+    timeout_sec: int = Field(1800, ge=1)
+    num_processes: int | None = None
+    process_id: int | None = None
+    coordinator_addr: str | None = None
+    coordinator_port: int | None = None
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+
+    model_config = _STRICT
+
+
+class MLflowConfig(BaseModel):
+    """MLflow tracking options (reference schemas.py:123-136, unchanged)."""
+
+    enabled: bool = True
+    tracking_uri: str = "file:./mlruns"
+    experiment: str = "llm-train-k8s"
+    run_name: str | None = None
+    log_models: bool = False
+
+    model_config = _STRICT
+
+
+class LoggingConfig(BaseModel):
+    """Structured-logging settings (reference schemas.py:139-151, unchanged)."""
+
+    level: Literal["DEBUG", "INFO", "WARNING", "ERROR"] = "INFO"
+    json_output: bool = True
+    log_to_file: bool = True
+    file_name: str = "train.log"
+
+    model_config = _STRICT
+
+
+class OutputConfig(BaseModel):
+    """Run-dir paths and persistence toggles (reference schemas.py:154-166)."""
+
+    root_dir: str = "runs"
+    run_id: str | None = None
+    save_config_copy: bool = True
+    save_meta_json: bool = True
+
+    model_config = _STRICT
+
+
+class RunConfig(BaseModel):
+    """Top-level schema tying every section into one executable run.
+
+    Mirrors reference schemas.py:169-186 with ``ddp`` → ``distributed``.
+    """
+
+    schema_version: int = Field(1, ge=1)
+    run: RunSectionConfig
+    model: ModelConfig
+    data: DataConfig
+    trainer: TrainerConfig
+    distributed: DistributedConfig = Field(default_factory=DistributedConfig)
+    mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
+    logging: LoggingConfig = Field(default_factory=LoggingConfig)
+    output: OutputConfig = Field(default_factory=OutputConfig)
+
+    model_config = _STRICT

@@ -1,0 +1,227 @@
+"""Multi-process runtime + named device mesh.
+
+Parity target: reference ``src/llmtrain/distributed/__init__.py`` (DDPState,
+setup_ddp, teardown_ddp) re-imagined for JAX:
+
+* ``DistState`` mirrors ``DDPState`` (frozen, ``is_main == (rank == 0)``
+  invariant enforced in ``__post_init__``, reference :28-31).
+* ``setup_distributed`` mirrors ``setup_ddp``'s contract — idempotent with a
+  warning (reference :75-93), env-var-first resolution with config fallback
+  (reference :100-118) — but rendezvous is ``jax.distributed.initialize``
+  (coordinator over DCN) instead of a gloo process group. The same env names
+  (RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT) are honoured so the K8s
+  IndexedJob bootstrap carries over unchanged; JAX-native names
+  (JAX_PROCESS_ID/JAX_NUM_PROCESSES/JAX_COORDINATOR_ADDRESS) win over them.
+* There is no DDP wrapper to build: gradient sync is a sharding property of
+  the jit-compiled train step (see ``llmtrain_tpu/parallel``), with XLA
+  emitting psum/reduce-scatter over ICI.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+from ..config.schemas import DistributedConfig, MeshConfig
+from ..utils.logging import get_logger
+
+_DEFAULT_COORDINATOR_PORT = 29500
+
+# Module-level idempotency latch (the analogue of torch's
+# dist.is_initialized() check, reference distributed/__init__.py:75).
+_ACTIVE_STATE: "DistState | None" = None
+_JAX_DIST_INITIALIZED = False
+
+
+@dataclass(frozen=True)
+class DistState:
+    """Resolved multi-process topology for this process.
+
+    ``process_index``/``num_processes`` are the JAX names for the reference's
+    rank/world_size; ``is_main`` gates all filesystem and tracker I/O.
+    """
+
+    process_index: int
+    num_processes: int
+    local_device_count: int
+    is_main: bool
+    coordinator: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not (0 <= self.process_index < self.num_processes):
+            raise ValueError("process_index must be in [0, num_processes)")
+        if self.is_main != (self.process_index == 0):
+            raise ValueError("is_main must equal (process_index == 0)")
+
+    # Reference-compatible aliases (DDPState.rank / .world_size).
+    @property
+    def rank(self) -> int:
+        return self.process_index
+
+    @property
+    def world_size(self) -> int:
+        return self.num_processes
+
+
+def _env_int(*names: str) -> int | None:
+    for name in names:
+        raw = os.environ.get(name)
+        if raw is not None and raw != "":
+            try:
+                return int(raw)
+            except ValueError as exc:
+                raise ValueError(f"Environment variable {name}={raw!r} is not an integer") from exc
+    return None
+
+
+def _resolve_int(env_names: tuple[str, ...], config_value: int | None, default: int) -> int:
+    env_val = _env_int(*env_names)
+    if env_val is not None:
+        return env_val
+    if config_value is not None:
+        return config_value
+    return default
+
+
+def resolve_topology(cfg: DistributedConfig) -> tuple[int, int, str | None]:
+    """Resolve (process_id, num_processes, coordinator) env-first.
+
+    JAX-native env names beat torch-compat names beat config values beat
+    defaults — mirroring reference distributed/__init__.py:100-118.
+    """
+    num_processes = _resolve_int(("JAX_NUM_PROCESSES", "WORLD_SIZE"), cfg.num_processes, 1)
+    explicit_process_id = _env_int("JAX_PROCESS_ID", "RANK")
+    if explicit_process_id is None:
+        explicit_process_id = cfg.process_id
+    if explicit_process_id is None and num_processes > 1:
+        # Fail fast with a diagnosable error instead of letting every process
+        # claim rank 0 and hang in rendezvous until the timeout.
+        raise ValueError(
+            "Multi-process run (num_processes "
+            f"= {num_processes}) but process id is unset; set RANK/JAX_PROCESS_ID "
+            "or distributed.process_id"
+        )
+    process_id = explicit_process_id if explicit_process_id is not None else 0
+
+    # "" counts as unset for the address, matching _env_int's empty-as-unset rule.
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS") or None
+    if coordinator is None:
+        addr = os.environ.get("MASTER_ADDR") or cfg.coordinator_addr
+        port = _resolve_int(("MASTER_PORT",), cfg.coordinator_port, _DEFAULT_COORDINATOR_PORT)
+        coordinator = f"{addr}:{port}" if addr else None
+    return process_id, num_processes, coordinator
+
+
+def setup_distributed(cfg: DistributedConfig) -> DistState:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    With one process this is a no-op beyond resolving the topology. With
+    several, all processes block in ``jax.distributed.initialize`` until the
+    coordinator has heard from everyone — the process-group boundary the
+    reference hits in ``dist.init_process_group`` (reference :130-136).
+    """
+    global _ACTIVE_STATE, _JAX_DIST_INITIALIZED
+    logger = get_logger()
+
+    if _ACTIVE_STATE is not None:
+        logger.warning("distributed runtime already initialized; returning existing state")
+        return _ACTIVE_STATE
+
+    process_id, num_processes, coordinator = resolve_topology(cfg)
+
+    if num_processes > 1:
+        if coordinator is None:
+            raise ValueError(
+                "Multi-process run requires a coordinator address "
+                "(set MASTER_ADDR/MASTER_PORT, JAX_COORDINATOR_ADDRESS, "
+                "or distributed.coordinator_addr/coordinator_port)"
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=None,
+            initialization_timeout=cfg.timeout_sec,
+        )
+        _JAX_DIST_INITIALIZED = True
+        process_id = jax.process_index()
+        num_processes = jax.process_count()
+
+    state = DistState(
+        process_index=process_id,
+        num_processes=num_processes,
+        local_device_count=jax.local_device_count(),
+        is_main=process_id == 0,
+        coordinator=coordinator,
+    )
+    _ACTIVE_STATE = state
+    logger.info(
+        "distributed runtime ready: process %d/%d, %d local device(s)",
+        state.process_index,
+        state.num_processes,
+        state.local_device_count,
+    )
+    return state
+
+
+def teardown_distributed() -> None:
+    """Shut down the distributed runtime if this process started it."""
+    global _ACTIVE_STATE, _JAX_DIST_INITIALIZED
+    if _JAX_DIST_INITIALIZED:
+        jax.distributed.shutdown()
+        _JAX_DIST_INITIALIZED = False
+    _ACTIVE_STATE = None
+
+
+def active_state() -> DistState | None:
+    return _ACTIVE_STATE
+
+
+MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipeline", "expert")
+
+
+def resolve_mesh_axes(mesh_cfg: MeshConfig, device_count: int) -> dict[str, int]:
+    """Materialize axis sizes, expanding a single ``-1`` wildcard."""
+    sizes = mesh_cfg.axis_sizes()
+    fixed = 1
+    wildcard_axis: str | None = None
+    for axis, v in sizes.items():
+        if v == -1:
+            wildcard_axis = axis
+        else:
+            fixed *= v
+    if wildcard_axis is not None:
+        if device_count % fixed != 0:
+            raise ValueError(
+                f"device count {device_count} not divisible by fixed mesh axes product {fixed}"
+            )
+        sizes[wildcard_axis] = device_count // fixed
+        fixed *= sizes[wildcard_axis]
+    if fixed != device_count:
+        raise ValueError(
+            f"mesh axes {sizes} multiply to {fixed} but {device_count} devices are available"
+        )
+    return sizes
+
+
+def build_mesh(mesh_cfg: MeshConfig | None = None, devices=None) -> jax.sharding.Mesh:
+    """Build the global named device mesh.
+
+    Axis order puts ``data`` outermost (slowest-varying) so data-parallel
+    replicas span hosts/DCN while tensor/sequence shards stay within a host's
+    ICI neighbourhood — the layout recommended by the scaling playbook.
+    """
+    from jax.experimental import mesh_utils
+
+    if mesh_cfg is None:
+        mesh_cfg = MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = resolve_mesh_axes(mesh_cfg, len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return jax.sharding.Mesh(device_array, MESH_AXES)

@@ -1,0 +1,21 @@
+"""Run plumbing: run ids, run dirs, metadata, summaries, logging."""
+
+from .logging import JsonFormatter, configure_logging, get_logger
+from .metadata import distributed_env_snapshot, generate_meta, write_meta_json
+from .run_dir import create_run_directory, write_resolved_config
+from .run_id import generate_run_id, slugify_run_name
+from .summary import format_run_summary
+
+__all__ = [
+    "JsonFormatter",
+    "configure_logging",
+    "create_run_directory",
+    "distributed_env_snapshot",
+    "format_run_summary",
+    "generate_meta",
+    "generate_run_id",
+    "get_logger",
+    "slugify_run_name",
+    "write_meta_json",
+    "write_resolved_config",
+]

@@ -1,0 +1,38 @@
+"""Test bootstrap: force an 8-virtual-device CPU platform before JAX imports.
+
+This is the TPU-build analogue of the reference's mocked-collective technique
+(reference tests/test_distributed.py:609-619): instead of faking
+``all_gather``/``all_reduce``, we give XLA eight real host devices so mesh
+shardings and collectives execute for real in a single process.
+"""
+
+import os
+
+# Force CPU even when the host pre-sets JAX_PLATFORMS to a real TPU platform:
+# unit tests must be hermetic and use the 8-device virtual mesh. The host's
+# sitecustomize pre-imports jax, so the env var alone is too late — update the
+# config directly (the backend itself is still uninitialized at this point).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_distributed_state():
+    """Guarantee distributed-state teardown between tests.
+
+    Analogue of the reference's autouse teardown fixture
+    (reference tests/test_distributed.py:31-35).
+    """
+    yield
+    from llmtrain_tpu.distributed import teardown_distributed
+
+    teardown_distributed()

@@ -1,0 +1,134 @@
+"""Config schema + loader tests (parity with reference tests/test_config.py)."""
+
+import pytest
+import yaml
+
+from llmtrain_tpu.config import (
+    ConfigLoadError,
+    MeshConfig,
+    RunConfig,
+    load_and_validate_config,
+)
+
+MINIMAL = {
+    "run": {"name": "t"},
+    "model": {"name": "dummy_gpt"},
+    "data": {"name": "dummy_text"},
+    "trainer": {"max_steps": 10, "warmup_steps": 0},
+}
+
+
+def test_minimal_config_materializes_defaults():
+    cfg = RunConfig.model_validate(MINIMAL)
+    assert cfg.schema_version == 1
+    assert cfg.run.seed == 1337
+    assert cfg.run.device == "cpu"
+    assert cfg.model.block_size == 256
+    assert cfg.trainer.grad_accum_steps == 4
+    assert cfg.distributed.enabled is False
+    assert cfg.distributed.mesh.data == -1
+    assert cfg.mlflow.enabled is True
+    assert cfg.output.root_dir == "runs"
+
+
+def test_extra_top_level_field_rejected():
+    bad = dict(MINIMAL, bogus=1)
+    with pytest.raises(Exception):
+        RunConfig.model_validate(bad)
+
+
+def test_extra_section_field_rejected():
+    bad = {**MINIMAL, "model": {"name": "gpt", "not_a_field": 3}}
+    with pytest.raises(Exception):
+        RunConfig.model_validate(bad)
+
+
+def test_plugin_extra_escape_hatch_accepted():
+    cfg = RunConfig.model_validate(
+        {
+            **MINIMAL,
+            "model": {"name": "gpt", "extra": {"custom_knob": 7}},
+            "data": {"name": "dummy_text", "extra": {"n": 1}},
+            "trainer": {"max_steps": 10, "warmup_steps": 0, "extra": {"keep_last_k": 2}},
+        }
+    )
+    assert cfg.model.extra["custom_knob"] == 7
+    assert cfg.trainer.extra["keep_last_k"] == 2
+
+
+def test_d_model_head_divisibility_enforced():
+    bad = {**MINIMAL, "model": {"name": "gpt", "d_model": 64, "n_heads": 3}}
+    with pytest.raises(Exception, match="divisible"):
+        RunConfig.model_validate(bad)
+
+
+def test_d_ff_must_be_at_least_d_model():
+    bad = {**MINIMAL, "model": {"name": "gpt", "d_model": 64, "n_heads": 2, "d_ff": 32}}
+    with pytest.raises(Exception, match="d_ff"):
+        RunConfig.model_validate(bad)
+
+
+def test_warmup_cannot_exceed_max_steps():
+    bad = {**MINIMAL, "trainer": {"max_steps": 10, "warmup_steps": 20}}
+    with pytest.raises(Exception, match="warmup"):
+        RunConfig.model_validate(bad)
+
+
+def test_config_is_frozen():
+    cfg = RunConfig.model_validate(MINIMAL)
+    with pytest.raises(Exception):
+        cfg.run.seed = 7  # type: ignore[misc]
+
+
+def test_mesh_single_wildcard_only():
+    with pytest.raises(Exception, match="wildcard"):
+        MeshConfig(data=-1, tensor=-1)
+
+
+def test_mesh_rejects_zero_axis():
+    with pytest.raises(Exception):
+        MeshConfig(tensor=0)
+
+
+def test_device_literal_is_cpu_or_tpu():
+    bad = {**MINIMAL, "run": {"name": "t", "device": "mps"}}
+    with pytest.raises(Exception):
+        RunConfig.model_validate(bad)
+
+
+def test_loader_roundtrip(tmp_path):
+    path = tmp_path / "c.yaml"
+    path.write_text(yaml.safe_dump(MINIMAL))
+    cfg, raw, resolved = load_and_validate_config(path)
+    assert cfg.run.name == "t"
+    assert raw == MINIMAL
+    assert resolved["trainer"]["lr"] == pytest.approx(3e-4)
+    assert resolved["distributed"]["mesh"]["fsdp"] == 1
+
+
+def test_loader_missing_file(tmp_path):
+    with pytest.raises(ConfigLoadError, match="not found"):
+        load_and_validate_config(tmp_path / "nope.yaml")
+
+
+def test_loader_invalid_yaml(tmp_path):
+    path = tmp_path / "c.yaml"
+    path.write_text("run: [unclosed")
+    with pytest.raises(ConfigLoadError, match="not valid YAML"):
+        load_and_validate_config(path)
+
+
+def test_loader_non_mapping_root(tmp_path):
+    path = tmp_path / "c.yaml"
+    path.write_text("- a\n- b\n")
+    with pytest.raises(ConfigLoadError, match="mapping"):
+        load_and_validate_config(path)
+
+
+def test_loader_validation_errors_are_structured(tmp_path):
+    path = tmp_path / "c.yaml"
+    path.write_text(yaml.safe_dump({**MINIMAL, "trainer": {"max_steps": -1}}))
+    with pytest.raises(ConfigLoadError) as exc_info:
+        load_and_validate_config(path)
+    errs = exc_info.value.errors
+    assert errs and any("trainer" in e["loc"] for e in errs)

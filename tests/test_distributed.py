@@ -1,0 +1,151 @@
+"""Distributed-state + mesh tests on the 8-virtual-device CPU platform.
+
+Mirrors the reference test tiers (tests/test_distributed.py): pure-unit state
+invariants, real single-process setup/idempotency/teardown, env-beats-config
+resolution — with the multi-rank tier exercised as *real* shardings over the
+forced 8-device host platform instead of mocked collectives.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import DistributedConfig, MeshConfig
+from llmtrain_tpu.distributed import (
+    DistState,
+    active_state,
+    build_mesh,
+    resolve_mesh_axes,
+    resolve_topology,
+    setup_distributed,
+    teardown_distributed,
+)
+
+
+class TestDistState:
+    def test_valid(self):
+        s = DistState(process_index=0, num_processes=2, local_device_count=1, is_main=True)
+        assert s.rank == 0 and s.world_size == 2
+
+    def test_is_main_invariant(self):
+        with pytest.raises(ValueError, match="is_main"):
+            DistState(process_index=1, num_processes=2, local_device_count=1, is_main=True)
+
+    def test_rank_bounds(self):
+        with pytest.raises(ValueError):
+            DistState(process_index=2, num_processes=2, local_device_count=1, is_main=False)
+        with pytest.raises(ValueError):
+            DistState(process_index=0, num_processes=0, local_device_count=1, is_main=True)
+
+
+class TestTopologyResolution:
+    def test_env_beats_config(self, monkeypatch):
+        monkeypatch.setenv("RANK", "1")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "12345")
+        cfg = DistributedConfig(process_id=0, num_processes=2, coordinator_addr="cfg-host")
+        pid, n, coord = resolve_topology(cfg)
+        assert (pid, n, coord) == (1, 4, "10.0.0.1:12345")
+
+    def test_jax_native_env_beats_torch_names(self, monkeypatch):
+        monkeypatch.setenv("RANK", "1")
+        monkeypatch.setenv("JAX_PROCESS_ID", "2")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "8")
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "coord:1234")
+        pid, n, coord = resolve_topology(DistributedConfig())
+        assert (pid, n, coord) == (2, 8, "coord:1234")
+
+    def test_config_fallback(self):
+        cfg = DistributedConfig(
+            process_id=1, num_processes=2, coordinator_addr="host", coordinator_port=999
+        )
+        pid, n, coord = resolve_topology(cfg)
+        assert (pid, n, coord) == (1, 2, "host:999")
+
+    def test_defaults(self):
+        assert resolve_topology(DistributedConfig()) == (0, 1, None)
+
+    def test_bad_env_int(self, monkeypatch):
+        monkeypatch.setenv("WORLD_SIZE", "banana")
+        with pytest.raises(ValueError, match="not an integer"):
+            resolve_topology(DistributedConfig())
+
+    def test_multiprocess_unset_process_id_fails_fast(self, monkeypatch):
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        with pytest.raises(ValueError, match="process id is unset"):
+            resolve_topology(DistributedConfig())
+
+    def test_empty_coordinator_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "")
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.2")
+        _, _, coord = resolve_topology(DistributedConfig())
+        assert coord == "10.0.0.2:29500"
+
+
+class TestSetup:
+    def test_single_process_setup_and_teardown(self):
+        state = setup_distributed(DistributedConfig())
+        assert state.num_processes == 1 and state.is_main
+        assert state.local_device_count == 8  # forced host platform
+        assert active_state() is state
+        teardown_distributed()
+        assert active_state() is None
+
+    def test_idempotent_returns_same_state(self):
+        s1 = setup_distributed(DistributedConfig())
+        s2 = setup_distributed(DistributedConfig())
+        assert s1 is s2
+
+    def test_multiprocess_requires_coordinator(self):
+        with pytest.raises(ValueError, match="coordinator"):
+            setup_distributed(DistributedConfig(num_processes=2, process_id=0))
+
+
+class TestMesh:
+    def test_wildcard_resolution(self):
+        sizes = resolve_mesh_axes(MeshConfig(), 8)
+        assert sizes["data"] == 8 and sizes["tensor"] == 1
+
+    def test_explicit_axes(self):
+        sizes = resolve_mesh_axes(MeshConfig(data=2, tensor=4), 8)
+        assert sizes == {
+            "data": 2, "fsdp": 1, "tensor": 4, "sequence": 1, "pipeline": 1, "expert": 1,
+        }
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            resolve_mesh_axes(MeshConfig(data=-1, tensor=3), 8)
+
+    def test_mismatched_product_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            resolve_mesh_axes(MeshConfig(data=2, tensor=2), 8)
+
+    def test_build_mesh_and_psum(self):
+        """A real psum over the data axis of a real 8-device mesh."""
+        mesh = build_mesh(MeshConfig(data=4, tensor=2))
+        assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.arange(8, dtype=np.float32)
+        sharded = jax.device_put(x, NamedSharding(mesh, P(("data", "tensor"))))
+
+        @jax.jit
+        def total(v):
+            return jax.numpy.sum(v)
+
+        assert float(total(sharded)) == float(x.sum())
+
+    def test_build_mesh_sharded_matmul(self):
+        """Tensor-parallel matmul: weight sharded on 'tensor', XLA all-gathers."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = build_mesh(MeshConfig(data=2, tensor=4))
+        w = np.ones((16, 8), dtype=np.float32)
+        x = np.ones((4, 16), dtype=np.float32)
+        ws = jax.device_put(w, NamedSharding(mesh, P(None, "tensor")))
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        out = jax.jit(lambda a, b: a @ b)(xs, ws)
+        np.testing.assert_allclose(np.asarray(out), x @ w)

@@ -1,0 +1,62 @@
+"""Run-id generation tests (parity with reference tests/test_run_id.py)."""
+
+import pytest
+
+from llmtrain_tpu.utils import run_id as run_id_mod
+from llmtrain_tpu.utils.run_id import generate_run_id, slugify_run_name
+
+
+def test_slugify_lowercases_and_squashes():
+    assert slugify_run_name("My Fancy RUN!!") == "my-fancy-run"
+    assert slugify_run_name("a__b--c") == "a__b-c"
+
+
+def test_slugify_truncates_to_40():
+    assert len(slugify_run_name("x" * 100)) == 40
+
+
+def test_slugify_empty_falls_back():
+    assert slugify_run_name("!!!") == "run"
+
+
+class _FixedDatetime:
+    @classmethod
+    def now(cls, tz=None):
+        import datetime as dt
+
+        return dt.datetime(2026, 1, 2, 3, 4, 5, tzinfo=tz)
+
+
+def test_generate_run_id_format(tmp_path, monkeypatch):
+    monkeypatch.setattr(run_id_mod, "datetime", _FixedDatetime)
+    monkeypatch.setattr(run_id_mod, "_git_short_sha", lambda: "abc1234")
+    rid = generate_run_id("Hello World", tmp_path)
+    assert rid == "20260102_030405_abc1234_hello-world"
+
+
+def test_generate_run_id_collision_suffix(tmp_path, monkeypatch):
+    monkeypatch.setattr(run_id_mod, "datetime", _FixedDatetime)
+    monkeypatch.setattr(run_id_mod, "_git_short_sha", lambda: "abc1234")
+    base = generate_run_id("x", tmp_path)
+    (tmp_path / base).mkdir()
+    second = generate_run_id("x", tmp_path)
+    assert second == base + "__01"
+    (tmp_path / second).mkdir()
+    assert generate_run_id("x", tmp_path) == base + "__02"
+
+
+def test_generate_run_id_collision_exhaustion(tmp_path, monkeypatch):
+    monkeypatch.setattr(run_id_mod, "datetime", _FixedDatetime)
+    monkeypatch.setattr(run_id_mod, "_git_short_sha", lambda: "abc1234")
+    monkeypatch.setattr(run_id_mod, "_MAX_COLLISION_SUFFIX", 2)
+    base = generate_run_id("x", tmp_path)
+    for suffix in ["", "__01", "__02"]:
+        (tmp_path / (base + suffix)).mkdir()
+    with pytest.raises(RuntimeError):
+        generate_run_id("x", tmp_path)
+
+
+def test_generate_run_id_nogit(tmp_path, monkeypatch):
+    monkeypatch.setattr(run_id_mod, "datetime", _FixedDatetime)
+    monkeypatch.setattr(run_id_mod, "git_sha", lambda *, short: None)
+    assert "_nogit_" in generate_run_id("x", tmp_path)
