@@ -1,0 +1,6 @@
+"""Data plugins: the DataModule contract, sampler, and built-in modules."""
+
+from .base import DataModule, IndexedDataset
+from .sampler import DeterministicSampler
+
+__all__ = ["DataModule", "DeterministicSampler", "IndexedDataset"]

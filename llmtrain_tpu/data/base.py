@@ -1,0 +1,48 @@
+"""Data-module plugin contract.
+
+Parity target: reference ``src/llmtrain/data/base.py`` (DataModule ABC with
+setup/train_dataloader/val_dataloader, :11-24). The TPU design replaces
+stateful torch DataLoaders + DistributedSampler with *indexable datasets*:
+``setup`` prepares arrays, ``train_dataset``/``val_dataset`` return objects
+supporting random access by example index. Batch order, sharding across
+processes, and resume position are then pure functions of (seed, step) —
+see ``llmtrain_tpu.data.sampler`` — which is what makes bitwise resume and
+multi-host determinism possible without the reference's single-process
+skip-ahead hack (reference trainer.py:336-347).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Protocol, runtime_checkable
+
+import numpy as np
+
+from ..config.schemas import RunConfig
+
+
+@runtime_checkable
+class IndexedDataset(Protocol):
+    """Random-access dataset of fixed-shape tokenized examples."""
+
+    def __len__(self) -> int: ...
+
+    def get_examples(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        """Gather a batch: each value has leading dim ``len(indices)``."""
+        ...
+
+
+class DataModule(ABC):
+    """Prepares train/val datasets for a run."""
+
+    @abstractmethod
+    def setup(self, cfg: RunConfig, tokenizer: Any | None) -> None:
+        """Load/tokenize/cache data. Called once before training."""
+
+    @abstractmethod
+    def train_dataset(self) -> IndexedDataset:
+        """The training split (must be non-empty)."""
+
+    @abstractmethod
+    def val_dataset(self) -> IndexedDataset | None:
+        """The validation split, or None if the module has no val data."""

@@ -1,0 +1,150 @@
+"""HuggingFace text data module: tokenize → concatenate → window.
+
+Parity target: reference ``src/llmtrain/data/hf_text.py`` — ``load_dataset``
+with cache_dir (:81-86), tokenize→concatenate→slice into ``block_size + 1``
+windows yielding ``input_ids = chunk[:-1]`` / ``labels = chunk[1:]`` /
+all-ones attention_mask (:108-174), processed-split disk cache keyed by
+dataset/config/split (:97-106).
+
+TPU-first divergence: instead of materializing a HF dataset of per-window
+rows, the tokenized stream is stored as ONE flat int32 numpy array (cached as
+``.npy``) and windows are cut at access time — zero-copy random access, an
+order of magnitude less cache space, and gather-friendly for the
+deterministic index-based sampler. The window content is identical:
+non-overlapping ``block_size + 1`` chunks of the concatenated stream.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from ..config.schemas import RunConfig
+from ..registry.data import register_data_module
+from .base import DataModule, IndexedDataset
+
+
+class TokenWindowDataset:
+    """Non-overlapping (block_size+1)-token windows over a flat stream."""
+
+    def __init__(self, tokens: np.ndarray, block_size: int) -> None:
+        if tokens.ndim != 1:
+            raise ValueError(f"token stream must be 1-D, got shape {tokens.shape}")
+        self._tokens = tokens
+        self._block_size = block_size
+        self._chunk = block_size + 1
+        self._num_windows = len(tokens) // self._chunk
+
+    def __len__(self) -> int:
+        return self._num_windows
+
+    def get_examples(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        starts = np.asarray(indices, dtype=np.int64) * self._chunk
+        # Gather all windows in one vectorized fancy-index.
+        offsets = np.arange(self._chunk, dtype=np.int64)
+        chunks = self._tokens[starts[:, None] + offsets[None, :]]
+        input_ids = np.ascontiguousarray(chunks[:, :-1], dtype=np.int32)
+        labels = np.ascontiguousarray(chunks[:, 1:], dtype=np.int32)
+        return {
+            "input_ids": input_ids,
+            "labels": labels,
+            "attention_mask": np.ones_like(input_ids),
+        }
+
+
+@register_data_module("hf_text")
+class HFTextDataModule(DataModule):
+    """Loads a HuggingFace text dataset and serves fixed token windows."""
+
+    def __init__(self) -> None:
+        self._cfg: RunConfig | None = None
+        self._train: TokenWindowDataset | None = None
+        self._val: TokenWindowDataset | None = None
+
+    def setup(self, cfg: RunConfig, tokenizer: Any | None = None) -> None:
+        if tokenizer is None:
+            raise ValueError("hf_text requires a tokenizer from the model adapter")
+        if cfg.data.dataset_name is None:
+            raise ValueError("hf_text requires data.dataset_name")
+        text_column = cfg.data.text_column or "text"
+        self._cfg = cfg
+
+        train_tokens = self._prepare_split(cfg, cfg.data.train_split, tokenizer, text_column)
+        self._train = TokenWindowDataset(train_tokens, cfg.model.block_size)
+        self._val = None
+        if cfg.data.val_split:
+            val_tokens = self._prepare_split(cfg, cfg.data.val_split, tokenizer, text_column)
+            val_ds = TokenWindowDataset(val_tokens, cfg.model.block_size)
+            if len(val_ds) > 0:
+                self._val = val_ds
+
+    def _token_cache_path(self, cfg: RunConfig, split: str, tokenizer: Any) -> Path:
+        dataset_name = (cfg.data.dataset_name or "unknown").replace("/", "__")
+        dataset_config = (cfg.data.dataset_config or "default").replace("/", "__")
+        # Key the cache by tokenizer identity too: reusing token ids produced
+        # by a different tokenizer would silently corrupt training.
+        tok_id = f"{type(tokenizer).__name__}{getattr(tokenizer, 'n_vocab', 'x')}"
+        return (
+            Path(cfg.data.cache_dir)
+            / "processed"
+            / f"{dataset_name}__{dataset_config}__{tok_id}__{split}.npy"
+        )
+
+    def _prepare_split(
+        self, cfg: RunConfig, split: str, tokenizer: Any, text_column: str
+    ) -> np.ndarray:
+        cache_path = self._token_cache_path(cfg, split, tokenizer)
+        if cache_path.exists():
+            return np.load(cache_path, mmap_mode="r")
+
+        from datasets import load_dataset
+
+        raw = load_dataset(
+            cfg.data.dataset_name,
+            cfg.data.dataset_config,
+            split=split,
+            cache_dir=cfg.data.cache_dir,
+        )
+        tokens = self._tokenize_stream(raw, tokenizer, text_column)
+        cache_path.parent.mkdir(parents=True, exist_ok=True)
+        # np.save appends ".npy" unless the name already ends with it.
+        tmp = cache_path.with_suffix(".tmp.npy")
+        np.save(tmp, tokens)
+        tmp.replace(cache_path)
+        return tokens
+
+    @staticmethod
+    def _tokenize_stream(raw_dataset: Any, tokenizer: Any, text_column: str) -> np.ndarray:
+        """Encode every row's text column and concatenate into one stream."""
+        pieces: list[np.ndarray] = []
+        batch_encode = getattr(tokenizer, "encode_ordinary_batch", None)
+        texts = (str(t) for t in raw_dataset[text_column] if t is not None)
+        if batch_encode is not None:
+            # tiktoken fast path: parallel batch encoding without special tokens.
+            encoded_lists = batch_encode(list(texts))
+            pieces = [np.asarray(ids, dtype=np.int32) for ids in encoded_lists if ids]
+        else:
+            for text in texts:
+                ids = tokenizer.encode(text)
+                if not isinstance(ids, list):
+                    raise ValueError("Tokenizer encode output must be a list of token ids.")
+                if ids:
+                    pieces.append(np.asarray(ids, dtype=np.int32))
+        if not pieces:
+            return np.zeros((0,), dtype=np.int32)
+        return np.concatenate(pieces)
+
+    def train_dataset(self) -> IndexedDataset:
+        if self._train is None:
+            raise RuntimeError("setup must be called before train_dataset")
+        return self._train
+
+    def val_dataset(self) -> IndexedDataset | None:
+        if self._cfg is None:
+            raise RuntimeError("setup must be called before val_dataset")
+        return self._val
+
+
+__all__ = ["HFTextDataModule", "TokenWindowDataset"]

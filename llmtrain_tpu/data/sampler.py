@@ -1,0 +1,70 @@
+"""Deterministic, stateless batch sampling.
+
+Replaces the reference's ``DistributedSampler`` + epoch iteration
+(reference data/hf_text.py:182-198) and its resume-by-replay batch skipping
+(reference trainer.py:336-347, explicitly unsafe under DDP) with a pure
+function: the examples making up global micro-batch ``b`` are a function of
+``(seed, b)`` only. Every process computes the same global index list and
+slices out its own shard, so resume and multi-host sharding are exact by
+construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def _epoch_permutation(num_examples: int, seed: int, epoch: int) -> np.ndarray:
+    rng = np.random.default_rng(np.random.SeedSequence(entropy=seed, spawn_key=(epoch,)))
+    return rng.permutation(num_examples)
+
+
+@dataclass(frozen=True)
+class DeterministicSampler:
+    """Maps a global micro-batch index to example indices.
+
+    ``batch_size`` is the *global* micro-batch size (per-replica batch ×
+    data-parallel degree). Incomplete trailing batches are dropped, matching
+    torch DataLoader ``drop_last`` semantics for stable shapes under jit.
+    """
+
+    num_examples: int
+    batch_size: int
+    seed: int
+    shuffle: bool = True
+
+    def __post_init__(self) -> None:
+        if self.num_examples < self.batch_size:
+            raise ValueError(
+                f"dataset has {self.num_examples} examples but the global "
+                f"micro-batch needs {self.batch_size}"
+            )
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.num_examples // self.batch_size
+
+    def batch_indices(self, batch_index: int) -> np.ndarray:
+        """Example indices of global micro-batch ``batch_index`` (0-based)."""
+        epoch, pos = divmod(batch_index, self.batches_per_epoch)
+        if self.shuffle:
+            perm = _epoch_permutation(self.num_examples, self.seed, epoch)
+        else:
+            perm = np.arange(self.num_examples)
+        return perm[pos * self.batch_size : (pos + 1) * self.batch_size]
+
+    def shard_indices(self, batch_index: int, shard: int, num_shards: int) -> np.ndarray:
+        """This process's contiguous slice of the global batch.
+
+        Slicing is contiguous (not strided) so the host slice matches the
+        ``data``-axis sharding layout of the global device array.
+        """
+        if self.batch_size % num_shards != 0:
+            raise ValueError(
+                f"global micro-batch {self.batch_size} not divisible by {num_shards} shards"
+            )
+        per_shard = self.batch_size // num_shards
+        full = self.batch_indices(batch_index)
+        return full[shard * per_shard : (shard + 1) * per_shard]

@@ -1,0 +1,12 @@
+"""Model plugins: the ModelAdapter contract and built-in adapters."""
+
+from .base import Batch, Metrics, ModelAdapter, Params, masked_cross_entropy, validate_lm_batch
+
+__all__ = [
+    "Batch",
+    "Metrics",
+    "ModelAdapter",
+    "Params",
+    "masked_cross_entropy",
+    "validate_lm_batch",
+]

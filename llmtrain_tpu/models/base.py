@@ -1,0 +1,121 @@
+"""Model-adapter plugin contract.
+
+Parity target: reference ``src/llmtrain/models/base.py`` (ModelAdapter ABC
+with build_model/build_tokenizer/compute_loss, :12-27), adapted to JAX's
+functional split between module definition and parameters:
+
+* ``build_model`` returns a Flax module (pure function of params + inputs).
+* ``init_params`` is new — JAX params are explicit, not stored in the module.
+* ``compute_loss`` takes ``(model, params, batch)`` and must be jit-traceable:
+  shape/dtype validation happens at trace time (Python raises are fine there),
+  and returned metrics are JAX scalars, not floats.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+
+Params = Any  # PyTree of arrays
+Batch = dict[str, jax.Array]
+Metrics = dict[str, jax.Array]
+
+
+class ModelAdapter(ABC):
+    """Builds a Flax model + tokenizer and defines its training loss."""
+
+    @abstractmethod
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        """Construct the (uninitialized) Flax module from config."""
+
+    @abstractmethod
+    def build_tokenizer(self, cfg: RunConfig) -> Any | None:
+        """Construct the tokenizer, or None for models that need none."""
+
+    def init_params(self, model: nn.Module, cfg: RunConfig, rng: jax.Array) -> Params:
+        """Initialize the parameter PyTree.
+
+        Default: trace the module with a dummy ``(1, block_size)`` token batch.
+        """
+        tokens = jnp.zeros((1, cfg.model.block_size), dtype=jnp.int32)
+        variables = model.init({"params": rng}, tokens, deterministic=True)
+        return variables["params"]
+
+    @abstractmethod
+    def compute_loss(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, Metrics]:
+        """Pure loss function: ``(scalar loss, metrics dict of JAX scalars)``."""
+
+
+def validate_lm_batch(batch: Batch) -> tuple[jax.Array, jax.Array, jax.Array | None]:
+    """Trace-time validation shared by language-model adapters.
+
+    Mirrors the reference's defensive checks (reference models/gpt.py:214-252):
+    2-D input_ids/labels of equal shape, integer dtype, seq len >= 2, and an
+    optional attention_mask matching input_ids.
+    """
+    input_ids = batch["input_ids"]
+    labels = batch["labels"]
+    attention_mask = batch.get("attention_mask")
+
+    if input_ids.ndim != 2 or labels.ndim != 2:
+        raise ValueError(
+            f"Expected input_ids and labels to be 2D (B, T); "
+            f"got {tuple(input_ids.shape)} and {tuple(labels.shape)}."
+        )
+    if input_ids.shape != labels.shape:
+        raise ValueError(
+            "Expected input_ids and labels to have the same shape; "
+            f"got {tuple(input_ids.shape)} vs {tuple(labels.shape)}."
+        )
+    if not jnp.issubdtype(input_ids.dtype, jnp.integer) or not jnp.issubdtype(
+        labels.dtype, jnp.integer
+    ):
+        raise ValueError(
+            f"Expected integer input_ids and labels; got {input_ids.dtype} and {labels.dtype}."
+        )
+    if input_ids.shape[1] < 2:
+        raise ValueError("Expected sequence length >= 2 for next-token loss.")
+
+    if attention_mask is not None:
+        if attention_mask.ndim != 2 or attention_mask.shape != input_ids.shape:
+            raise ValueError(
+                "Expected attention_mask to match input_ids shape; "
+                f"got {tuple(attention_mask.shape)} vs {tuple(input_ids.shape)}."
+            )
+        if not (
+            jnp.issubdtype(attention_mask.dtype, jnp.integer)
+            or attention_mask.dtype == jnp.bool_
+        ):
+            raise ValueError(f"Expected bool or integer attention_mask; got {attention_mask.dtype}.")
+
+    return input_ids, labels, attention_mask
+
+
+def masked_cross_entropy(
+    logits: jax.Array, labels: jax.Array, attention_mask: jax.Array | None
+) -> jax.Array:
+    """Position-wise CE with mask-aware mean (reference gpt.py:256-269).
+
+    Labels are already shifted by the data pipeline (reference hf_text.py:125),
+    so no shift happens here.
+    """
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per_token = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    if attention_mask is None:
+        return per_token.mean()
+    mask = attention_mask.astype(jnp.float32)
+    return jnp.sum(per_token * mask) / jnp.maximum(jnp.sum(mask), 1.0)

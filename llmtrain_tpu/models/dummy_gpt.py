@@ -1,0 +1,79 @@
+"""Tiny dummy model adapter — the fast fake backend for tests.
+
+Parity target: reference ``src/llmtrain/models/dummy_gpt.py`` — a minimal
+embed→mix→lm_head model with the same defensive clamps (d_model capped at 64,
+n_heads divisibility fixed, reference :43-47) registered as ``dummy_gpt``.
+The mixer is a single gelu MLP rather than a torch TransformerEncoder layer:
+the dummy backend's contract is "cheap, deterministic, loss can decrease",
+not architectural fidelity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .base import Batch, Metrics, ModelAdapter, Params, masked_cross_entropy, validate_lm_batch
+
+
+class _TinyLM(nn.Module):
+    vocab_size: int
+    d_model: int
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        *,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        del attention_mask, deterministic
+        x = nn.Embed(self.vocab_size, self.d_model, name="embed")(input_ids)
+        h = nn.Dense(self.d_model * 2, name="mlp_in")(x)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, name="mlp_out")(h)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)
+
+
+@register_model("dummy_gpt")
+class DummyGPTAdapter(ModelAdapter):
+    """Tiny adapter for dry-run smoke tests."""
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        vocab_size = cfg.model.vocab_size or 128
+        d_model = min(cfg.model.d_model or 128, 64)
+        return _TinyLM(vocab_size=vocab_size, d_model=d_model)
+
+    def build_tokenizer(self, cfg: RunConfig) -> Any | None:
+        del cfg
+        return None
+
+    def compute_loss(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, Metrics]:
+        input_ids, labels, attention_mask = validate_lm_batch(batch)
+        logits = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask=attention_mask,
+            deterministic=deterministic,
+            rngs=rngs,
+        )
+        loss = masked_cross_entropy(logits, labels, attention_mask)
+        return loss, {"loss": loss}
+
+
+__all__ = ["DummyGPTAdapter", "_TinyLM"]

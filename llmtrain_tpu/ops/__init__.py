@@ -1,0 +1,1 @@
+"""Hot-path ops: Pallas TPU kernels with XLA fallbacks."""

@@ -1,0 +1,120 @@
+"""Blockwise (memory-efficient) causal attention in pure JAX.
+
+New TPU capability beyond the reference (which only has full-matrix attention,
+reference models/gpt.py:56-69): computes exact attention with online softmax
+over key/value chunks, so peak memory is O(T * block) instead of O(T^2). The
+chunk loop is a ``lax.scan`` whose body is ``jax.checkpoint``-ed, giving the
+same O(T) memory through autodiff — this is the single-device core that ring
+attention (``ops/ring_attention.py``) extends across the ``sequence`` mesh
+axis. Pattern follows the Blockwise Parallel Transformers / Ring Attention
+papers (see PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _chunk_scan(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_offset: jax.Array | int,
+    kv_offset: jax.Array | int,
+    causal: bool,
+    kv_chunk: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Online-softmax accumulation of one q-chunk over all kv-chunks.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D). Offsets give the absolute positions
+    of the first query/key, so the causal mask works on chunks of a larger
+    sequence (ring attention passes nonzero kv_offset).
+    Returns (acc, row_max, row_sum) with acc un-normalized: out = acc / row_sum.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    tq = q.shape[1]
+    num_kv = k.shape[1] // kv_chunk
+
+    k_chunks = k.reshape(k.shape[0], num_kv, kv_chunk, *k.shape[2:])
+    v_chunks = v.reshape(v.shape[0], num_kv, kv_chunk, *v.shape[2:])
+
+    q_pos = q_offset + jnp.arange(tq)
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def body(carry, inputs):
+        acc, row_max, row_sum = carry
+        k_c, v_c, chunk_idx = inputs
+        s = jnp.einsum("bqhd,bkhd->bqhk", q, k_c) * scale
+        s = s.astype(jnp.float32)
+        if causal:
+            k_pos = kv_offset + chunk_idx * kv_chunk + jnp.arange(kv_chunk)
+            mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, kv_chunk)
+            s = jnp.where(mask[None, :, None, :], s, _NEG_INF)
+        new_max = jnp.maximum(row_max, s.max(axis=-1))
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(s - new_max[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bqhk,bkhd->bqhd", p.astype(v_c.dtype), v_c
+        ).astype(jnp.float32)
+        row_sum = row_sum * correction + p.sum(axis=-1)
+        return (acc, new_max, row_sum), None
+
+    b, _, h, d = q.shape
+    init = (
+        jnp.zeros((b, tq, h, d), jnp.float32),
+        jnp.full((b, tq, h), _NEG_INF, jnp.float32),
+        jnp.zeros((b, tq, h), jnp.float32),
+    )
+    k_scan = jnp.moveaxis(k_chunks, 1, 0)
+    v_scan = jnp.moveaxis(v_chunks, 1, 0)
+    (acc, row_max, row_sum), _ = jax.lax.scan(
+        body, init, (k_scan, v_scan, jnp.arange(num_kv))
+    )
+    return acc, row_max, row_sum
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_chunk: int = 512,
+    kv_chunk: int = 512,
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory."""
+    b, tq, h, d = q.shape
+    q_chunk = min(q_chunk, tq)
+    kv_chunk = min(kv_chunk, k.shape[1])
+    if tq % q_chunk != 0 or k.shape[1] % kv_chunk != 0:
+        # Fall back to single-chunk (dense) for ragged sizes.
+        q_chunk, kv_chunk = tq, k.shape[1]
+
+    num_q = tq // q_chunk
+
+    def one_q_chunk(qi):
+        qc = jax.lax.dynamic_slice_in_dim(q, qi * q_chunk, q_chunk, axis=1)
+        acc, _, row_sum = _chunk_scan(
+            qc,
+            k,
+            v,
+            q_offset=q_offset + qi * q_chunk,
+            kv_offset=kv_offset,
+            causal=causal,
+            kv_chunk=kv_chunk,
+        )
+        return (acc / row_sum[..., None]).astype(q.dtype)
+
+    if num_q == 1:
+        return one_q_chunk(0)
+    outs = jax.lax.map(one_q_chunk, jnp.arange(num_q))  # (num_q, B, q_chunk, H, D)
+    return jnp.moveaxis(outs, 0, 1).reshape(b, tq, h, d)

@@ -1,0 +1,63 @@
+"""Flash attention dispatch: Pallas forward on TPU, blockwise everywhere.
+
+New TPU capability beyond the reference (full-matrix attention only,
+reference models/gpt.py:56-69). Training differentiates through a
+``jax.custom_vjp``: the forward runs the Pallas kernel on TPU (or blockwise
+on CPU), the backward recomputes via the checkpointed blockwise
+implementation — O(T) memory both directions, no (T, T) materialization.
+
+Padding masks route to the model's dense path (``models/gpt.py``); flash is
+the packed/causal fast path, which is also what the data pipeline produces
+(all-ones masks from hf_text windows).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .blockwise_attention import blockwise_attention
+
+
+def _forward_best(q, k, v, causal: bool):
+    if jax.default_backend() == "tpu" and q.shape[1] % 128 == 0:
+        from .pallas_attention import pallas_flash_attention
+
+        return pallas_flash_attention(q, k, v, causal=causal)
+    return blockwise_attention(q, k, v, causal=causal)
+
+
+@jax.custom_vjp
+def _flash(q, k, v):
+    return _forward_best(q, k, v, causal=True)
+
+
+def _flash_fwd(q, k, v):
+    return _flash(q, k, v), (q, k, v)
+
+
+def _flash_bwd(residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=True), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    attention_mask: jax.Array | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention over (B, T, H, Dh); O(T) memory, differentiable."""
+    if attention_mask is not None:
+        raise ValueError(
+            "flash attention does not support padding masks; use attention='dense' "
+            "for padded batches (hf_text/dummy_text produce all-ones masks)"
+        )
+    if not causal:
+        return blockwise_attention(q, k, v, causal=False)
+    return _flash(q, k, v)

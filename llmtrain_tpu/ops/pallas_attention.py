@@ -1,0 +1,121 @@
+"""Pallas TPU flash-attention forward kernel.
+
+The MXU-resident hot path for causal attention: one grid program per
+(batch*head, q-block), streaming K/V through VMEM with online softmax, so
+nothing of shape (T, T) ever exists. Written per the Pallas TPU guide
+(grid/BlockSpec tiling, f32 accumulation via preferred_element_type, 2-D
+iota for masks). Differentiability is provided in ``ops/flash_attention.py``
+via custom_vjp with a blockwise-recompute backward.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, causal: bool):
+    """One q-block vs the streamed K/V sequence.
+
+    Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D).
+    """
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    seq_len = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kv = seq_len // block_k
+    if causal:
+        # Only blocks that intersect the causal triangle for this q block.
+        num_kv_live = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
+        num_kv = jnp.minimum(num_kv, num_kv_live)
+
+    def body(kb, carry):
+        acc, row_max, row_sum = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]  # (BK, D)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q,
+            k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        new_max = jnp.maximum(row_max, s.max(axis=1))
+        p = jnp.exp(s - new_max[:, None])
+        correction = jnp.exp(row_max - new_max)
+        acc = acc * correction[:, None] + jax.lax.dot_general(
+            p,
+            v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        row_sum = row_sum * correction + p.sum(axis=1)
+        return acc, new_max, row_sum
+
+    init = (
+        jnp.zeros((block_q, head_dim), jnp.float32),
+        jnp.full((block_q,), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    acc, _, row_sum = jax.lax.fori_loop(0, num_kv, body, init)
+    o_ref[0] = (acc / row_sum[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def pallas_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal flash attention over (B, T, H, D); forward only.
+
+    Falls back to smaller blocks automatically when T < block size.
+    """
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError(f"sequence length {t} must be divisible by block sizes")
+
+    # Fold heads into the grid's batch dimension: (B*H, T, D).
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    scale = 1.0 / math.sqrt(d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)

@@ -1,0 +1,47 @@
+"""Model-adapter registry.
+
+Parity target: reference ``src/llmtrain/registry/models.py`` — name→class
+dict, duplicate registration raises listing available names (:32-37), unknown
+lookup raises listing available names (:46-48).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+from ..models.base import ModelAdapter
+
+
+class RegistryError(Exception):
+    """Raised on duplicate registration or unknown lookup."""
+
+
+_MODEL_ADAPTERS: dict[str, type[ModelAdapter]] = {}
+
+T = TypeVar("T", bound=type[ModelAdapter])
+
+
+def register_model(name: str) -> Callable[[T], T]:
+    def decorator(cls: T) -> T:
+        if name in _MODEL_ADAPTERS:
+            raise RegistryError(
+                f"Model adapter {name!r} is already registered. "
+                f"Available: {sorted(_MODEL_ADAPTERS)}"
+            )
+        _MODEL_ADAPTERS[name] = cls
+        return cls
+
+    return decorator
+
+
+def get_model_adapter(name: str) -> type[ModelAdapter]:
+    try:
+        return _MODEL_ADAPTERS[name]
+    except KeyError:
+        raise RegistryError(
+            f"Unknown model adapter {name!r}. Available: {sorted(_MODEL_ADAPTERS)}"
+        ) from None
+
+
+def available_model_adapters() -> list[str]:
+    return sorted(_MODEL_ADAPTERS)

@@ -1,0 +1,178 @@
+"""Sampler + dummy_text + hf_text tests (parity with reference
+tests/test_dummy_text_data.py and tests/test_hf_text_data.py)."""
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.data.dummy_text import DummyTextDataModule
+from llmtrain_tpu.data.hf_text import HFTextDataModule, TokenWindowDataset
+from llmtrain_tpu.data.sampler import DeterministicSampler
+
+CFG = {
+    "run": {"name": "t", "seed": 11},
+    "model": {"name": "dummy_gpt", "block_size": 8, "vocab_size": 32},
+    "data": {"name": "dummy_text"},
+    "trainer": {"max_steps": 10, "micro_batch_size": 4, "warmup_steps": 0},
+}
+
+
+class TestSampler:
+    def test_deterministic_and_epoch_varies(self):
+        s = DeterministicSampler(num_examples=100, batch_size=10, seed=3)
+        assert np.array_equal(s.batch_indices(4), s.batch_indices(4))
+        # Different epochs shuffle differently.
+        a = s.batch_indices(0)
+        b = s.batch_indices(s.batches_per_epoch)  # same position, next epoch
+        assert not np.array_equal(a, b)
+
+    def test_epoch_covers_all_examples_once(self):
+        s = DeterministicSampler(num_examples=40, batch_size=10, seed=0)
+        seen = np.concatenate([s.batch_indices(i) for i in range(s.batches_per_epoch)])
+        assert sorted(seen.tolist()) == list(range(40))
+
+    def test_drop_last(self):
+        s = DeterministicSampler(num_examples=47, batch_size=10, seed=0)
+        assert s.batches_per_epoch == 4
+
+    def test_shard_slicing(self):
+        s = DeterministicSampler(num_examples=64, batch_size=8, seed=0)
+        full = s.batch_indices(2)
+        parts = [s.shard_indices(2, r, 4) for r in range(4)]
+        assert np.array_equal(np.concatenate(parts), full)
+
+    def test_shard_indivisible_raises(self):
+        s = DeterministicSampler(num_examples=64, batch_size=8, seed=0)
+        with pytest.raises(ValueError, match="divisible"):
+            s.shard_indices(0, 0, 3)
+
+    def test_no_shuffle_is_sequential(self):
+        s = DeterministicSampler(num_examples=20, batch_size=5, seed=0, shuffle=False)
+        assert np.array_equal(s.batch_indices(0), np.arange(5))
+
+    def test_too_small_dataset_raises(self):
+        with pytest.raises(ValueError, match="examples"):
+            DeterministicSampler(num_examples=3, batch_size=8, seed=0)
+
+
+class TestDummyText:
+    def test_shapes_and_determinism(self):
+        cfg = RunConfig.model_validate(CFG)
+        dm = DummyTextDataModule()
+        dm.setup(cfg, None)
+        train = dm.train_dataset()
+        assert len(train) == 40  # max_steps * micro_batch
+        batch = train.get_examples(np.array([0, 1, 2]))
+        assert batch["input_ids"].shape == (3, 8)
+        assert np.array_equal(batch["labels"], batch["input_ids"])
+        assert batch["attention_mask"].all()
+        again = train.get_examples(np.array([0, 1, 2]))
+        assert np.array_equal(batch["input_ids"], again["input_ids"])
+
+    def test_val_split_sizing_and_seed(self):
+        cfg = RunConfig.model_validate(CFG)
+        dm = DummyTextDataModule()
+        dm.setup(cfg, None)
+        val = dm.val_dataset()
+        assert len(val) == 8  # 40 // 5
+        tb = dm.train_dataset().get_examples(np.array([0]))
+        vb = val.get_examples(np.array([0]))
+        assert not np.array_equal(tb["input_ids"], vb["input_ids"])
+
+    def test_seq_len_capped_at_8(self):
+        cfg = RunConfig.model_validate(
+            {**CFG, "model": {"name": "dummy_gpt", "block_size": 256, "vocab_size": 32}}
+        )
+        dm = DummyTextDataModule()
+        dm.setup(cfg, None)
+        assert dm.train_dataset().get_examples(np.array([0]))["input_ids"].shape[1] == 8
+
+    def test_setup_required(self):
+        with pytest.raises(RuntimeError, match="setup"):
+            DummyTextDataModule().train_dataset()
+
+
+class TestTokenWindowDataset:
+    def test_windows(self):
+        tokens = np.arange(25, dtype=np.int32)
+        ds = TokenWindowDataset(tokens, block_size=4)  # chunk=5 -> 5 windows
+        assert len(ds) == 5
+        b = ds.get_examples(np.array([0, 2]))
+        assert np.array_equal(b["input_ids"][0], [0, 1, 2, 3])
+        assert np.array_equal(b["labels"][0], [1, 2, 3, 4])
+        assert np.array_equal(b["input_ids"][1], [10, 11, 12, 13])
+        assert b["attention_mask"].all()
+
+
+class _ToyTokenizer:
+    n_vocab = 128
+
+    def encode(self, text):
+        return [ord(c) % 128 for c in text]
+
+
+def _hf_cfg(tmp_path, block_size=8):
+    return RunConfig.model_validate(
+        {
+            "run": {"name": "t"},
+            "model": {"name": "gpt", "block_size": block_size, "vocab_size": 128},
+            "data": {
+                "name": "hf_text",
+                "dataset_name": "toy",
+                "cache_dir": str(tmp_path),
+                "text_column": "text",
+            },
+            "trainer": {"max_steps": 2, "warmup_steps": 0},
+        }
+    )
+
+
+class TestHFText:
+    def _patch_load(self, monkeypatch, rows):
+        import llmtrain_tpu.data.hf_text as mod
+
+        calls = {"n": 0}
+
+        class _FakeDS:
+            def __getitem__(self, col):
+                assert col == "text"
+                return rows
+
+        def fake_load_dataset(name, config, split, cache_dir):
+            calls["n"] += 1
+            return _FakeDS()
+
+        import datasets
+
+        monkeypatch.setattr(datasets, "load_dataset", fake_load_dataset)
+        return calls
+
+    def test_pipeline_and_cache_reuse(self, tmp_path, monkeypatch):
+        calls = self._patch_load(monkeypatch, ["abcdefghijklmnopqr", None, "stuvwxyz"])
+        cfg = _hf_cfg(tmp_path)
+        dm = HFTextDataModule()
+        dm.setup(cfg, _ToyTokenizer())
+        train = dm.train_dataset()
+        # 26 tokens total, chunk=9 -> 2 windows
+        assert len(train) == 2
+        batch = train.get_examples(np.array([0]))
+        assert batch["input_ids"][0].tolist() == [ord(c) for c in "abcdefgh"]
+        assert batch["labels"][0].tolist() == [ord(c) for c in "bcdefghi"]
+        first_calls = calls["n"]
+
+        dm2 = HFTextDataModule()
+        dm2.setup(cfg, _ToyTokenizer())
+        assert calls["n"] == first_calls  # served from .npy cache
+        assert len(dm2.train_dataset()) == 2
+
+    def test_requires_tokenizer_and_dataset_name(self, tmp_path):
+        cfg = _hf_cfg(tmp_path)
+        with pytest.raises(ValueError, match="tokenizer"):
+            HFTextDataModule().setup(cfg, None)
+
+    def test_empty_val_split_gives_none(self, tmp_path, monkeypatch):
+        self._patch_load(monkeypatch, ["ab"])  # 2 tokens -> 0 windows
+        cfg = _hf_cfg(tmp_path)
+        dm = HFTextDataModule()
+        dm.setup(cfg, _ToyTokenizer())
+        assert dm.val_dataset() is None

@@ -1,0 +1,135 @@
+"""GPT adapter tests (parity with reference tests/test_gpt_adapter.py):
+loss vs a hand-rolled reference computation, tokenizer-derived vocab sizing,
+batch validation errors."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.models.gpt import GPTAdapter
+
+CFG = {
+    "run": {"name": "t"},
+    "model": {
+        "name": "gpt",
+        "block_size": 8,
+        "d_model": 32,
+        "n_layers": 1,
+        "n_heads": 4,
+        "d_ff": 64,
+        "dropout": 0.0,
+        "vocab_size": 50,
+    },
+    "data": {"name": "dummy_text"},
+    "trainer": {"max_steps": 5, "warmup_steps": 0},
+}
+
+
+def _build():
+    cfg = RunConfig.model_validate(CFG)
+    adapter = GPTAdapter()
+    model = adapter.build_model(cfg)
+    params = adapter.init_params(model, cfg, jax.random.key(0))
+    return cfg, adapter, model, params
+
+
+def _batch(B=2, T=8, vocab=50, with_mask=True, seed=0):
+    rng = np.random.default_rng(seed)
+    input_ids = rng.integers(0, vocab, (B, T)).astype(np.int32)
+    labels = rng.integers(0, vocab, (B, T)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(input_ids), "labels": jnp.asarray(labels)}
+    if with_mask:
+        mask = np.ones((B, T), dtype=np.int32)
+        mask[-1, T // 2 :] = 0
+        batch["attention_mask"] = jnp.asarray(mask)
+    return batch
+
+
+def test_loss_matches_handrolled_cross_entropy():
+    _, adapter, model, params = _build()
+    batch = _batch()
+    loss, metrics = adapter.compute_loss(model, params, batch)
+
+    logits = np.asarray(
+        model.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=True,
+        ),
+        dtype=np.float64,
+    )
+    # Hand-rolled masked CE.
+    shifted = logits - logits.max(axis=-1, keepdims=True)
+    log_probs = shifted - np.log(np.exp(shifted).sum(axis=-1, keepdims=True))
+    labels = np.asarray(batch["labels"])
+    per_token = -np.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    mask = np.asarray(batch["attention_mask"], dtype=np.float64)
+    expected = (per_token * mask).sum() / mask.sum()
+
+    assert float(loss) == pytest.approx(expected, rel=1e-5)
+    assert float(metrics["loss"]) == pytest.approx(float(loss))
+
+
+def test_loss_without_mask_is_plain_mean():
+    _, adapter, model, params = _build()
+    batch = _batch(with_mask=False)
+    loss, _ = adapter.compute_loss(model, params, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_vocab_size_from_tokenizer(monkeypatch):
+    class _FakeTok:
+        n_vocab = 61
+
+    adapter = GPTAdapter()
+    monkeypatch.setattr(adapter, "build_tokenizer", lambda cfg: _FakeTok())
+    cfg_dict = {**CFG, "model": {**CFG["model"], "vocab_size": None}}
+    cfg = RunConfig.model_validate(cfg_dict)
+    model = adapter.build_model(cfg)
+    assert model.vocab_size == 61
+
+
+def test_bad_tokenizer_vocab_raises(monkeypatch):
+    class _BadTok:
+        n_vocab = 0
+
+    adapter = GPTAdapter()
+    monkeypatch.setattr(adapter, "build_tokenizer", lambda cfg: _BadTok())
+    cfg_dict = {**CFG, "model": {**CFG["model"], "vocab_size": None}}
+    cfg = RunConfig.model_validate(cfg_dict)
+    with pytest.raises(ValueError, match="n_vocab"):
+        adapter.build_model(cfg)
+
+
+def test_shape_validation():
+    _, adapter, model, params = _build()
+    bad = {
+        "input_ids": jnp.zeros((2, 8), jnp.int32),
+        "labels": jnp.zeros((2, 7), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="same shape"):
+        adapter.compute_loss(model, params, bad)
+
+    bad2 = {
+        "input_ids": jnp.zeros((8,), jnp.int32),
+        "labels": jnp.zeros((8,), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="2D"):
+        adapter.compute_loss(model, params, bad2)
+
+    bad3 = {
+        "input_ids": jnp.zeros((2, 1), jnp.int32),
+        "labels": jnp.zeros((2, 1), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="length >= 2"):
+        adapter.compute_loss(model, params, bad3)
+
+    bad4 = {
+        "input_ids": jnp.zeros((2, 8), jnp.float32),
+        "labels": jnp.zeros((2, 8), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="integer"):
+        adapter.compute_loss(model, params, bad4)

@@ -1,0 +1,145 @@
+"""GPT model correctness tests (parity with reference tests/test_gpt_model.py).
+
+Includes the flagship causality-invariance test: perturbing tokens after
+position t must leave logits at positions <= t unchanged (reference
+test_gpt_model.py:144-175).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmtrain_tpu.models.gpt import GPT
+
+VOCAB = 97
+BLOCK = 16
+
+
+def _tiny_gpt(**overrides):
+    kwargs = dict(
+        vocab_size=VOCAB,
+        block_size=BLOCK,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        d_ff=64,
+        dropout=0.0,
+        tie_embeddings=True,
+    )
+    kwargs.update(overrides)
+    return GPT(**kwargs)
+
+
+def _init(model, batch=2, seqlen=BLOCK, seed=0):
+    tokens = jnp.zeros((batch, seqlen), dtype=jnp.int32)
+    return model.init({"params": jax.random.key(seed)}, tokens, deterministic=True)["params"]
+
+
+def test_forward_shape():
+    model = _tiny_gpt()
+    params = _init(model)
+    tokens = jax.random.randint(jax.random.key(1), (3, 10), 0, VOCAB)
+    logits = model.apply({"params": params}, tokens, deterministic=True)
+    assert logits.shape == (3, 10, VOCAB)
+
+
+def test_block_size_overflow_raises():
+    model = _tiny_gpt()
+    params = _init(model)
+    tokens = jnp.zeros((1, BLOCK + 1), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="exceeds block size"):
+        model.apply({"params": params}, tokens, deterministic=True)
+
+
+def test_weight_tying_removes_lm_head():
+    tied = _tiny_gpt(tie_embeddings=True)
+    untied = _tiny_gpt(tie_embeddings=False)
+    tied_params = _init(tied)
+    untied_params = _init(untied)
+    assert "lm_head" not in tied_params
+    assert "lm_head" in untied_params
+    tied_count = sum(x.size for x in jax.tree.leaves(tied_params))
+    untied_count = sum(x.size for x in jax.tree.leaves(untied_params))
+    assert untied_count == tied_count + 32 * VOCAB
+
+
+def test_causality_invariance():
+    """Perturb tokens after position t; logits up to t must be unchanged."""
+    model = _tiny_gpt()
+    params = _init(model)
+    key = jax.random.key(7)
+    tokens = jax.random.randint(key, (2, BLOCK), 0, VOCAB)
+    t = 9
+    perturbed = tokens.at[:, t + 1 :].set((tokens[:, t + 1 :] + 13) % VOCAB)
+
+    logits_a = model.apply({"params": params}, tokens, deterministic=True)
+    logits_b = model.apply({"params": params}, perturbed, deterministic=True)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_a[:, : t + 1]), np.asarray(logits_b[:, : t + 1]), atol=1e-6
+    )
+    assert not np.allclose(np.asarray(logits_a[:, t + 1 :]), np.asarray(logits_b[:, t + 1 :]))
+
+
+def test_padding_mask_zeroes_padded_rows_and_blocks_keys():
+    model = _tiny_gpt()
+    params = _init(model)
+    tokens = jax.random.randint(jax.random.key(3), (1, 8), 0, VOCAB)
+    mask = jnp.array([[1, 1, 1, 1, 1, 0, 0, 0]], dtype=jnp.int32)
+
+    logits_masked = model.apply({"params": params}, tokens, attention_mask=mask)
+    # Changing tokens in the padded region must not change unpadded logits.
+    perturbed = tokens.at[:, 5:].set((tokens[:, 5:] + 1) % VOCAB)
+    logits_masked2 = model.apply({"params": params}, perturbed, attention_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(logits_masked[:, :5]), np.asarray(logits_masked2[:, :5]), atol=1e-6
+    )
+
+
+def test_gradient_flow():
+    model = _tiny_gpt()
+    params = _init(model)
+    tokens = jax.random.randint(jax.random.key(5), (2, BLOCK), 0, VOCAB)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, tokens, deterministic=True)
+        return jnp.mean(logits**2)
+
+    grads = jax.grad(loss_fn)(params)
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(norms) > 0.0
+
+
+def test_dropout_rng_changes_output():
+    model = _tiny_gpt(dropout=0.5)
+    params = _init(model)
+    tokens = jax.random.randint(jax.random.key(2), (2, 8), 0, VOCAB)
+    out1 = model.apply(
+        {"params": params}, tokens, deterministic=False, rngs={"dropout": jax.random.key(1)}
+    )
+    out2 = model.apply(
+        {"params": params}, tokens, deterministic=False, rngs={"dropout": jax.random.key(2)}
+    )
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_bfloat16_compute_dtype():
+    model = _tiny_gpt(dtype=jnp.bfloat16)
+    params = _init(model)
+    # Master params stay f32; activations/logits come out bf16.
+    assert all(x.dtype == jnp.float32 for x in jax.tree.leaves(params))
+    tokens = jnp.zeros((1, 4), dtype=jnp.int32)
+    logits = model.apply({"params": params}, tokens, deterministic=True)
+    assert logits.dtype == jnp.bfloat16
+
+
+def test_remat_matches_no_remat():
+    base = _tiny_gpt(remat=False)
+    rem = _tiny_gpt(remat=True)
+    params = _init(base)
+    tokens = jax.random.randint(jax.random.key(11), (2, BLOCK), 0, VOCAB)
+    out_a = base.apply({"params": params}, tokens, deterministic=True)
+    out_b = rem.apply({"params": params}, tokens, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)

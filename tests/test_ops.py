@@ -1,0 +1,150 @@
+"""Attention ops: blockwise + pallas (interpret mode) vs dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmtrain_tpu.models.gpt import GPT, dense_attention
+from llmtrain_tpu.ops.blockwise_attention import blockwise_attention
+from llmtrain_tpu.ops.flash_attention import flash_attention
+from llmtrain_tpu.ops.pallas_attention import pallas_flash_attention
+
+
+def _qkv(b=2, t=32, h=2, d=8, seed=0):
+    keys = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, dtype=jnp.float32) for k in keys)
+
+
+def _dense_ref(q, k, v, causal=True):
+    return dense_attention(q, k, v, attention_mask=None)
+
+
+class TestBlockwise:
+    def test_matches_dense(self):
+        q, k, v = _qkv()
+        out = blockwise_attention(q, k, v, causal=True, q_chunk=8, kv_chunk=8)
+        ref = _dense_ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_single_chunk_matches(self):
+        q, k, v = _qkv(t=16)
+        out = blockwise_attention(q, k, v, causal=True, q_chunk=16, kv_chunk=16)
+        ref = _dense_ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_non_causal(self):
+        q, k, v = _qkv(t=16)
+        out = blockwise_attention(q, k, v, causal=False, q_chunk=4, kv_chunk=4)
+        import math
+
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gradients_match_dense(self):
+        q, k, v = _qkv(t=16)
+
+        def loss_block(q, k, v):
+            return blockwise_attention(q, k, v, causal=True, q_chunk=4, kv_chunk=4).sum()
+
+        def loss_dense(q, k, v):
+            return _dense_ref(q, k, v).sum()
+
+        g_block = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gb, gd in zip(g_block, g_dense):
+            np.testing.assert_allclose(np.asarray(gb), np.asarray(gd), atol=1e-4)
+
+    def test_kv_offset_for_ring(self):
+        """Chunked causal mask with offsets == global causal attention."""
+        q, k, v = _qkv(t=16)
+        full = blockwise_attention(q, k, v, causal=True, q_chunk=16, kv_chunk=16)
+        # Query block [8:16] attending to keys [0:16] with the right offsets.
+        out = blockwise_attention(
+            q[:, 8:], k, v, causal=True, q_chunk=8, kv_chunk=8, q_offset=8, kv_offset=0
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full[:, 8:]), atol=1e-5)
+
+
+class TestPallasInterpret:
+    def test_matches_dense(self):
+        q, k, v = _qkv(t=32)
+        out = pallas_flash_attention(q, k, v, block_q=8, block_k=8, interpret=True)
+        ref = _dense_ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_bf16(self):
+        q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(t=16))
+        out = pallas_flash_attention(q, k, v, block_q=8, block_k=8, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = _dense_ref(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32), atol=2e-2
+        )
+
+    def test_ragged_seq_raises(self):
+        q, k, v = _qkv(t=24)
+        with pytest.raises(ValueError, match="divisible"):
+            pallas_flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+
+
+class TestFlashDispatch:
+    def test_cpu_dispatch_and_grads(self):
+        q, k, v = _qkv(t=16)
+        out = flash_attention(q, k, v)
+        ref = _dense_ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+        g = jax.grad(lambda q: flash_attention(q, k, v).sum())(q)
+        g_ref = jax.grad(lambda q: _dense_ref(q, k, v).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+    def test_mask_rejected(self):
+        q, k, v = _qkv(t=16)
+        with pytest.raises(ValueError, match="padding"):
+            flash_attention(q, k, v, attention_mask=jnp.ones((2, 16)))
+
+
+class TestGPTIntegration:
+    def test_flash_gpt_matches_dense_gpt(self):
+        kwargs = dict(
+            vocab_size=64,
+            block_size=16,
+            d_model=32,
+            n_layers=1,
+            n_heads=4,
+            d_ff=64,
+            dropout=0.0,
+        )
+        dense = GPT(**kwargs, attention="dense")
+        flash = GPT(**kwargs, attention="flash")
+        tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, 64)
+        params = dense.init({"params": jax.random.key(1)}, tokens, deterministic=True)["params"]
+        out_d = dense.apply({"params": params}, tokens, deterministic=True)
+        out_f = flash.apply({"params": params}, tokens, deterministic=True)
+        np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f), atol=1e-5)
+
+    def test_remat_with_dropout_trains(self):
+        """Regression: remat + dropout>0 must trace (static deterministic)."""
+        model = GPT(
+            vocab_size=32,
+            block_size=8,
+            d_model=16,
+            n_layers=1,
+            n_heads=2,
+            d_ff=32,
+            dropout=0.1,
+            remat=True,
+        )
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        params = model.init({"params": jax.random.key(0)}, tokens, deterministic=True)["params"]
+        out = model.apply(
+            {"params": params},
+            tokens,
+            deterministic=False,
+            rngs={"dropout": jax.random.key(1)},
+        )
+        assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,66 @@
+"""Registry tests (parity with reference tests/test_registry.py)."""
+
+import pytest
+
+from llmtrain_tpu.registry import (
+    RegistryError,
+    available_data_modules,
+    available_model_adapters,
+    get_data_module,
+    get_model_adapter,
+    initialize_registries,
+    register_data_module,
+    register_model,
+)
+
+
+def test_initialize_registers_builtins():
+    initialize_registries()
+    assert "gpt" in available_model_adapters()
+    assert "dummy_gpt" in available_model_adapters()
+    assert "hf_text" in available_data_modules()
+    assert "dummy_text" in available_data_modules()
+
+
+def test_initialize_is_idempotent():
+    initialize_registries()
+    before = available_model_adapters()
+    initialize_registries()
+    assert available_model_adapters() == before
+
+
+def test_duplicate_model_registration_raises():
+    initialize_registries()
+    with pytest.raises(RegistryError, match="already registered"):
+
+        @register_model("gpt")
+        class Dup:  # pragma: no cover - registration fails before use
+            pass
+
+
+def test_duplicate_data_registration_raises():
+    initialize_registries()
+    with pytest.raises(RegistryError, match="already registered"):
+
+        @register_data_module("dummy_text")
+        class Dup:  # pragma: no cover
+            pass
+
+
+def test_unknown_model_lists_available():
+    initialize_registries()
+    with pytest.raises(RegistryError, match="gpt"):
+        get_model_adapter("nope")
+
+
+def test_unknown_data_lists_available():
+    initialize_registries()
+    with pytest.raises(RegistryError, match="dummy_text"):
+        get_data_module("nope")
+
+
+def test_lookup_returns_class():
+    initialize_registries()
+    adapter_cls = get_model_adapter("dummy_gpt")
+    adapter = adapter_cls()
+    assert hasattr(adapter, "build_model")
