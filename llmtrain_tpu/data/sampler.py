@@ -36,23 +36,28 @@ class DeterministicSampler:
     shuffle: bool = True
 
     def __post_init__(self) -> None:
-        if self.num_examples < self.batch_size:
-            raise ValueError(
-                f"dataset has {self.num_examples} examples but the global "
-                f"micro-batch needs {self.batch_size}"
-            )
+        if self.num_examples < 1:
+            raise ValueError("dataset has no examples")
 
     @property
     def batches_per_epoch(self) -> int:
-        return self.num_examples // self.batch_size
+        return max(1, self.num_examples // self.batch_size)
 
     def batch_indices(self, batch_index: int) -> np.ndarray:
-        """Example indices of global micro-batch ``batch_index`` (0-based)."""
+        """Example indices of global micro-batch ``batch_index`` (0-based).
+
+        Datasets smaller than one global micro-batch (tiny smoke datasets ×
+        wide data-parallel meshes) wrap deterministically: the epoch
+        permutation is tiled until the batch is full.
+        """
         epoch, pos = divmod(batch_index, self.batches_per_epoch)
         if self.shuffle:
             perm = _epoch_permutation(self.num_examples, self.seed, epoch)
         else:
             perm = np.arange(self.num_examples)
+        if self.num_examples < self.batch_size:
+            reps = -(-self.batch_size // self.num_examples)
+            perm = np.tile(perm, reps)
         return perm[pos * self.batch_size : (pos + 1) * self.batch_size]
 
     def shard_indices(self, batch_index: int, shard: int, num_shards: int) -> np.ndarray:

@@ -47,7 +47,6 @@ class ModelAdapter(ABC):
         variables = model.init({"params": rng}, tokens, deterministic=True)
         return variables["params"]
 
-    @abstractmethod
     def compute_loss(
         self,
         model: nn.Module,
@@ -57,7 +56,43 @@ class ModelAdapter(ABC):
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
     ) -> tuple[jax.Array, Metrics]:
-        """Pure loss function: ``(scalar loss, metrics dict of JAX scalars)``."""
+        """Pure loss function: ``(scalar loss, metrics dict of JAX scalars)``.
+
+        Default derives the scalar from ``compute_loss_components`` (one
+        forward, token-weighted mean). Adapters implement at least one of
+        the two methods.
+        """
+        comps = self.compute_loss_components(
+            model, params, batch, rngs=rngs, deterministic=deterministic
+        )
+        if comps is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} must implement compute_loss or "
+                "compute_loss_components"
+            )
+        loss_sum, tokens = comps
+        loss = jnp.sum(loss_sum) / jnp.maximum(jnp.sum(tokens), 1.0)
+        return loss, {"loss": loss}
+
+    def compute_loss_components(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, jax.Array] | None:
+        """Optional per-example ``(loss_sum, token_count)`` arrays of shape (B,).
+
+        When an adapter implements this, the trainer derives the scalar loss
+        as ``sum(loss_sum)/sum(token_count)`` and gets exact per-data-shard
+        metrics (the ``*_rank_{r}`` keys, reference trainer.py:428-482) and
+        token-weighted eval (reference trainer.py:243-289) from one forward.
+        Returning None makes the trainer fall back to ``compute_loss``.
+        """
+        del model, params, batch, rngs, deterministic
+        return None
 
 
 def validate_lm_batch(batch: Batch) -> tuple[jax.Array, jax.Array, jax.Array | None]:
@@ -105,6 +140,26 @@ def validate_lm_batch(batch: Batch) -> tuple[jax.Array, jax.Array, jax.Array | N
     return input_ids, labels, attention_mask
 
 
+def lm_loss_components(
+    model: nn.Module,
+    params: Params,
+    batch: Batch,
+    *,
+    rngs: dict[str, jax.Array] | None = None,
+    deterministic: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Shared LM forward → per-example (loss_sum, token_count)."""
+    input_ids, labels, attention_mask = validate_lm_batch(batch)
+    logits = model.apply(
+        {"params": params},
+        input_ids,
+        attention_mask=attention_mask,
+        deterministic=deterministic,
+        rngs=rngs,
+    )
+    return masked_ce_components(logits, labels, attention_mask)
+
+
 def masked_cross_entropy(
     logits: jax.Array, labels: jax.Array, attention_mask: jax.Array | None
 ) -> jax.Array:
@@ -113,9 +168,18 @@ def masked_cross_entropy(
     Labels are already shifted by the data pipeline (reference hf_text.py:125),
     so no shift happens here.
     """
+    loss_sum, tokens = masked_ce_components(logits, labels, attention_mask)
+    return jnp.sum(loss_sum) / jnp.maximum(jnp.sum(tokens), 1.0)
+
+
+def masked_ce_components(
+    logits: jax.Array, labels: jax.Array, attention_mask: jax.Array | None
+) -> tuple[jax.Array, jax.Array]:
+    """Per-example ``(loss_sum, token_count)`` of shape (B,), CE in float32."""
     log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     per_token = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
     if attention_mask is None:
-        return per_token.mean()
-    mask = attention_mask.astype(jnp.float32)
-    return jnp.sum(per_token * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        mask = jnp.ones_like(per_token)
+    else:
+        mask = attention_mask.astype(jnp.float32)
+    return jnp.sum(per_token * mask, axis=-1), jnp.sum(mask, axis=-1)

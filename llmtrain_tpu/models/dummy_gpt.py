@@ -18,7 +18,13 @@ from flax import linen as nn
 
 from ..config.schemas import RunConfig
 from ..registry.models import register_model
-from .base import Batch, Metrics, ModelAdapter, Params, masked_cross_entropy, validate_lm_batch
+from .base import (
+    Batch,
+    Metrics,
+    ModelAdapter,
+    Params,
+    lm_loss_components,
+)
 
 
 class _TinyLM(nn.Module):
@@ -55,7 +61,7 @@ class DummyGPTAdapter(ModelAdapter):
         del cfg
         return None
 
-    def compute_loss(
+    def compute_loss_components(
         self,
         model: nn.Module,
         params: Params,
@@ -63,17 +69,10 @@ class DummyGPTAdapter(ModelAdapter):
         *,
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
-    ) -> tuple[jax.Array, Metrics]:
-        input_ids, labels, attention_mask = validate_lm_batch(batch)
-        logits = model.apply(
-            {"params": params},
-            input_ids,
-            attention_mask=attention_mask,
-            deterministic=deterministic,
-            rngs=rngs,
+    ) -> tuple[jax.Array, jax.Array]:
+        return lm_loss_components(
+            model, params, batch, rngs=rngs, deterministic=deterministic
         )
-        loss = masked_cross_entropy(logits, labels, attention_mask)
-        return loss, {"loss": loss}
 
 
 __all__ = ["DummyGPTAdapter", "_TinyLM"]

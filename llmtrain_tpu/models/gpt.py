@@ -34,7 +34,13 @@ from flax import linen as nn
 
 from ..config.schemas import RunConfig
 from ..registry.models import register_model
-from .base import Batch, Metrics, ModelAdapter, Params, masked_cross_entropy, validate_lm_batch
+from .base import (
+    Batch,
+    Metrics,
+    ModelAdapter,
+    Params,
+    lm_loss_components,
+)
 
 _EMBED_INIT = nn.initializers.normal(stddev=0.02)
 _DENSE_INIT = nn.initializers.normal(stddev=0.02)
@@ -76,9 +82,9 @@ class CausalSelfAttention(nn.Module):
             name="qkv_proj",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        q = nn.with_logical_constraint(q, ("batch", "length", "heads", "kv"))
-        k = nn.with_logical_constraint(k, ("batch", "length", "heads", "kv"))
-        v = nn.with_logical_constraint(v, ("batch", "length", "heads", "kv"))
+        q = nn.with_logical_constraint(q, ("batch", "length", "act_heads", "act_kv"))
+        k = nn.with_logical_constraint(k, ("batch", "length", "act_heads", "act_kv"))
+        v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
 
         if self.attention == "flash":
             # Flash mode is the packed-sequence fast path: padding masks are
@@ -201,7 +207,7 @@ class TransformerBlock(nn.Module):
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("mlp",)),
             name="mlp_fc",
         )(h)
-        h = nn.with_logical_constraint(h, ("batch", "length", "mlp"))
+        h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
         h = nn.gelu(h, approximate=False)
         h = nn.Dense(
             self.d_model,
@@ -213,7 +219,7 @@ class TransformerBlock(nn.Module):
         )(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
-        return nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
 
 class GPT(nn.Module):
@@ -266,7 +272,7 @@ class GPT(nn.Module):
         positions = jnp.arange(seqlen)[None, :]
         x = token_embedding(input_ids) + position_embedding(positions)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
-        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
         block_cls = TransformerBlock
         if self.remat:
@@ -306,7 +312,7 @@ class GPT(nn.Module):
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "vocab")),
                 name="lm_head",
             )(x)
-        return nn.with_logical_constraint(logits, ("batch", "length", "vocab"))
+        return nn.with_logical_constraint(logits, ("batch", "length", "act_vocab"))
 
 
 @register_model("gpt")
@@ -347,7 +353,7 @@ class GPTAdapter(ModelAdapter):
 
         return tiktoken.get_encoding("gpt2")
 
-    def compute_loss(
+    def compute_loss_components(
         self,
         model: nn.Module,
         params: Params,
@@ -355,17 +361,10 @@ class GPTAdapter(ModelAdapter):
         *,
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
-    ) -> tuple[jax.Array, Metrics]:
-        input_ids, labels, attention_mask = validate_lm_batch(batch)
-        logits = model.apply(
-            {"params": params},
-            input_ids,
-            attention_mask=attention_mask,
-            deterministic=deterministic,
-            rngs=rngs,
+    ) -> tuple[jax.Array, jax.Array]:
+        return lm_loss_components(
+            model, params, batch, rngs=rngs, deterministic=deterministic
         )
-        loss = masked_cross_entropy(logits, labels, attention_mask)
-        return loss, {"loss": loss}
 
 
 __all__ = ["GPT", "TransformerBlock", "CausalSelfAttention", "GPTAdapter", "dense_attention"]

@@ -1,0 +1,21 @@
+"""Parallelism: logical-axis sharding rules and mesh-aware helpers.
+
+This package is the TPU-native replacement for the reference's DDP wrapper
+(reference trainer.py:84-91) and DistributedSampler wiring: parallelism is a
+*property of shardings* on the jit-compiled train step, not code. XLA inserts
+the collectives (psum/all-gather/reduce-scatter) implied by the shardings.
+"""
+
+from .sharding import (
+    DEFAULT_LOGICAL_AXIS_RULES,
+    batch_sharding,
+    data_parallel_degree,
+    state_shardings,
+)
+
+__all__ = [
+    "DEFAULT_LOGICAL_AXIS_RULES",
+    "batch_sharding",
+    "data_parallel_degree",
+    "state_shardings",
+]

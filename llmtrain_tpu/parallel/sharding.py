@@ -1,0 +1,95 @@
+"""Logical-axis → mesh-axis rules and sharding computation.
+
+Model code annotates parameters and activations with *logical* names
+(``vocab``/``embed``/``heads``/``mlp`` for params, ``batch``/``length``/
+``act_*`` for activations — see models/gpt.py). This module maps them onto
+the physical mesh axes (data/fsdp/tensor/sequence/pipeline/expert):
+
+* pure data parallel: every param rule lands on a size-1 axis → replicated
+  params, batch sharded over (data, fsdp). Gradient sync is the psum XLA
+  inserts for the replicated-param gradient — the moral equivalent of DDP's
+  all-reduce hook (reference trainer.py:88-91), but fused into the step.
+* FSDP: param ``embed`` axes shard over ``fsdp``; XLA all-gathers just-in-time.
+* Tensor parallel: ``heads``/``mlp``/``vocab`` shard over ``tensor`` —
+  Megatron-style column/row splits fall out of the einsum shardings.
+* Sequence parallel: activation ``length`` shards over ``sequence``
+  (ring attention in ops/ring_attention.py extends this to attention itself).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# fmt: off
+DEFAULT_LOGICAL_AXIS_RULES = (
+    # activations
+    ("batch", ("data", "fsdp")),
+    ("length", "sequence"),
+    ("act_embed", None),
+    ("act_mlp", "tensor"),
+    ("act_heads", "tensor"),
+    ("act_kv", None),
+    ("act_vocab", "tensor"),
+    # params
+    ("vocab", "tensor"),
+    ("embed", "fsdp"),
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("qkv", None),
+    ("position", None),
+)
+# fmt: on
+
+
+def data_parallel_degree(mesh: Mesh) -> int:
+    """Number of batch shards = product of the axes 'batch' maps onto."""
+    return mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def batch_sharding(mesh: Mesh, *, with_accum_dim: bool = False) -> NamedSharding:
+    """Sharding for (accum, B, T) or (B, T) token batches."""
+    if with_accum_dim:
+        return NamedSharding(mesh, P(None, ("data", "fsdp"), "sequence"))
+    return NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
+
+
+def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_RULES):
+    """NamedShardings for a pytree whose leaves may carry logical metadata.
+
+    Leaves without metadata (e.g. the dummy model, optimizer scalars) get
+    fully-replicated shardings.
+    """
+    logical_spec = nn.get_partition_spec(abstract_tree)
+    return nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def make_global_batch(
+    mesh: Mesh,
+    batch_parts: dict[str, Any],
+    *,
+    with_accum_dim: bool = False,
+    fetch,
+):
+    """Assemble a global device array from host data via per-shard callbacks.
+
+    ``fetch(key, index)`` must return the numpy block for ``index`` (a tuple
+    of slices into the global shape). Using ``make_array_from_callback``
+    keeps this correct for ANY device order / process layout — each process
+    materializes exactly its addressable shards.
+    """
+    sharding = batch_sharding(mesh, with_accum_dim=with_accum_dim)
+    out = {}
+    for key, global_shape in batch_parts.items():
+        out[key] = jax.make_array_from_callback(
+            tuple(global_shape), sharding, lambda index, k=key: fetch(k, index)
+        )
+    return out

@@ -1,0 +1,6 @@
+"""Experiment tracking: Tracker protocol, MLflow and Null implementations."""
+
+from .base import NullTracker, Tracker
+from .mlflow import MLflowTracker
+
+__all__ = ["MLflowTracker", "NullTracker", "Tracker"]

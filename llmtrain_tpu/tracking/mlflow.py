@@ -1,0 +1,82 @@
+"""MLflow tracker.
+
+Parity target: reference ``src/llmtrain/tracking/mlflow.py`` — lazy mlflow
+import raising a clear RuntimeError when the extra is missing (:45-51),
+set_tracking_uri/set_experiment/start_run (:54-61), nested-param flattening
+to dot keys with JSON-encoded lists (:11-29).
+
+Intentional divergence: the reference's join-an-existing-mlflow-run path is
+not implemented — in this framework exactly one process (rank 0) ever gets a
+real tracker (non-main ranks get NullTracker, see cli.py), so every tracked
+run is fresh and the framework run id is recorded as a tag.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def _flatten_params(params: dict[str, Any], prefix: str = "") -> dict[str, Any]:
+    flat: dict[str, Any] = {}
+    for key, value in params.items():
+        full = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            flat.update(_flatten_params(value, full))
+        elif isinstance(value, (list, tuple)):
+            flat[full] = json.dumps(list(value))
+        else:
+            flat[full] = value
+    return flat
+
+
+class MLflowTracker:
+    def __init__(
+        self,
+        tracking_uri: str,
+        experiment: str,
+        *,
+        run_name: str | None = None,
+    ) -> None:
+        self._tracking_uri = tracking_uri
+        self._experiment = experiment
+        self._run_name = run_name
+        self._mlflow = None
+        self._active = False
+
+    def _require_mlflow(self):
+        if self._mlflow is None:
+            try:
+                import mlflow
+            except ImportError as exc:
+                raise RuntimeError(
+                    "mlflow is not installed; install the [mlflow] extra or set "
+                    "mlflow.enabled: false"
+                ) from exc
+            self._mlflow = mlflow
+        return self._mlflow
+
+    def start_run(self, run_id: str, run_name: str | None = None) -> None:
+        mlflow = self._require_mlflow()
+        mlflow.set_tracking_uri(self._tracking_uri)
+        mlflow.set_experiment(self._experiment)
+        mlflow.start_run(run_name=run_name or self._run_name or run_id)
+        mlflow.set_tag("llmtrain.run_id", run_id)
+        self._active = True
+
+    def log_params(self, params: dict[str, Any]) -> None:
+        if self._active:
+            self._require_mlflow().log_params(_flatten_params(params))
+
+    def log_metrics(self, metrics: dict[str, float], step: int | None = None) -> None:
+        if self._active:
+            self._require_mlflow().log_metrics(metrics, step=step)
+
+    def log_artifact(self, local_path: str, artifact_path: str | None = None) -> None:
+        if self._active:
+            self._require_mlflow().log_artifact(local_path, artifact_path=artifact_path)
+
+    def end_run(self, status: str = "FINISHED") -> None:
+        if self._active:
+            self._require_mlflow().end_run(status=status)
+            self._active = False

@@ -1,0 +1,24 @@
+"""Training runtime: Trainer, jit train step, checkpoints, dry run."""
+
+from .checkpoint import CheckpointError, CheckpointManager, resolve_resume_path
+from .dry_run import DEFAULT_DRY_RUN_STEPS, DryRunResult, run_dry_run
+from .optimizer import build_optimizer, lr_schedule
+from .train_step import TrainState, create_train_state, make_eval_step, make_train_step
+from .trainer import Trainer, TrainResult
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointManager",
+    "DEFAULT_DRY_RUN_STEPS",
+    "DryRunResult",
+    "TrainResult",
+    "TrainState",
+    "Trainer",
+    "build_optimizer",
+    "create_train_state",
+    "lr_schedule",
+    "make_eval_step",
+    "make_train_step",
+    "resolve_resume_path",
+    "run_dry_run",
+]

@@ -1,0 +1,78 @@
+"""Forward-only dry run.
+
+Parity target: reference ``src/llmtrain/training/dry_run.py`` — build
+adapter/module, run min(5, max_steps) forward-only batches, log per-step
+loss + wall ms, return resolved plugin names and steps executed (:15-73).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..config.schemas import RunConfig
+from ..data.sampler import DeterministicSampler
+from ..registry import get_data_module, get_model_adapter
+from ..training.train_step import make_eval_step
+from ..utils.logging import get_logger
+
+DEFAULT_DRY_RUN_STEPS = 5
+
+logger = get_logger()
+
+
+@dataclass(frozen=True)
+class DryRunResult:
+    model_adapter: str
+    data_module: str
+    steps_executed: int
+
+
+def run_dry_run(cfg: RunConfig) -> DryRunResult:
+    """Run a few forward-only batches on the default device (no mesh)."""
+    adapter = get_model_adapter(cfg.model.name)()
+    data_module = get_data_module(cfg.data.name)()
+
+    tokenizer = None
+    try:
+        tokenizer = adapter.build_tokenizer(cfg)
+    except Exception as exc:
+        logger.warning("build_tokenizer failed (%s); continuing without one", exc)
+    data_module.setup(cfg, tokenizer)
+    model = adapter.build_model(cfg)
+    params = adapter.init_params(model, cfg, jax.random.key(cfg.run.seed))
+
+    from flax.linen import meta as nn_meta
+
+    params = nn_meta.unbox(params)
+    eval_step = jax.jit(make_eval_step(adapter, model))
+
+    train_ds = data_module.train_dataset()
+    steps = min(DEFAULT_DRY_RUN_STEPS, cfg.trainer.max_steps)
+    batch_size = min(cfg.trainer.micro_batch_size, len(train_ds))
+    sampler = DeterministicSampler(
+        num_examples=len(train_ds),
+        batch_size=batch_size,
+        seed=cfg.run.seed,
+        shuffle=not cfg.run.deterministic,
+    )
+
+    import jax.numpy as jnp
+
+    for i in range(steps):
+        start = time.perf_counter()
+        host = train_ds.get_examples(sampler.batch_indices(i))
+        batch = {k: jnp.asarray(v) for k, v in host.items()}
+        loss_sum, tokens = eval_step(params, batch)
+        loss = float(np.sum(jax.device_get(loss_sum)) / max(np.sum(jax.device_get(tokens)), 1.0))
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        logger.info("dry_run step=%d/%d loss=%.4f time_ms=%.1f", i + 1, steps, loss, elapsed_ms)
+
+    return DryRunResult(
+        model_adapter=cfg.model.name,
+        data_module=cfg.data.name,
+        steps_executed=steps,
+    )

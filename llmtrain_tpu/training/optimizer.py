@@ -1,0 +1,57 @@
+"""Optimizer + LR schedule via optax.
+
+Parity target: reference trainer.py:93-121 — AdamW(lr, weight_decay) with
+grad clipping (trainer.py:390-393) and a LambdaLR doing linear warmup to
+``warmup_steps`` then cosine decay to 0 at ``max_steps``. The reference steps
+the scheduler *after* the optimizer, so optimizer step N (1-indexed) uses
+multiplier ``lr_lambda(N-1)`` — optax's 0-indexed update count reproduces
+this exactly.
+"""
+
+from __future__ import annotations
+
+import math
+
+import optax
+
+from ..config.schemas import TrainerConfig
+
+
+def lr_schedule(cfg: TrainerConfig) -> optax.Schedule:
+    """Linear warmup → cosine decay to 0, as a function of update count."""
+    warmup = cfg.warmup_steps
+    max_steps = cfg.max_steps
+    base_lr = cfg.lr
+
+    def schedule(count):
+        import jax.numpy as jnp
+
+        count = jnp.asarray(count, dtype=jnp.float32)
+        warm = count / warmup if warmup > 0 else jnp.ones_like(count)
+        if max_steps <= warmup:
+            decay = jnp.ones_like(count)
+        else:
+            progress = (count - warmup) / (max_steps - warmup)
+            decay = 0.5 * (1.0 + jnp.cos(math.pi * jnp.clip(progress, 0.0, 1.0)))
+        mult = jnp.where(count < warmup, warm, decay)
+        return base_lr * mult
+
+    return schedule
+
+
+def build_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
+    """clip-by-global-norm → AdamW with the warmup-cosine schedule.
+
+    AdamW hyperparams match torch defaults (betas 0.9/0.999, eps 1e-8) so the
+    optimizer trajectory is comparable to the reference.
+    """
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(
+            learning_rate=lr_schedule(cfg),
+            b1=0.9,
+            b2=0.999,
+            eps=1e-8,
+            weight_decay=cfg.weight_decay,
+        ),
+    )

@@ -1,0 +1,133 @@
+"""The jit-compiled training and eval steps.
+
+This replaces the reference's Python hot loop (reference trainer.py:361-534):
+forward, backward, gradient accumulation, clipping, AdamW, LR schedule and
+gradient synchronization are ONE traced XLA program per optimizer step.
+
+* Gradient accumulation is a ``lax.scan`` over the leading micro-batch axis —
+  the analogue of the reference's ``no_sync()`` trick (trainer.py:376-384):
+  gradients accumulate in sharded registers and the cross-replica reduction
+  XLA inserts happens once per optimizer step, not per micro-batch.
+* Dropout RNG is ``fold_in(run_key, step, micro_idx)`` — stateless, so resume
+  reproduces the exact RNG stream without checkpointing generator state
+  (the reference must capture python/numpy/torch RNG states,
+  reference checkpoint.py:53-59).
+* Per-data-shard metrics come back as small (accum, B) arrays; the host
+  derives the reference's ``*_rank_{r}`` metric values from them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from ..models.base import ModelAdapter
+
+
+@struct.dataclass
+class TrainState:
+    """Pytree holding everything the step updates. ``step`` counts completed
+    optimizer steps (0 = fresh init); training step N uses LR multiplier
+    schedule(N-1), matching the reference's post-step LambdaLR."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def create_train_state(params: Any, tx: optax.GradientTransformation) -> TrainState:
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+
+
+def make_loss_fn(
+    adapter: ModelAdapter, model: Any, *, use_dropout: bool
+) -> Callable:
+    """Per-micro-batch loss: (params, batch, rng) -> (loss, (loss_sum_B, tokens_B))."""
+
+    def loss_fn(params, micro_batch, rng):
+        rngs = {"dropout": rng} if use_dropout else None
+        comps = adapter.compute_loss_components(
+            model, params, micro_batch, rngs=rngs, deterministic=not use_dropout
+        )
+        if comps is None:
+            loss, _ = adapter.compute_loss(
+                model, params, micro_batch, rngs=rngs, deterministic=not use_dropout
+            )
+            mask = micro_batch.get("attention_mask")
+            if mask is None:
+                tokens = jnp.full(
+                    (micro_batch["input_ids"].shape[0],),
+                    micro_batch["input_ids"].shape[1],
+                    jnp.float32,
+                )
+            else:
+                tokens = mask.astype(jnp.float32).sum(axis=-1)
+            # Fallback: distribute the scalar loss uniformly per token.
+            return loss, (loss * tokens, tokens)
+        loss_sum, tokens = comps
+        loss = jnp.sum(loss_sum) / jnp.maximum(jnp.sum(tokens), 1.0)
+        return loss, (loss_sum, tokens)
+
+    return loss_fn
+
+
+def make_train_step(
+    adapter: ModelAdapter,
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    grad_accum_steps: int,
+    use_dropout: bool,
+) -> Callable:
+    """Build the pure train step: (state, batch(A,B,T), run_key) -> (state, metrics)."""
+    loss_fn = make_loss_fn(adapter, model, use_dropout=use_dropout)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train_step(state: TrainState, batch: dict, run_key: jax.Array):
+        step_key = jax.random.fold_in(run_key, state.step)
+
+        def micro(grads_acc, xs):
+            micro_batch, idx = xs
+            rng = jax.random.fold_in(step_key, idx)
+            (loss, (loss_sum, tokens)), grads = grad_fn(state.params, micro_batch, rng)
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return grads_acc, (loss, loss_sum, tokens)
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        idxs = jnp.arange(grad_accum_steps)
+        grads_sum, (losses, loss_sums, token_counts) = jax.lax.scan(
+            micro, zeros, (batch, idxs)
+        )
+        grads = jax.tree.map(lambda g: g / grad_accum_steps, grads_sum)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        metrics = {
+            # mean over accum steps of per-micro-batch token-weighted means,
+            # matching reference step_loss (trainer.py:389).
+            "loss": jnp.mean(losses),
+            "grad_norm": optax.global_norm(grads),
+            "per_example_loss_sum": loss_sums,  # (A, B)
+            "per_example_tokens": token_counts,  # (A, B)
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(adapter: ModelAdapter, model: Any) -> Callable:
+    """Forward-only: (params, batch(B,T)) -> (loss_sum_B, tokens_B)."""
+    loss_fn = make_loss_fn(adapter, model, use_dropout=False)
+
+    def eval_step(params, batch):
+        _, (loss_sum, tokens) = loss_fn(params, batch, jax.random.key(0))
+        return loss_sum, tokens
+
+    return eval_step

@@ -1,0 +1,517 @@
+"""Step-based trainer over a jit-compiled train step on a device mesh.
+
+Parity target: reference ``src/llmtrain/training/trainer.py`` — 1-indexed
+step loop (:361), grad accumulation, interval metric accumulators with reset
+after each log (:355-359, :493-497), per-rank + global metric naming
+(:428-482), token-weighted eval (:243-289), rank-0-gated checkpointing at
+``save_every`` and the final step (:402-413), resume with config-mismatch
+warning (:315-318), ``TrainResult`` (:30-43).
+
+TPU architecture: instead of a DDP-wrapped model + collectives sprinkled
+through the loop, the Trainer builds ONE jit-compiled train step over a
+named mesh (see train_step.py) and feeds it globally-sharded batches built
+by ``jax.make_array_from_callback`` from the deterministic sampler. "Rank"
+in metric names means *data shard* (devices), a superset of the reference's
+process ranks. Host work per step is only: assemble batch indices, enqueue
+the step, and (at log boundaries) pull small scalars off device.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+from flax import linen as nn
+from flax.linen import meta as nn_meta
+
+from ..config.schemas import RunConfig
+from ..data.sampler import DeterministicSampler
+from ..distributed import DistState, build_mesh
+from ..parallel.sharding import (
+    DEFAULT_LOGICAL_AXIS_RULES,
+    batch_sharding,
+    data_parallel_degree,
+    state_shardings,
+)
+from ..registry import get_data_module, get_model_adapter
+from ..tracking.base import Tracker
+from ..utils.logging import get_logger
+from .checkpoint import CheckpointManager, resolve_resume_path
+from .optimizer import build_optimizer, lr_schedule
+from .train_step import TrainState, make_eval_step, make_train_step
+
+logger = get_logger()
+
+
+@dataclass(frozen=True)
+class TrainResult:
+    """Final outcome of a training run (reference trainer.py:30-43)."""
+
+    final_step: int
+    final_loss: float
+    final_val_loss: float | None
+    total_time: float
+    peak_memory: float
+    val_metrics: dict[str, float] | None
+    first_step_loss: float | None
+    resumed_from_step: int | None
+    parameter_count: int
+    trainable_parameter_count: int
+    total_tokens: int = 0
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: RunConfig,
+        run_dir: Path | None,
+        tracker: Tracker,
+        dist_state: DistState | None = None,
+    ) -> None:
+        self._cfg = cfg
+        self._run_dir = run_dir
+        self._tracker = tracker
+        self._dist_state = dist_state
+
+        self._dataset_specs: dict[int, tuple[tuple[str, ...], int]] = {}
+        self._adapter = get_model_adapter(cfg.model.name)()
+        self._data_module = get_data_module(cfg.data.name)()
+
+        tokenizer = None
+        try:
+            tokenizer = self._adapter.build_tokenizer(cfg)
+        except Exception as exc:  # offline environments: tokenizer optional
+            logger.warning("build_tokenizer failed (%s); continuing without one", exc)
+        self._data_module.setup(cfg, tokenizer)
+
+        self._model = self._adapter.build_model(cfg)
+
+        devices = jax.devices() if cfg.run.device == "tpu" else jax.devices("cpu")
+        self._mesh = build_mesh(cfg.distributed.mesh, devices)
+        self._rules = list(DEFAULT_LOGICAL_AXIS_RULES)
+        self._dp = data_parallel_degree(self._mesh)
+        self._global_micro = cfg.trainer.micro_batch_size * self._dp
+
+        self._tx = build_optimizer(cfg.trainer)
+        self._schedule = lr_schedule(cfg.trainer)
+
+        self._ckpt_mgr: CheckpointManager | None = None
+        if run_dir is not None:
+            keep_last_k = int(cfg.trainer.extra.get("keep_last_k", 3))
+            self._ckpt_mgr = CheckpointManager(
+                Path(run_dir) / "checkpoints", keep_last_k=keep_last_k
+            )
+
+        use_dropout = cfg.model.dropout > 0.0
+        self._train_step_fn = jax.jit(
+            make_train_step(
+                self._adapter,
+                self._model,
+                self._tx,
+                grad_accum_steps=cfg.trainer.grad_accum_steps,
+                use_dropout=use_dropout,
+            ),
+            donate_argnums=(0,),
+        )
+        self._eval_step_fn = jax.jit(make_eval_step(self._adapter, self._model))
+
+        with self._mesh, nn.logical_axis_rules(self._rules):
+            self._state = self._init_state()
+
+        params = nn_meta.unbox(self._state.params)
+        self._param_count = int(
+            sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        )
+
+    # ------------------------------------------------------------------ setup
+
+    def _init_state(self) -> TrainState:
+        """Initialize the sharded TrainState on the mesh.
+
+        Params keep their flax ``Partitioned`` metadata inside the state so
+        optimizer moments inherit the same logical specs; shardings are
+        computed from an ``eval_shape`` trace and applied via out_shardings.
+        """
+        cfg = self._cfg
+        init_rng = jax.random.key(cfg.run.seed)
+
+        def create(rng):
+            params = self._adapter.init_params(self._model, cfg, rng)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self._tx.init(params),
+            )
+
+        abstract = jax.eval_shape(create, init_rng)
+        shardings = state_shardings(self._mesh, abstract, self._rules)
+        self._state_shardings = shardings
+        return jax.jit(create, out_shardings=shardings)(init_rng)
+
+    @property
+    def _is_main(self) -> bool:
+        return self._dist_state is None or self._dist_state.is_main
+
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def parameter_count(self) -> int:
+        return self._param_count
+
+    # ------------------------------------------------------------------ data
+
+    def _global_batch(self, sampler: DeterministicSampler, dataset, step: int) -> dict:
+        """Assemble the (A, Bg, T) sharded global batch for optimizer step ``step``."""
+        accum = self._cfg.trainer.grad_accum_steps
+        base_index = (step - 1) * accum
+        keys, seqlen = self._dataset_spec(dataset)
+        sharding = batch_sharding(self._mesh, with_accum_dim=True)
+
+        # One dataset gather per (accum row, shard slice), shared across keys.
+        gather_cache: dict[tuple, dict[str, np.ndarray]] = {}
+
+        def fetch(key: str, index) -> np.ndarray:
+            a_sl, b_sl, t_sl = index
+            a_start = a_sl.start if a_sl.start is not None else 0
+            a_stop = a_sl.stop if a_sl.stop is not None else accum
+            rows = []
+            for a in range(a_start, a_stop):
+                cache_key = (a, b_sl.start, b_sl.stop)
+                if cache_key not in gather_cache:
+                    indices = sampler.batch_indices(base_index + a)[b_sl]
+                    gather_cache[cache_key] = dataset.get_examples(indices)
+                rows.append(gather_cache[cache_key][key][:, t_sl])
+            return np.stack(rows)
+
+        shape = (accum, self._global_micro, seqlen)
+        return {
+            key: jax.make_array_from_callback(shape, sharding, lambda i, k=key: fetch(k, i))
+            for key in keys
+        }
+
+    def _dataset_spec(self, dataset) -> tuple[tuple[str, ...], int]:
+        """Cached (batch keys, sequence length) of a dataset."""
+        cached = self._dataset_specs.get(id(dataset))
+        if cached is None:
+            probe = dataset.get_examples(np.asarray([0]))
+            cached = (tuple(probe), probe["input_ids"].shape[1])
+            self._dataset_specs[id(dataset)] = cached
+        return cached
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(
+        self, max_steps_override: int | None = None, resume_from: str | None = None
+    ) -> TrainResult:
+        cfg = self._cfg
+        max_steps = max_steps_override or cfg.trainer.max_steps
+        accum = cfg.trainer.grad_accum_steps
+        log_every = cfg.trainer.log_every_steps
+        eval_every = cfg.trainer.eval_every_steps
+        save_every = cfg.trainer.save_every_steps
+
+        train_ds = self._data_module.train_dataset()
+        sampler = DeterministicSampler(
+            num_examples=len(train_ds),
+            batch_size=self._global_micro,
+            seed=cfg.run.seed,
+            shuffle=not cfg.run.deterministic,
+        )
+
+        resumed_from_step: int | None = None
+        if resume_from is not None:
+            resumed_from_step = self._restore(resume_from)
+        start_step = (resumed_from_step or 0) + 1
+        if start_step > max_steps:
+            logger.warning(
+                "resume step %d >= max_steps %d; no training steps will run",
+                start_step - 1,
+                max_steps,
+            )
+
+        run_key = jax.random.key(cfg.run.seed)
+        tokens_per_step = accum * self._global_micro * self._probe_seqlen(train_ds)
+
+        self._tracker.log_params(yaml.safe_load(yaml.safe_dump(cfg.model_dump())))
+
+        first_step_loss: float | None = None
+        final_val_loss: float | None = None
+        final_val_metrics: dict[str, float] | None = None
+        step_loss_dev = None
+        total_tokens = (start_step - 1) * tokens_per_step
+
+        interval_losses: list[jax.Array] = []
+        interval_shard: list[tuple[jax.Array, jax.Array]] = []
+        interval_tokens = 0
+        interval_start = time.perf_counter()
+        start_time = time.perf_counter()
+
+        with self._mesh, nn.logical_axis_rules(self._rules):
+            for step in range(start_step, max_steps + 1):
+                batch = self._global_batch(sampler, train_ds, step)
+                self._state, metrics = self._train_step_fn(self._state, batch, run_key)
+
+                step_loss_dev = metrics["loss"]
+                interval_losses.append(metrics["loss"])
+                interval_shard.append(
+                    (metrics["per_example_loss_sum"], metrics["per_example_tokens"])
+                )
+                interval_tokens += tokens_per_step
+                total_tokens += tokens_per_step
+
+                if step == 1:
+                    first_step_loss = float(jax.device_get(metrics["loss"]))
+
+                if self._ckpt_mgr is not None and self._is_main and (
+                    step % save_every == 0 or step == max_steps
+                ):
+                    self._ckpt_mgr.save(step, self._state, cfg.model_dump())
+
+                if step % log_every == 0 or step == max_steps:
+                    interval_time = time.perf_counter() - interval_start
+                    self._log_train_interval(
+                        step=step,
+                        max_steps=max_steps,
+                        interval_losses=interval_losses,
+                        interval_shard=interval_shard,
+                        interval_tokens=interval_tokens,
+                        interval_time=interval_time,
+                        total_tokens=total_tokens,
+                    )
+                    interval_losses = []
+                    interval_shard = []
+                    interval_tokens = 0
+                    interval_start = time.perf_counter()
+
+                if step % eval_every == 0 or step == max_steps:
+                    val_metrics = self._evaluate(step, max_steps)
+                    if val_metrics:
+                        final_val_metrics = val_metrics
+                        final_val_loss = val_metrics.get("val/loss", final_val_loss)
+
+        total_time = time.perf_counter() - start_time
+        final_loss = float(jax.device_get(step_loss_dev)) if step_loss_dev is not None else 0.0
+
+        return TrainResult(
+            final_step=max_steps,
+            final_loss=final_loss,
+            final_val_loss=final_val_loss,
+            total_time=total_time,
+            peak_memory=self._peak_memory_bytes(),
+            val_metrics=final_val_metrics,
+            first_step_loss=first_step_loss,
+            resumed_from_step=resumed_from_step,
+            parameter_count=self._param_count,
+            trainable_parameter_count=self._param_count,
+            total_tokens=total_tokens,
+        )
+
+    def _probe_seqlen(self, dataset) -> int:
+        return self._dataset_spec(dataset)[1]
+
+    # ------------------------------------------------------------------ metrics
+
+    def _shard_means(
+        self, shard_stats: list[tuple[jax.Array, jax.Array]]
+    ) -> np.ndarray:
+        """Per-data-shard interval losses: mean over steps+accum of shard means."""
+        per_step = []
+        for loss_sum, tokens in shard_stats:
+            ls = np.asarray(jax.device_get(loss_sum))  # (A, Bg)
+            tc = np.asarray(jax.device_get(tokens))
+            a, bg = ls.shape
+            per = bg // self._dp
+            ls = ls.reshape(a, self._dp, per).sum(axis=2)
+            tc = tc.reshape(a, self._dp, per).sum(axis=2)
+            per_step.append((ls / np.maximum(tc, 1.0)).mean(axis=0))  # (dp,)
+        return np.mean(per_step, axis=0)
+
+    def _log_train_interval(
+        self,
+        *,
+        step: int,
+        max_steps: int,
+        interval_losses: list[jax.Array],
+        interval_shard: list[tuple[jax.Array, jax.Array]],
+        interval_tokens: int,
+        interval_time: float,
+        total_tokens: int,
+    ) -> None:
+        losses = np.asarray(jax.device_get(jnp.stack(interval_losses)))
+        avg_loss = float(losses.mean())
+        steps_in_interval = len(losses)
+        avg_step_time = interval_time / steps_in_interval if steps_in_interval else 0.0
+        tokens_per_sec = interval_tokens / interval_time if interval_time > 0 else 0.0
+        current_lr = float(jax.device_get(self._schedule(step - 1)))
+
+        if self._is_main:
+            if self._dp > 1:
+                shard_losses = self._shard_means(interval_shard)
+                for r in range(self._dp):
+                    self._tracker.log_metrics(
+                        {
+                            f"train/loss_rank_{r}": float(shard_losses[r]),
+                            f"train/lr_rank_{r}": current_lr,
+                            f"train/tokens_per_sec_rank_{r}": tokens_per_sec / self._dp,
+                            f"train/step_time_sec_rank_{r}": avg_step_time,
+                            f"train/tokens_total_rank_{r}": float(total_tokens / self._dp),
+                        },
+                        step=step,
+                    )
+            self._tracker.log_metrics(
+                {
+                    "train/loss": avg_loss,
+                    "train/lr": current_lr,
+                    "train/tokens_per_sec": tokens_per_sec,
+                    "train/step_time_sec": avg_step_time,
+                    "train/tokens_total": float(total_tokens),
+                },
+                step=step,
+            )
+
+        logger.info(
+            "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs",
+            step,
+            max_steps,
+            avg_loss,
+            current_lr,
+            tokens_per_sec,
+            avg_step_time,
+        )
+
+    # ------------------------------------------------------------------ eval
+
+    def _evaluate(self, step: int, max_steps: int) -> dict[str, float] | None:
+        val_ds = self._data_module.val_dataset()
+        if val_ds is None:
+            return None
+        n = len(val_ds)
+        sharding = batch_sharding(self._mesh, with_accum_dim=False)
+        seqlen = self._probe_seqlen(val_ds)
+
+        # Pad the last batch up to a multiple of the data-parallel degree with
+        # zero-masked rows: token-weighted aggregation makes padding exact
+        # (padded rows contribute 0 loss and 0 tokens).
+        eval_bs = min(self._global_micro, -(-n // self._dp) * self._dp)
+        num_batches = -(-n // eval_bs)
+
+        loss_sums = []
+        token_sums = []
+        shard_stats = []
+        for b in range(num_batches):
+            real = np.arange(b * eval_bs, min((b + 1) * eval_bs, n))
+            pad = eval_bs - len(real)
+            indices = np.concatenate([real, np.zeros(pad, dtype=np.int64)])
+
+            def fetch(key, index, pad=pad):
+                b_sl, t_sl = index
+                block = val_ds.get_examples(indices[b_sl])[key][:, t_sl]
+                if pad and key == "attention_mask":
+                    # Zero the attention mask of padded rows in this shard.
+                    # Unsharded dims arrive as slice(None) — default the bounds.
+                    start = b_sl.start if b_sl.start is not None else 0
+                    stop = b_sl.stop if b_sl.stop is not None else eval_bs
+                    row_ids = np.arange(start, stop)[: block.shape[0]]
+                    block = block.copy()
+                    block[row_ids >= eval_bs - pad] = 0
+                return block
+
+            batch = {
+                key: jax.make_array_from_callback(
+                    (eval_bs, seqlen), sharding, lambda i, k=key: fetch(k, i)
+                )
+                for key in self._dataset_spec(val_ds)[0]
+            }
+            loss_sum, tokens = self._eval_step_fn(
+                nn_meta.unbox(self._state.params), batch
+            )
+            loss_sums.append(loss_sum)
+            token_sums.append(tokens)
+            shard_stats.append((loss_sum[None], tokens[None]))
+
+        total_loss = float(sum(float(jnp.sum(jax.device_get(x))) for x in loss_sums))
+        total_tok = float(sum(float(jnp.sum(jax.device_get(x))) for x in token_sums))
+        val_loss = total_loss / max(total_tok, 1.0)
+        metrics = {"val/loss": val_loss}
+
+        if self._is_main:
+            if self._dp > 1:
+                shard_losses = self._shard_means(shard_stats)
+                for r in range(self._dp):
+                    self._tracker.log_metrics(
+                        {f"val/loss_rank_{r}": float(shard_losses[r])}, step=step
+                    )
+            self._tracker.log_metrics(metrics, step=step)
+
+        parts = "  ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
+        logger.info("val_step=%d/%d  %s", step, max_steps, parts)
+        return metrics
+
+    # ------------------------------------------------------------------ resume
+
+    def _restore(self, resume_spec: str) -> int:
+        """Load a checkpoint into the live state; returns the restored step."""
+        from flax import serialization
+
+        path = resolve_resume_path(resume_spec, self._cfg.output.root_dir)
+        payload = CheckpointManager.load(path)
+
+        current_yaml = yaml.safe_dump(self._cfg.model_dump(), sort_keys=False)
+        if payload["config_yaml"] != current_yaml:
+            logger.warning(
+                "checkpoint config differs from current config; "
+                "continuing with the CURRENT config (checkpoint: %s)",
+                path,
+            )
+
+        step = int(payload["step"])
+        host_params = serialization.from_state_dict(
+            nn_meta.unbox(self._state.params), payload["params"]
+        )
+        host_opt = serialization.from_state_dict(
+            nn_meta.unbox(self._state.opt_state), payload["opt_state"]
+        )
+        boxed_params = _rebox_like(self._state.params, host_params)
+        boxed_opt = _rebox_like(self._state.opt_state, host_opt)
+        restored = TrainState(
+            step=jnp.asarray(step, jnp.int32), params=boxed_params, opt_state=boxed_opt
+        )
+        self._state = jax.jit(lambda s: s, out_shardings=self._state_shardings)(restored)
+        logger.info("resumed from %s at step %d", path, step)
+        return step
+
+    # ------------------------------------------------------------------ misc
+
+    def _peak_memory_bytes(self) -> float:
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return 0.0
+        if not stats:
+            return 0.0
+        return float(stats.get("peak_bytes_in_use", 0))
+
+
+def _rebox_like(boxed_template: Any, values: Any) -> Any:
+    """Re-attach Partitioned metadata from ``boxed_template`` onto ``values``."""
+
+    def rebox(template_leaf, value):
+        if isinstance(template_leaf, nn_meta.Partitioned):
+            return template_leaf.replace_boxed(jnp.asarray(value))
+        return jnp.asarray(value)
+
+    return jax.tree.map(
+        rebox, boxed_template, values, is_leaf=lambda x: isinstance(x, nn_meta.Partitioned)
+    )

@@ -51,13 +51,15 @@ def format_run_summary(
         summary["train_result"] = {
             "final_step": train_result.final_step,
             "final_loss": train_result.final_loss,
+            "final_val_loss": train_result.final_val_loss,
             "first_step_loss": train_result.first_step_loss,
             "total_tokens": train_result.total_tokens,
-            "total_time_sec": train_result.total_time_sec,
-            "param_count": train_result.param_count,
-            "val_metrics": dict(train_result.val_metrics),
-            "resumed_from": train_result.resumed_from,
-            "peak_memory_bytes": train_result.peak_memory_bytes,
+            "total_time": train_result.total_time,
+            "peak_memory": train_result.peak_memory,
+            "parameter_count": train_result.parameter_count,
+            "trainable_parameter_count": train_result.trainable_parameter_count,
+            "val_metrics": dict(train_result.val_metrics or {}),
+            "resumed_from_step": train_result.resumed_from_step,
         }
 
     if as_json:

@@ -1,0 +1,170 @@
+"""Checkpoint tests (parity with reference tests/test_checkpoint.py):
+file naming, prune-to-k, latest selection, save cadence, resume-spec
+resolution, config-mismatch warning, and the flagship resume == continuous
+loss-parity guarantee (reference :301-320, tolerance 1e-5)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training import (
+    CheckpointError,
+    CheckpointManager,
+    Trainer,
+    resolve_resume_path,
+)
+
+
+def _cfg(tmp_path=None, **overrides):
+    base = {
+        "run": {"name": "t", "seed": 7},
+        "model": {
+            "name": "dummy_gpt",
+            "block_size": 8,
+            "vocab_size": 32,
+            "dropout": 0.0,
+            "d_model": 48,
+            "n_heads": 2,
+            "d_ff": 96,
+            "n_layers": 1,
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 20,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 2,
+            "lr": 3e-3,
+            "warmup_steps": 0,
+            "log_every_steps": 50,
+            "eval_every_steps": 50,
+            "save_every_steps": 5,
+        },
+        "mlflow": {"enabled": False},
+    }
+    if tmp_path is not None:
+        base["output"] = {"root_dir": str(tmp_path)}
+    for section, values in overrides.items():
+        base[section] = {**base.get(section, {}), **values}
+    return RunConfig.model_validate(base)
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+def _run_dir(tmp_path, name="run_a"):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+class TestCheckpointManager:
+    def test_naming_and_cadence(self, tmp_path):
+        run_dir = _run_dir(tmp_path)
+        cfg = _cfg(tmp_path)
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        names = [p.name for p in (run_dir / "checkpoints").iterdir()]
+        # save_every=5, max=20, keep_last_k default 3 -> steps 10, 15, 20
+        assert sorted(names) == ["step_000010.ckpt", "step_000015.ckpt", "step_000020.ckpt"]
+
+    def test_keep_last_k_override(self, tmp_path):
+        run_dir = _run_dir(tmp_path)
+        cfg = _cfg(tmp_path, trainer={"extra": {"keep_last_k": 1}})
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        names = [p.name for p in (run_dir / "checkpoints").iterdir()]
+        assert names == ["step_000020.ckpt"]
+
+    def test_latest_checkpoint(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "c")
+        assert mgr.latest_checkpoint() is None
+        (tmp_path / "c").mkdir()
+        for step in (10, 2, 30):
+            (tmp_path / "c" / f"step_{step:06d}.ckpt").write_bytes(b"x")
+        assert mgr.latest_checkpoint().name == "step_000030.ckpt"
+
+    def test_load_validates_keys(self, tmp_path):
+        from flax import serialization
+
+        bad = tmp_path / "step_000001.ckpt"
+        bad.write_bytes(serialization.msgpack_serialize({"step": np.int64(1)}))
+        with pytest.raises(CheckpointError, match="missing required keys"):
+            CheckpointManager.load(bad)
+
+    def test_load_missing_file(self, tmp_path):
+        with pytest.raises(CheckpointError, match="not found"):
+            CheckpointManager.load(tmp_path / "nope.ckpt")
+
+
+class TestResumeResolution:
+    def test_explicit_file(self, tmp_path):
+        f = tmp_path / "step_000005.ckpt"
+        f.write_bytes(b"x")
+        assert resolve_resume_path(str(f), tmp_path) == f
+
+    def test_directory_latest(self, tmp_path):
+        d = tmp_path / "ckpts"
+        d.mkdir()
+        for step in (1, 9):
+            (d / f"step_{step:06d}.ckpt").write_bytes(b"x")
+        assert resolve_resume_path(str(d), tmp_path).name == "step_000009.ckpt"
+
+    def test_missing_ckpt_file_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            resolve_resume_path("nope.ckpt", tmp_path)
+
+    def test_run_id_resolution(self, tmp_path):
+        d = tmp_path / "my_run" / "checkpoints"
+        d.mkdir(parents=True)
+        (d / "step_000003.ckpt").write_bytes(b"x")
+        assert resolve_resume_path("my_run", tmp_path).name == "step_000003.ckpt"
+
+    def test_unknown_run_id_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="neither"):
+            resolve_resume_path("ghost_run", tmp_path)
+
+
+class TestResumeParity:
+    def test_resume_matches_continuous(self, tmp_path):
+        """Train 20 straight vs 10 + resume 10: final loss within 1e-5."""
+        cfg = _cfg(tmp_path, trainer={"save_every_steps": 10})
+
+        run_a = _run_dir(tmp_path, "continuous")
+        res_full = Trainer(cfg, run_a, NullTracker(), None).fit()
+
+        run_b = _run_dir(tmp_path, "resumed")
+        Trainer(cfg, run_b, NullTracker(), None).fit(max_steps_override=10)
+        resumed_trainer = Trainer(cfg, run_b, NullTracker(), None)
+        res_resumed = resumed_trainer.fit(
+            resume_from=str(run_b / "checkpoints" / "step_000010.ckpt")
+        )
+
+        assert res_resumed.resumed_from_step == 10
+        assert res_resumed.final_loss == pytest.approx(res_full.final_loss, abs=1e-5)
+
+    def test_resume_with_dropout_parity(self, tmp_path):
+        """Stateless fold_in RNG means dropout streams also line up."""
+        cfg = _cfg(tmp_path, model={"dropout": 0.1}, trainer={"save_every_steps": 10})
+        run_a = _run_dir(tmp_path, "cont_do")
+        res_full = Trainer(cfg, run_a, NullTracker(), None).fit()
+        run_b = _run_dir(tmp_path, "res_do")
+        Trainer(cfg, run_b, NullTracker(), None).fit(max_steps_override=10)
+        res_resumed = Trainer(cfg, run_b, NullTracker(), None).fit(
+            resume_from=str(run_b / "checkpoints")
+        )
+        assert res_resumed.final_loss == pytest.approx(res_full.final_loss, abs=1e-5)
+
+    def test_config_mismatch_warns(self, tmp_path, caplog):
+        cfg = _cfg(tmp_path)
+        run_a = _run_dir(tmp_path, "warn_run")
+        Trainer(cfg, run_a, NullTracker(), None).fit(max_steps_override=10)
+        changed = _cfg(tmp_path, trainer={"lr": 1e-4})
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            Trainer(changed, None, NullTracker(), None).fit(
+                resume_from=str(run_a / "checkpoints")
+            )
+        assert any("config differs" in r.message for r in caplog.records)

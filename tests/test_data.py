@@ -50,9 +50,16 @@ class TestSampler:
         s = DeterministicSampler(num_examples=20, batch_size=5, seed=0, shuffle=False)
         assert np.array_equal(s.batch_indices(0), np.arange(5))
 
-    def test_too_small_dataset_raises(self):
-        with pytest.raises(ValueError, match="examples"):
-            DeterministicSampler(num_examples=3, batch_size=8, seed=0)
+    def test_small_dataset_wraps_deterministically(self):
+        s = DeterministicSampler(num_examples=3, batch_size=8, seed=0)
+        a = s.batch_indices(0)
+        assert len(a) == 8
+        assert set(a.tolist()) == {0, 1, 2}
+        assert np.array_equal(a, s.batch_indices(0))
+
+    def test_empty_dataset_raises(self):
+        with pytest.raises(ValueError, match="no examples"):
+            DeterministicSampler(num_examples=0, batch_size=8, seed=0)
 
 
 class TestDummyText:

@@ -1,0 +1,171 @@
+"""Trainer behavior tests (parity with reference tests/test_trainer.py):
+loss decreases, exact LR schedule values, log cadence, tracker contract,
+per-rank (per-data-shard) metric naming."""
+
+import math
+from unittest.mock import Mock
+
+import jax
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import RunConfig, TrainerConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training import Trainer, lr_schedule
+
+
+def _cfg(**overrides):
+    base = {
+        "run": {"name": "t", "seed": 3},
+        "model": {
+            "name": "dummy_gpt",
+            "block_size": 8,
+            "vocab_size": 32,
+            "dropout": 0.0,
+            "d_model": 64,
+            "n_heads": 2,
+            "d_ff": 128,
+            "n_layers": 1,
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 30,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 2,
+            "lr": 3e-3,
+            "warmup_steps": 0,
+            "log_every_steps": 10,
+            "eval_every_steps": 15,
+            "save_every_steps": 10,
+        },
+        "mlflow": {"enabled": False},
+    }
+    for section, values in overrides.items():
+        base[section] = {**base[section], **values}
+    return RunConfig.model_validate(base)
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+class TestLossDecreases:
+    def test_dummy_model(self):
+        trainer = Trainer(_cfg(), None, NullTracker(), None)
+        res = trainer.fit()
+        assert res.first_step_loss is not None
+        assert res.final_loss < res.first_step_loss
+        assert np.isfinite(res.final_loss)
+        assert res.final_val_loss is not None and np.isfinite(res.final_val_loss)
+
+    def test_real_gpt(self):
+        cfg = _cfg(
+            model={
+                "name": "gpt",
+                "block_size": 8,
+                "vocab_size": 32,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 2,
+                "dropout": 0.0,
+            },
+            trainer={"max_steps": 40, "lr": 1e-2},
+        )
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.final_loss < res.first_step_loss
+
+    def test_grad_accum_consumes_distinct_batches(self):
+        """total_tokens reflects accum * global_micro * seq per step."""
+        cfg = _cfg(trainer={"max_steps": 4, "grad_accum_steps": 3})
+        trainer = Trainer(cfg, None, NullTracker(), None)
+        res = trainer.fit()
+        assert res.total_tokens == 4 * 3 * (2 * 8) * 8  # steps*accum*(micro*dp)*seq
+
+
+class TestLRSchedule:
+    def test_exact_values(self):
+        cfg = TrainerConfig(max_steps=100, warmup_steps=10, lr=1.0)
+        sched = lr_schedule(cfg)
+        # optimizer step N (1-indexed) uses count N-1
+        assert float(sched(0)) == pytest.approx(0.0)  # first step, warmup start
+        assert float(sched(5)) == pytest.approx(0.5)  # mid-warmup
+        assert float(sched(10)) == pytest.approx(1.0)  # warmup end
+        mid = 10 + (100 - 10) / 2
+        assert float(sched(mid)) == pytest.approx(0.5)  # cosine midpoint
+        assert float(sched(100)) == pytest.approx(0.0, abs=1e-6)  # decayed to 0
+
+    def test_no_warmup(self):
+        sched = lr_schedule(TrainerConfig(max_steps=10, warmup_steps=0, lr=2.0))
+        assert float(sched(0)) == pytest.approx(2.0)
+
+    def test_warmup_equals_max(self):
+        sched = lr_schedule(TrainerConfig(max_steps=10, warmup_steps=10, lr=1.0))
+        assert float(sched(10)) == pytest.approx(1.0)
+        assert float(sched(5)) == pytest.approx(0.5)
+
+
+class TestLoggingCadence:
+    def _tracked_steps(self, tracker, prefix="train/loss"):
+        steps = []
+        for call in tracker.log_metrics.call_args_list:
+            metrics = call.args[0] if call.args else call.kwargs["metrics"]
+            if prefix in metrics:
+                steps.append(call.kwargs.get("step"))
+        return steps
+
+    def test_log_every_and_final(self):
+        tracker = Mock()
+        cfg = _cfg(trainer={"max_steps": 25, "log_every_steps": 10, "eval_every_steps": 100})
+        # eval_every > max_steps would break the <= validator? no such validator; fine
+        Trainer(cfg, None, tracker, None).fit()
+        assert self._tracked_steps(tracker) == [10, 20, 25]
+
+    def test_per_rank_metrics_present(self):
+        tracker = Mock()
+        cfg = _cfg(trainer={"max_steps": 10, "log_every_steps": 10, "eval_every_steps": 10})
+        Trainer(cfg, None, tracker, None).fit()
+        all_keys = set()
+        for call in tracker.log_metrics.call_args_list:
+            metrics = call.args[0] if call.args else call.kwargs["metrics"]
+            all_keys.update(metrics)
+        # 8 virtual devices -> 8 data shards ("ranks")
+        assert "train/loss_rank_0" in all_keys
+        assert "train/loss_rank_7" in all_keys
+        assert "val/loss_rank_0" in all_keys
+        assert "train/loss" in all_keys and "val/loss" in all_keys
+        assert "train/tokens_per_sec" in all_keys
+        assert "train/step_time_sec" in all_keys
+        assert "train/tokens_total" in all_keys
+        assert "train/lr" in all_keys
+
+    def test_params_logged_once(self):
+        tracker = Mock()
+        Trainer(_cfg(trainer={"max_steps": 2}), None, tracker, None).fit()
+        assert tracker.log_params.call_count == 1
+        logged = tracker.log_params.call_args.args[0]
+        assert logged["model"]["name"] == "dummy_gpt"
+
+    def test_shard_losses_are_per_shard(self):
+        """Per-rank losses differ across shards (different data)."""
+        tracker = Mock()
+        cfg = _cfg(trainer={"max_steps": 10, "log_every_steps": 10})
+        Trainer(cfg, None, tracker, None).fit()
+        rank_losses = {}
+        for call in tracker.log_metrics.call_args_list:
+            metrics = call.args[0] if call.args else call.kwargs["metrics"]
+            for k, v in metrics.items():
+                if k.startswith("train/loss_rank_"):
+                    rank_losses[k] = v
+        assert len(rank_losses) == 8
+        assert len({round(v, 9) for v in rank_losses.values()}) > 1
+
+
+class TestValEval:
+    def test_token_weighted_val_loss_finite(self):
+        cfg = _cfg(trainer={"max_steps": 15, "eval_every_steps": 5})
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.val_metrics is not None
+        assert np.isfinite(res.val_metrics["val/loss"])
