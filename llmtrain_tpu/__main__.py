@@ -1,0 +1,8 @@
+"""``python -m llmtrain_tpu`` entry point (reference src/llmtrain/__main__.py)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""Command-line interface.
+
+Parity target: reference ``src/llmtrain/cli.py`` — argparse CLI with
+``train``/``validate``/``print-config`` subcommands (:145-157), required
+``--config``, train-only ``--run-id``/``--dry-run``/``--json``/``-v``/
+``--resume`` (:147-151), exit codes 0/1 (training failure, :304)/2 (config
+error, :167), JSON errors to stderr (:63-76), and the train orchestration:
+distributed setup → run dir → logging → registries → tracker → Trainer/dry
+run → summary → artifact logging → teardown in ``finally`` (:201-328).
+Under ``--json``, logs go to stderr so stdout carries only the summary JSON
+(:281-288).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any
+
+from . import __version__
+from .config import ConfigLoadError, load_and_validate_config
+from .distributed import (
+    DistState,
+    configure_platform,
+    setup_distributed,
+    teardown_distributed,
+)
+from .registry import (
+    RegistryError,
+    get_data_module,
+    get_model_adapter,
+    initialize_registries,
+)
+from .tracking import MLflowTracker, NullTracker, Tracker
+from .utils import (
+    configure_logging,
+    create_run_directory,
+    format_run_summary,
+    generate_meta,
+    generate_run_id,
+    get_logger,
+    write_meta_json,
+    write_resolved_config,
+)
+
+EXIT_OK = 0
+EXIT_TRAIN_FAILURE = 1
+EXIT_CONFIG_ERROR = 2
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="llmtrain",
+        description="TPU-native config-driven LLM training",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    train = sub.add_parser("train", help="run a training job")
+    train.add_argument("--config", required=True, help="path to the YAML run config")
+    train.add_argument("--run-id", default=None, help="override the generated run id")
+    train.add_argument("--dry-run", action="store_true", help="forward-only sanity check")
+    train.add_argument("--json", action="store_true", help="emit the run summary as JSON")
+    train.add_argument("-v", "--verbose", action="store_true", help="DEBUG logging")
+    train.add_argument(
+        "--resume",
+        default=None,
+        help="checkpoint file, checkpoint dir, or run id to resume from",
+    )
+
+    validate = sub.add_parser("validate", help="validate a config file")
+    validate.add_argument("--config", required=True)
+    validate.add_argument("--json", action="store_true")
+
+    printcfg = sub.add_parser("print-config", help="print the resolved config")
+    printcfg.add_argument("--config", required=True)
+    printcfg.add_argument("--json", action="store_true")
+
+    return parser
+
+
+def _emit_error(message: str, *, details: Any = None, errors: Any = None) -> None:
+    payload = {"error": message}
+    if details:
+        payload["details"] = details
+    if errors:
+        payload["errors"] = errors
+    print(json.dumps(payload), file=sys.stderr)
+
+
+def _handle_validate(args: argparse.Namespace) -> int:
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    if args.json:
+        print(json.dumps({"valid": True, "config": args.config}))
+    else:
+        print("Config validation succeeded.")
+    return EXIT_OK
+
+
+def _handle_print_config(args: argparse.Namespace) -> int:
+    try:
+        _, _, resolved = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    if args.json:
+        print(json.dumps(resolved, indent=2))
+    else:
+        import yaml
+
+        print(yaml.safe_dump(resolved, sort_keys=False), end="")
+    return EXIT_OK
+
+
+def _create_tracker(cfg, dist_state: DistState | None, run_id: str) -> Tracker:
+    """MLflow on the main process when enabled; Null otherwise (reference :246-248)."""
+    is_main = dist_state is None or dist_state.is_main
+    if cfg.mlflow.enabled and is_main:
+        return MLflowTracker(
+            cfg.mlflow.tracking_uri,
+            cfg.mlflow.experiment,
+            run_name=cfg.mlflow.run_name or run_id,
+        )
+    return NullTracker()
+
+
+def _log_run_artifacts(tracker: Tracker, run_dir: Path | None) -> None:
+    if run_dir is None:
+        return
+    for name in ("config.yaml", "meta.json"):
+        path = run_dir / name
+        if path.is_file():
+            tracker.log_artifact(str(path))
+
+
+def _agree_run_id(candidate: str, dist_state: DistState | None) -> str:
+    """Make every process use rank 0's run id.
+
+    ``generate_run_id`` is wall-clock/filesystem dependent, so independent
+    generation can diverge across hosts; rank 0's id is broadcast instead.
+    """
+    if dist_state is None or dist_state.num_processes == 1:
+        return candidate
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(256, dtype=np.uint8)
+    encoded = candidate.encode("utf-8")[:256]
+    buf[: len(encoded)] = np.frombuffer(encoded, dtype=np.uint8)
+    agreed = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(agreed)).rstrip(b"\x00").decode("utf-8")
+
+
+def _handle_train(args: argparse.Namespace) -> int:
+    try:
+        cfg, _, resolved = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    dist_state: DistState | None = None
+    if cfg.distributed.enabled:
+        dist_state = setup_distributed(cfg.distributed)
+    is_main = dist_state is None or dist_state.is_main
+
+    logger = get_logger()
+    tracker: Tracker = NullTracker()
+    exit_code = EXIT_OK
+    tracker_started = False
+    try:
+        run_id = args.run_id or cfg.output.run_id
+        if run_id is None:
+            run_id = generate_run_id(cfg.run.name, cfg.output.root_dir)
+        run_id = _agree_run_id(run_id, dist_state)
+
+        # Rank-0-only I/O: non-main ranks never touch the run dir
+        # (reference cli.py:246-248, trainer.py:402-406).
+        run_dir: Path | None = None
+        if is_main:
+            try:
+                run_dir = create_run_directory(cfg.output.root_dir, run_id)
+            except FileExistsError:
+                _emit_error(
+                    f"run directory already exists for run id {run_id!r}",
+                    details="pass a fresh --run-id or let the run id be generated",
+                )
+                return EXIT_TRAIN_FAILURE
+
+        log_file = None
+        if cfg.logging.log_to_file and run_dir is not None:
+            log_file = run_dir / "logs" / cfg.logging.file_name
+        level = "DEBUG" if args.verbose else cfg.logging.level
+        # Under --json, all logs go to stderr so stdout stays machine-parseable
+        # (reference cli.py:281-288). Logs already default to stderr.
+        configure_logging(
+            level=level, json_output=cfg.logging.json_output, log_file=log_file
+        )
+
+        if run_dir is not None:
+            if cfg.output.save_config_copy:
+                write_resolved_config(run_dir, resolved)
+            if cfg.output.save_meta_json:
+                meta = generate_meta(
+                    run_id=run_id,
+                    run_name=cfg.run.name,
+                    config_path=args.config,
+                    resolved_config_path=run_dir / "config.yaml",
+                )
+                write_meta_json(run_dir, meta)
+
+        initialize_registries()
+        try:
+            get_model_adapter(cfg.model.name)
+            get_data_module(cfg.data.name)
+        except RegistryError as exc:
+            _emit_error(str(exc))
+            return EXIT_CONFIG_ERROR
+
+        tracker = _create_tracker(cfg, dist_state, run_id)
+        tracker.start_run(run_id, cfg.mlflow.run_name)
+        tracker_started = True
+
+        if args.dry_run:
+            from .training import run_dry_run
+
+            dry_result = run_dry_run(cfg)
+            summary = format_run_summary(
+                cfg,
+                run_id=run_id,
+                run_dir=str(run_dir) if run_dir else None,
+                dry_run=True,
+                dry_run_result=dry_result,
+                as_json=args.json,
+            )
+        else:
+            from .training import Trainer
+
+            trainer = Trainer(cfg, run_dir, tracker, dist_state)
+            result = trainer.fit(resume_from=args.resume)
+            summary = format_run_summary(
+                cfg,
+                run_id=run_id,
+                run_dir=str(run_dir) if run_dir else None,
+                dry_run=False,
+                train_result=result,
+                as_json=args.json,
+            )
+        if is_main:
+            print(json.dumps(summary) if args.json else summary)
+            _log_run_artifacts(tracker, run_dir)
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        logger.exception("training failed: %s", exc)
+        _emit_error(f"training failed: {exc}")
+        exit_code = EXIT_TRAIN_FAILURE
+    finally:
+        try:
+            if tracker_started:
+                tracker.end_run("FINISHED" if exit_code == EXIT_OK else "FAILED")
+        finally:
+            teardown_distributed()
+    return exit_code
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "train":
+        return _handle_train(args)
+    if args.command == "validate":
+        return _handle_validate(args)
+    if args.command == "print-config":
+        return _handle_print_config(args)
+    parser.error(f"unknown command {args.command!r}")
+    return EXIT_CONFIG_ERROR
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,24 @@ def resolve_topology(cfg: DistributedConfig) -> tuple[int, int, str | None]:
     return process_id, num_processes, coordinator
 
 
+def configure_platform(device: str) -> None:
+    """Pin the JAX platform to match ``run.device`` BEFORE backend init.
+
+    Required on hosts whose sitecustomize registers an accelerator PJRT
+    plugin: with a plugin registered, ``jax.process_index()`` consults the
+    plugin's backend and can report 0 in every process unless the platform
+    is pinned via jax.config (the JAX_PLATFORMS env var alone is not
+    honoured once the plugin is registered). ``tpu`` leaves the default
+    accelerator backend in place.
+    """
+    if device != "cpu":
+        return
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as exc:  # backend already initialized — too late to switch
+        get_logger().warning("could not pin jax platform to cpu: %s", exc)
+
+
 def setup_distributed(cfg: DistributedConfig) -> DistState:
     """Initialize the JAX distributed runtime (idempotent).
 

@@ -37,6 +37,7 @@ from ..parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     batch_sharding,
     data_parallel_degree,
+    replicated,
     state_shardings,
 )
 from ..registry import get_data_module, get_model_adapter
@@ -108,6 +109,12 @@ class Trainer:
                 Path(run_dir) / "checkpoints", keep_last_k=keep_last_k
             )
 
+        with self._mesh, nn.logical_axis_rules(self._rules):
+            self._state = self._init_state()
+
+        # Metrics come out replicated (out_shardings) so every process can
+        # read them: per-example arrays are otherwise batch-sharded and not
+        # addressable across hosts. They are tiny; the all-gather is noise.
         use_dropout = cfg.model.dropout > 0.0
         self._train_step_fn = jax.jit(
             make_train_step(
@@ -118,11 +125,12 @@ class Trainer:
                 use_dropout=use_dropout,
             ),
             donate_argnums=(0,),
+            out_shardings=(self._state_shardings, replicated(self._mesh)),
         )
-        self._eval_step_fn = jax.jit(make_eval_step(self._adapter, self._model))
-
-        with self._mesh, nn.logical_axis_rules(self._rules):
-            self._state = self._init_state()
+        self._eval_step_fn = jax.jit(
+            make_eval_step(self._adapter, self._model),
+            out_shardings=replicated(self._mesh),
+        )
 
         params = nn_meta.unbox(self._state.params)
         self._param_count = int(

@@ -1,0 +1,177 @@
+"""CLI integration tests (parity with reference tests/test_cli.py):
+subcommands as subprocesses asserting exit codes, stdout JSON schema, and
+artifacts on disk; resume via run dir and explicit ckpt path."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+CFG = {
+    "schema_version": 1,
+    "run": {"name": "cli-test", "seed": 5, "device": "cpu", "deterministic": True},
+    "model": {
+        "name": "dummy_gpt",
+        "block_size": 8,
+        "d_model": 48,
+        "n_layers": 1,
+        "n_heads": 2,
+        "d_ff": 96,
+        "dropout": 0.0,
+        "vocab_size": 32,
+    },
+    "data": {"name": "dummy_text"},
+    "trainer": {
+        "max_steps": 6,
+        "micro_batch_size": 2,
+        "grad_accum_steps": 1,
+        "lr": 0.003,
+        "warmup_steps": 0,
+        "log_every_steps": 3,
+        "eval_every_steps": 3,
+        "save_every_steps": 3,
+    },
+    "mlflow": {"enabled": False},
+    "logging": {"level": "INFO", "json_output": True, "log_to_file": True},
+    "output": {"root_dir": "runs"},
+}
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def _run(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=_env(),
+        timeout=420,
+    )
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    cfg_path = tmp_path / "config.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    return tmp_path
+
+
+class TestValidate:
+    def test_valid(self, workdir):
+        proc = _run(["validate", "--config", "config.yaml"], workdir)
+        assert proc.returncode == 0
+        assert "succeeded" in proc.stdout
+
+    def test_invalid_exit_2_with_json_stderr(self, workdir):
+        (workdir / "bad.yaml").write_text(yaml.safe_dump({**CFG, "bogus": 1}))
+        proc = _run(["validate", "--config", "bad.yaml"], workdir)
+        assert proc.returncode == 2
+        err = json.loads(proc.stderr.strip().splitlines()[-1])
+        assert "error" in err and err["errors"]
+
+    def test_missing_file_exit_2(self, workdir):
+        proc = _run(["validate", "--config", "nope.yaml"], workdir)
+        assert proc.returncode == 2
+
+
+class TestPrintConfig:
+    def test_yaml_defaults_materialized(self, workdir):
+        proc = _run(["print-config", "--config", "config.yaml"], workdir)
+        assert proc.returncode == 0
+        resolved = yaml.safe_load(proc.stdout)
+        assert resolved["trainer"]["weight_decay"] == 0.1
+        assert resolved["distributed"]["mesh"]["data"] == -1
+
+    def test_json(self, workdir):
+        proc = _run(["print-config", "--config", "config.yaml", "--json"], workdir)
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout)["run"]["name"] == "cli-test"
+
+
+class TestTrain:
+    def test_full_train_json_summary_and_artifacts(self, workdir):
+        proc = _run(
+            ["train", "--config", "config.yaml", "--json", "--run-id", "run1"], workdir
+        )
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout)
+        tr = summary["train_result"]
+        assert tr["final_step"] == 6
+        assert tr["final_loss"] > 0 and tr["first_step_loss"] > 0
+        assert tr["parameter_count"] > 0
+        assert summary["run_id"] == "run1"
+
+        run_dir = workdir / "runs" / "run1"
+        assert (run_dir / "config.yaml").is_file()
+        assert (run_dir / "meta.json").is_file()
+        assert (run_dir / "logs" / "train.log").is_file()
+        ckpts = sorted(p.name for p in (run_dir / "checkpoints").iterdir())
+        assert ckpts == ["step_000003.ckpt", "step_000006.ckpt"]
+        # --json keeps stdout pure JSON; logs went to stderr/file
+        assert proc.stdout.strip().startswith("{")
+
+    def test_dry_run(self, workdir):
+        proc = _run(["train", "--config", "config.yaml", "--dry-run", "--json"], workdir)
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["dry_run"] is True
+        assert summary["dry_run_resolution"]["steps_executed"] == 5
+        assert summary["dry_run_resolution"]["model_adapter"] == "dummy_gpt"
+
+    def test_resume_by_run_dir(self, workdir):
+        first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runA"], workdir)
+        assert first.returncode == 0, first.stderr
+        second = _run(
+            [
+                "train",
+                "--config",
+                "config.yaml",
+                "--json",
+                "--run-id",
+                "runB",
+                "--resume",
+                str(workdir / "runs" / "runA" / "checkpoints" / "step_000003.ckpt"),
+            ],
+            workdir,
+        )
+        assert second.returncode == 0, second.stderr
+        tr = json.loads(second.stdout)["train_result"]
+        assert tr["resumed_from_step"] == 3
+
+    def test_unknown_adapter_exit_2(self, workdir):
+        bad = {**CFG, "model": {**CFG["model"], "name": "nonexistent"}}
+        (workdir / "bad.yaml").write_text(yaml.safe_dump(bad))
+        proc = _run(["train", "--config", "bad.yaml"], workdir)
+        assert proc.returncode == 2
+        assert "nonexistent" in proc.stderr
+
+    def test_train_failure_exit_1(self, workdir):
+        bad = {**CFG, "trainer": {**CFG["trainer"], "max_steps": 6}}
+        bad["data"] = {"name": "hf_text"}  # no dataset_name -> setup raises
+        (workdir / "bad.yaml").write_text(yaml.safe_dump(bad))
+        proc = _run(["train", "--config", "bad.yaml", "--json"], workdir)
+        assert proc.returncode == 1
+        err = json.loads(proc.stderr.strip().splitlines()[-1])
+        assert "training failed" in err["error"]
+
+
+class TestPresets:
+    def test_all_presets_validate(self, workdir):
+        import pathlib
+
+        presets = pathlib.Path(__file__).resolve().parent.parent / "configs" / "presets"
+        assert presets.is_dir()
+        for preset in sorted(presets.glob("*.yaml")):
+            proc = _run(["validate", "--config", str(preset)], workdir)
+            assert proc.returncode == 0, f"{preset.name}: {proc.stderr}"

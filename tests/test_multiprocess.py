@@ -1,0 +1,115 @@
+"""Real 2-process distributed smoke (parity with reference
+tests/test_distributed.py:705-784's torchrun test): two CLI subprocesses
+rendezvous via MASTER_ADDR/MASTER_PORT, train data-parallel over a global
+8-device mesh (4 forced CPU devices per process), rank-0-only artifacts."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+CFG = {
+    "schema_version": 1,
+    "run": {"name": "mp-smoke", "seed": 11, "device": "cpu", "deterministic": True},
+    "model": {
+        "name": "dummy_gpt",
+        "block_size": 8,
+        "d_model": 48,
+        "n_layers": 1,
+        "n_heads": 2,
+        "d_ff": 96,
+        "dropout": 0.0,
+        "vocab_size": 32,
+    },
+    "data": {"name": "dummy_text"},
+    "trainer": {
+        "max_steps": 4,
+        "micro_batch_size": 2,
+        "grad_accum_steps": 1,
+        "lr": 0.003,
+        "warmup_steps": 0,
+        "log_every_steps": 2,
+        "eval_every_steps": 4,
+        "save_every_steps": 2,
+    },
+    "distributed": {"enabled": True, "timeout_sec": 60},
+    "mlflow": {"enabled": False},
+    "logging": {"level": "INFO", "json_output": True, "log_to_file": True},
+    "output": {"root_dir": "runs"},
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_train(tmp_path):
+    cfg_path = tmp_path / "config.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    port = _free_port()
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            RANK=str(rank),
+            WORLD_SIZE="2",
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "llmtrain_tpu",
+                    "train",
+                    "--config",
+                    "config.yaml",
+                    "--json",
+                    "--run-id",
+                    "mp_run",
+                ],
+                cwd=tmp_path,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+
+    outs = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=300)
+        outs.append((proc.returncode, out, err))
+
+    for rc, out, err in outs:
+        assert rc == 0, f"rank failed: {err[-2000:]}"
+
+    # Rank 0 prints the JSON summary as its last stdout line; rank 1 prints
+    # no summary. (XLA's CPU gloo backend chats "[Gloo] ..." on stdout — a
+    # CPU-test artifact that doesn't exist on TPU.)
+    def summary_lines(out):
+        return [ln for ln in out.splitlines() if ln.startswith("{")]
+
+    rank0_json = summary_lines(outs[0][1])
+    assert len(rank0_json) == 1
+    summary = json.loads(rank0_json[0])
+    assert summary["train_result"]["final_step"] == 4
+    assert summary["train_result"]["final_loss"] > 0
+    assert summary_lines(outs[1][1]) == []
+
+    # Exactly one run dir, created by rank 0 only, with the expected ckpts.
+    runs = list((tmp_path / "runs").iterdir())
+    assert [p.name for p in runs] == ["mp_run"]
+    ckpts = sorted(p.name for p in (tmp_path / "runs" / "mp_run" / "checkpoints").iterdir())
+    assert ckpts == ["step_000002.ckpt", "step_000004.ckpt"]
