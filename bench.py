@@ -1,0 +1,142 @@
+"""Headline benchmark: training-step throughput on the flagship GPT.
+
+Measures the real jit-compiled train step (forward + backward + AdamW +
+clip + LR schedule, llmtrain_tpu/training/train_step.py) on synthetic
+token batches and prints ONE JSON line:
+
+    {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tokens/s",
+     "vs_baseline": R}
+
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is measured MFU divided by the 0.30 MFU north-star target
+from BASELINE.json — 1.0 means "hit the 30% MFU target exactly".
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by TPU generation (scaling-book numbers).
+_TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+_MFU_TARGET = 0.30
+
+
+def _peak_flops() -> float:
+    if jax.default_backend() != "tpu":
+        return 2e11  # nominal host CPU peak; local smoke only
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return 197e12
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        depth, d_model, n_heads, d_ff = 12, 768, 12, 3072
+        vocab, seq, batch = 50257, 512, 16
+        steps = 10
+    else:
+        depth, d_model, n_heads, d_ff = 2, 128, 4, 512
+        vocab, seq, batch = 1024, 128, 4
+        steps = 3
+
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.models.gpt import GPTAdapter
+    from llmtrain_tpu.training.optimizer import build_optimizer
+    from llmtrain_tpu.training.train_step import create_train_state, make_train_step
+
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": "bench", "device": "tpu" if on_tpu else "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": seq,
+                "d_model": d_model,
+                "n_layers": depth,
+                "n_heads": n_heads,
+                "d_ff": d_ff,
+                "dropout": 0.0,
+                "vocab_size": vocab,
+                "dtype": "bfloat16" if on_tpu else "float32",
+                "attention": "flash" if on_tpu else "dense",
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {"micro_batch_size": batch, "grad_accum_steps": 1, "warmup_steps": 0},
+        }
+    )
+    adapter = GPTAdapter()
+    model = adapter.build_model(cfg)
+    tx = build_optimizer(cfg.trainer)
+
+    rng = jax.random.key(0)
+    params = adapter.init_params(model, cfg, rng)
+    state = create_train_state(params, tx)
+    step_fn = jax.jit(
+        make_train_step(adapter, model, tx, grad_accum_steps=1, use_dropout=False),
+        donate_argnums=(0,),
+    )
+
+    tokens = np.random.default_rng(0).integers(0, vocab, size=(1, batch, seq), dtype=np.int32)
+    batch_dict = {
+        "input_ids": jnp.asarray(tokens),
+        "labels": jnp.asarray(tokens),
+        "attention_mask": jnp.ones_like(jnp.asarray(tokens)),
+    }
+
+    # Warmup: compile + one real step. Sync via device_get — on remote-tunnel
+    # platforms block_until_ready can return before execution finishes.
+    for _ in range(2):
+        state, metrics = step_fn(state, batch_dict, rng)
+    jax.device_get(metrics["loss"])
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_dict, rng)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    # Training FLOPs/token ~ 6N + 12*L*T*d (PaLM appendix B approximation).
+    flops_per_token = 6 * n_params + 12 * depth * seq * d_model
+    mfu = tokens_per_sec * flops_per_token / _peak_flops()
+
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / _MFU_TARGET, 4),
+                "detail": {
+                    "backend": jax.default_backend(),
+                    "device_kind": jax.devices()[0].device_kind,
+                    "model": f"gpt L{depth} d{d_model} T{seq}",
+                    "params": n_params,
+                    "mfu": round(mfu, 4),
+                    "step_time_ms": round(elapsed / steps * 1e3, 2),
+                    "final_loss": final_loss,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
