@@ -157,6 +157,17 @@ def _agree_run_id(candidate: str, dist_state: DistState | None) -> str:
     return bytes(np.asarray(agreed)).rstrip(b"\x00").decode("utf-8")
 
 
+def _agree_flag(local_ok: bool, dist_state: DistState | None) -> bool:
+    """Broadcast rank 0's boolean to every process (single-process: identity)."""
+    if dist_state is None or dist_state.num_processes == 1:
+        return local_ok
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    agreed = multihost_utils.broadcast_one_to_all(np.uint8(1 if local_ok else 0))
+    return bool(np.asarray(agreed))
+
+
 def _handle_train(args: argparse.Namespace) -> int:
     try:
         cfg, _, resolved = load_and_validate_config(args.config)
@@ -181,17 +192,23 @@ def _handle_train(args: argparse.Namespace) -> int:
         run_id = _agree_run_id(run_id, dist_state)
 
         # Rank-0-only I/O: non-main ranks never touch the run dir
-        # (reference cli.py:246-248, trainer.py:402-406).
+        # (reference cli.py:246-248, trainer.py:402-406). All ranks must
+        # agree on the outcome — if only rank 0 bailed here, the other ranks
+        # would run on into the first collective and hang until timeout.
         run_dir: Path | None = None
+        run_dir_ok = True
         if is_main:
             try:
                 run_dir = create_run_directory(cfg.output.root_dir, run_id)
             except FileExistsError:
+                run_dir_ok = False
+        if not _agree_flag(run_dir_ok, dist_state):
+            if is_main:
                 _emit_error(
                     f"run directory already exists for run id {run_id!r}",
                     details="pass a fresh --run-id or let the run id be generated",
                 )
-                return EXIT_TRAIN_FAILURE
+            return EXIT_TRAIN_FAILURE
 
         log_file = None
         if cfg.logging.log_to_file and run_dir is not None:

@@ -87,13 +87,20 @@ class CausalSelfAttention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
 
         if self.attention == "flash":
-            # Flash mode is the packed-sequence fast path: padding masks are
-            # NOT applied inside attention (the data pipeline emits all-ones
-            # masks; the loss still respects the mask). Use 'dense' for
-            # genuinely padded batches.
+            # Flash/ring modes are the packed-sequence fast path: padding
+            # masks are NOT applied inside attention (the data pipeline emits
+            # all-ones masks; the loss still respects the mask). Use 'dense'
+            # for genuinely padded batches.
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
+        elif self.attention == "ring":
+            # Sequence-parallel exact attention over the mesh's `sequence`
+            # axis (ops/ring_attention.py); falls back to blockwise when no
+            # ambient mesh shards the sequence.
+            from ..ops.ring_attention import ring_or_blockwise
+
+            out = ring_or_blockwise(q, k, v, causal=True)
         else:
             out = dense_attention(
                 q,
@@ -327,10 +334,11 @@ class GPTAdapter(ModelAdapter):
             if not isinstance(tokenizer_vocab_size, int) or tokenizer_vocab_size <= 0:
                 raise ValueError("GPT tokenizer must expose a positive integer n_vocab.")
             vocab_size = tokenizer_vocab_size
-        if cfg.model.attention == "flash" and cfg.model.dropout > 0.0:
+        if cfg.model.attention in ("flash", "ring") and cfg.model.dropout > 0.0:
             raise ValueError(
-                "attention='flash' does not support attention-probability dropout; "
-                "set model.dropout to 0.0 or use attention='dense'"
+                f"attention={cfg.model.attention!r} does not support "
+                "attention-probability dropout; set model.dropout to 0.0 or "
+                "use attention='dense'"
             )
         return GPT(
             vocab_size=vocab_size,

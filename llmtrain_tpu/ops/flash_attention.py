@@ -19,7 +19,10 @@ from .blockwise_attention import blockwise_attention
 
 
 def _forward_best(q, k, v, causal: bool):
-    if jax.default_backend() == "tpu" and q.shape[1] % 128 == 0:
+    # The Pallas kernel tiles with block_q=block_k=256 (min'd with T), so T
+    # must divide evenly by the actual block size or the kernel raises.
+    t = q.shape[1]
+    if jax.default_backend() == "tpu" and t >= 128 and t % min(256, t) == 0:
         from .pallas_attention import pallas_flash_attention
 
         return pallas_flash_attention(q, k, v, causal=causal)

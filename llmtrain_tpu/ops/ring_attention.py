@@ -1,0 +1,128 @@
+"""Ring attention: exact causal attention sharded over the ``sequence`` axis.
+
+New TPU capability beyond the reference (whose attention is single-device
+full-matrix, reference models/gpt.py:56-69; max context = block_size). Each
+device holds a (B, T/n, H, D) shard of Q/K/V. K/V shards rotate around the
+``sequence`` mesh axis via ``lax.ppermute`` (one ICI hop per step) while each
+device accumulates online-softmax partials of its local queries against the
+visiting K/V block — so the full (T, T) score matrix never exists anywhere
+and context length scales linearly with the number of devices. Pattern
+follows the Ring Attention paper (see PAPERS.md); the per-block math reuses
+``ops/blockwise_attention._chunk_scan``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .blockwise_attention import _chunk_scan, blockwise_attention
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    kv_chunk: int = 512,
+) -> jax.Array:
+    """Local-shard ring attention; must run inside shard_map over ``axis_name``.
+
+    q/k/v: (B, T_local, H, D) shards, contiguous along the global sequence in
+    axis order. Returns the (B, T_local, H, D) output shard.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    axis_index = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_offset = axis_index * t_local
+    chunk = min(kv_chunk, t_local)
+    if t_local % chunk != 0:
+        chunk = t_local
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        acc, row_max, row_sum, k_cur, v_cur = carry
+        # After i rotations this device holds the K/V shard that started on
+        # device (axis_index - i); its global offset drives the causal mask.
+        kv_offset = ((axis_index - i) % axis_size) * t_local
+        acc2, max2, sum2 = _chunk_scan(
+            q,
+            k_cur,
+            v_cur,
+            q_offset=q_offset,
+            kv_offset=kv_offset,
+            causal=causal,
+            kv_chunk=chunk,
+        )
+        new_max = jnp.maximum(row_max, max2)
+        c1 = jnp.exp(row_max - new_max)
+        c2 = jnp.exp(max2 - new_max)
+        acc = acc * c1[..., None] + acc2 * c2[..., None]
+        row_sum = row_sum * c1 + sum2 * c2
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, new_max, row_sum, k_cur, v_cur
+
+    b, _, h, d = q.shape
+    init = (
+        jnp.zeros((b, t_local, h, d), jnp.float32),
+        jnp.full((b, t_local, h), -1e30, jnp.float32),
+        jnp.zeros((b, t_local, h), jnp.float32),
+        k,
+        v,
+    )
+    acc, _, row_sum, _, _ = jax.lax.fori_loop(0, axis_size, body, init)
+    return (acc / row_sum[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """shard_map wrapper: global (B, T, H, D) arrays over the named mesh.
+
+    Batch shards over (data, fsdp), sequence over ``sequence``, heads over
+    ``tensor`` — matching the activation logical-axis rules in
+    parallel/sharding.py.
+    """
+    P = jax.sharding.PartitionSpec
+    spec = P(("data", "fsdp"), "sequence", "tensor", None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name="sequence", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
+    """Route to ring attention when an ambient mesh has a sequence axis > 1;
+    otherwise fall back to single-device blockwise (same math, no ring)."""
+    mesh = _ambient_mesh()
+    if (
+        mesh is not None
+        and "sequence" in mesh.axis_names
+        and mesh.shape["sequence"] > 1
+        and q.shape[1] % mesh.shape["sequence"] == 0
+    ):
+        return ring_attention_sharded(q, k, v, mesh, causal=causal)
+    return blockwise_attention(q, k, v, causal=causal)
+
+
+def _ambient_mesh() -> jax.sharding.Mesh | None:
+    """The mesh from an enclosing ``with mesh:`` block, if any."""
+    from jax._src import mesh as mesh_lib
+
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    return None if physical.empty else physical

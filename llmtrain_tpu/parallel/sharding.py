@@ -20,7 +20,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import jax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -70,26 +69,3 @@ def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_R
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
-
-
-def make_global_batch(
-    mesh: Mesh,
-    batch_parts: dict[str, Any],
-    *,
-    with_accum_dim: bool = False,
-    fetch,
-):
-    """Assemble a global device array from host data via per-shard callbacks.
-
-    ``fetch(key, index)`` must return the numpy block for ``index`` (a tuple
-    of slices into the global shape). Using ``make_array_from_callback``
-    keeps this correct for ANY device order / process layout — each process
-    materializes exactly its addressable shards.
-    """
-    sharding = batch_sharding(mesh, with_accum_dim=with_accum_dim)
-    out = {}
-    for key, global_shape in batch_parts.items():
-        out[key] = jax.make_array_from_callback(
-            tuple(global_shape), sharding, lambda index, k=key: fetch(k, index)
-        )
-    return out

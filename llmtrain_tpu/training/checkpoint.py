@@ -35,9 +35,34 @@ _REQUIRED_KEYS = {"checkpoint_version", "step", "params", "opt_state", "config_y
 
 
 def _to_host(tree: Any) -> Any:
-    """Unbox metadata and materialize every leaf as host numpy."""
+    """Unbox metadata and materialize every leaf as host numpy.
+
+    Multi-host sharded leaves (FSDP/TP params whose shards live on other
+    processes) are gathered with ``process_allgather`` — a collective, so
+    EVERY process must call this; only the main process then writes (see
+    Trainer.fit's save path).
+    """
     unboxed = nn_meta.unbox(tree)
-    return jax.tree.map(lambda x: np.asarray(x), unboxed)
+
+    def fetch(x: Any) -> np.ndarray:
+        if isinstance(x, jax.Array) and not (
+            x.is_fully_addressable or x.is_fully_replicated
+        ):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return jax.tree.map(fetch, unboxed)
+
+
+def state_to_host(state: Any) -> dict[str, Any]:
+    """Collective-safe host materialization of a TrainState's saved fields."""
+    return {
+        "step": int(state.step),
+        "params": serialization.to_state_dict(_to_host(state.params)),
+        "opt_state": serialization.to_state_dict(_to_host(state.opt_state)),
+    }
 
 
 class CheckpointError(Exception):
@@ -54,13 +79,24 @@ class CheckpointManager:
         return self._dir
 
     def save(self, step: int, state: Any, resolved_config: dict[str, Any]) -> Path:
-        """Serialize (step, params, opt_state, config) to ``step_{step:06d}.ckpt``."""
+        """Serialize (step, params, opt_state, config) to ``step_{step:06d}.ckpt``.
+
+        Single-host convenience wrapper; multi-host callers run
+        ``state_to_host`` on every process and pass the result to
+        ``save_host`` on the main process only.
+        """
+        host_state = state_to_host(state)
+        return self.save_host(step, host_state, resolved_config)
+
+    def save_host(
+        self, step: int, host_state: dict[str, Any], resolved_config: dict[str, Any]
+    ) -> Path:
         self._dir.mkdir(parents=True, exist_ok=True)
         payload = {
             "checkpoint_version": CHECKPOINT_VERSION,
             "step": np.int64(step),
-            "params": serialization.to_state_dict(_to_host(state.params)),
-            "opt_state": serialization.to_state_dict(_to_host(state.opt_state)),
+            "params": host_state["params"],
+            "opt_state": host_state["opt_state"],
             "config_yaml": yaml.safe_dump(resolved_config, sort_keys=False),
         }
         target = self._dir / f"step_{step:06d}.ckpt"

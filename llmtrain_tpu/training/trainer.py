@@ -252,7 +252,7 @@ class Trainer:
         run_key = jax.random.key(cfg.run.seed)
         tokens_per_step = accum * self._global_micro * self._probe_seqlen(train_ds)
 
-        self._tracker.log_params(yaml.safe_load(yaml.safe_dump(cfg.model_dump())))
+        self._tracker.log_params(cfg.model_dump())
 
         first_step_loss: float | None = None
         final_val_loss: float | None = None
@@ -282,10 +282,8 @@ class Trainer:
                 if step == 1:
                     first_step_loss = float(jax.device_get(metrics["loss"]))
 
-                if self._ckpt_mgr is not None and self._is_main and (
-                    step % save_every == 0 or step == max_steps
-                ):
-                    self._ckpt_mgr.save(step, self._state, cfg.model_dump())
+                if step % save_every == 0 or step == max_steps:
+                    self._save_checkpoint(step)
 
                 if step % log_every == 0 or step == max_steps:
                     interval_time = time.perf_counter() - interval_start
@@ -328,6 +326,20 @@ class Trainer:
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]
+
+    def _save_checkpoint(self, step: int) -> None:
+        """Host-gather on every process (collective for multi-host sharded
+        params), write on the main process only (reference trainer.py:402-406)."""
+        multi_process = (
+            self._dist_state is not None and self._dist_state.num_processes > 1
+        )
+        if self._ckpt_mgr is None and not multi_process:
+            return
+        from .checkpoint import state_to_host
+
+        host_state = state_to_host(self._state)
+        if self._ckpt_mgr is not None and self._is_main:
+            self._ckpt_mgr.save_host(step, host_state, self._cfg.model_dump())
 
     # ------------------------------------------------------------------ metrics
 
@@ -425,7 +437,11 @@ class Trainer:
 
             def fetch(key, index, pad=pad):
                 b_sl, t_sl = index
-                block = val_ds.get_examples(indices[b_sl])[key][:, t_sl]
+                examples = val_ds.get_examples(indices[b_sl])
+                if key == "attention_mask" and key not in examples:
+                    block = np.ones_like(examples["input_ids"][:, t_sl])
+                else:
+                    block = examples[key][:, t_sl]
                 if pad and key == "attention_mask":
                     # Zero the attention mask of padded rows in this shard.
                     # Unsharded dims arrive as slice(None) — default the bounds.
@@ -436,11 +452,16 @@ class Trainer:
                     block[row_ids >= eval_bs - pad] = 0
                 return block
 
+            # Always include an attention_mask: padded duplicate rows must be
+            # zero-masked or they'd be counted in the token-weighted val loss
+            # even for datasets that don't produce masks themselves.
+            ds_keys = self._dataset_spec(val_ds)[0]
+            batch_keys = set(ds_keys) | {"attention_mask"}
             batch = {
                 key: jax.make_array_from_callback(
                     (eval_bs, seqlen), sharding, lambda i, k=key: fetch(k, i)
                 )
-                for key in self._dataset_spec(val_ds)[0]
+                for key in batch_keys
             }
             loss_sum, tokens = self._eval_step_fn(
                 nn_meta.unbox(self._state.params), batch
