@@ -1,0 +1,56 @@
+.PHONY: test test-all train-smoke train-multiproc bench mlflow \
+	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
+	k8s-full k8s-e2e
+
+test:
+	python -m pytest tests/ -q -m "not slow"
+
+test-all:
+	python -m pytest tests/ -q
+
+train-smoke:
+	JAX_PLATFORMS=cpu python -m llmtrain_tpu train --config configs/presets/gpt_smoke.yaml
+
+# Two real OS processes forming a JAX distributed runtime on localhost
+# (the analogue of the reference's `torchrun --nproc_per_node=2`).
+train-multiproc:
+	JAX_PLATFORMS=cpu WORLD_SIZE=2 MASTER_ADDR=127.0.0.1 MASTER_PORT=29511 \
+		bash -c 'RANK=1 python -m llmtrain_tpu train --config configs/presets/ddp_smoke.yaml & \
+		RANK=0 python -m llmtrain_tpu train --config configs/presets/ddp_smoke.yaml; wait'
+
+bench:
+	python bench.py
+
+mlflow:
+	mlflow ui --backend-store-uri sqlite:///./mlflow.db
+
+# --------------------------------------------------------------------------
+# Kubernetes (kind) targets
+# --------------------------------------------------------------------------
+
+k8s-cluster:
+	mkdir -p runs mlflow-k8s
+	kind create cluster --name llmtrain-tpu --config k8s/kind-config.yaml
+
+k8s-cluster-delete:
+	kind delete cluster --name llmtrain-tpu
+
+k8s-build:
+	docker build -t llmtrain-tpu:dev -f k8s/Dockerfile .
+	kind load docker-image llmtrain-tpu:dev --name llmtrain-tpu
+
+k8s-train:
+	kubectl apply -f k8s/rbac.yaml -f k8s/storage.yaml -f k8s/configmap.yaml \
+		-f k8s/service.yaml -f k8s/job.yaml
+
+k8s-logs:
+	kubectl logs -l app=llmtrain-tpu --all-containers --prefix -f
+
+k8s-clean:
+	kubectl delete -f k8s/job.yaml -f k8s/service.yaml -f k8s/configmap.yaml \
+		-f k8s/storage.yaml -f k8s/rbac.yaml --ignore-not-found
+
+k8s-full: k8s-cluster k8s-build k8s-train k8s-logs
+
+k8s-e2e:
+	bash k8s/test_e2e.sh

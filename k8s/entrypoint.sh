@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# JAX distributed bootstrap for IndexedJob pods.
+#
+# Derives the env vars llmtrain_tpu.distributed.setup_distributed resolves
+# (JAX_PROCESS_ID / JAX_NUM_PROCESSES / JAX_COORDINATOR_ADDRESS) from the
+# IndexedJob controller's JOB_COMPLETION_INDEX. The coordinator (process 0)
+# advertises its own pod IP; other processes discover it by polling the
+# Kubernetes API for the index-0 pod of the same job (RBAC: k8s/rbac.yaml).
+#
+# On a GKE TPU pod slice this script is NOT needed: the TPU runtime env
+# (TPU_WORKER_ID/TPU_WORKER_HOSTNAMES) lets jax.distributed.initialize()
+# auto-detect the topology — see k8s/job-tpu-v5e.yaml, which execs the CLI
+# directly.
+set -euo pipefail
+
+CONFIG_PATH="${LLMTRAIN_CONFIG:-/config/train.yaml}"
+COORD_PORT="${COORDINATOR_PORT:-29500}"
+
+if [ -z "${JOB_COMPLETION_INDEX:-}" ]; then
+    echo "entrypoint: JOB_COMPLETION_INDEX missing — not an IndexedJob pod" >&2
+    exit 1
+fi
+if [ -z "${NUM_PROCESSES:-}" ]; then
+    echo "entrypoint: NUM_PROCESSES missing (set in the Job spec)" >&2
+    exit 1
+fi
+
+export JAX_PROCESS_ID="$JOB_COMPLETION_INDEX"
+export JAX_NUM_PROCESSES="$NUM_PROCESSES"
+
+discover_coordinator_ip() {
+    # Poll the K8s API for the index-0 pod's IP using the mounted
+    # serviceaccount credentials. Prints the IP on success.
+    local sa=/var/run/secrets/kubernetes.io/serviceaccount
+    local ns token url
+    ns="$(cat "$sa/namespace")"
+    token="$(cat "$sa/token")"
+    url="https://kubernetes.default.svc/api/v1/namespaces/${ns}/pods"
+    url="${url}?labelSelector=batch.kubernetes.io/job-completion-index%3D0,job-name%3D${JOB_NAME:?JOB_NAME must be set}"
+
+    local tries=60 ip=""
+    for i in $(seq 1 "$tries"); do
+        ip="$(curl -sf --cacert "$sa/ca.crt" -H "Authorization: Bearer ${token}" "$url" \
+            | python3 -c 'import json,sys
+items = json.load(sys.stdin).get("items", [])
+print(items[0]["status"].get("podIP", "") if items else "")' || true)"
+        if [ -n "$ip" ]; then
+            echo "$ip"
+            return 0
+        fi
+        echo "entrypoint: waiting for coordinator pod IP ($i/$tries)" >&2
+        sleep 2
+    done
+    return 1
+}
+
+if [ "$JAX_PROCESS_ID" -eq 0 ]; then
+    : "${POD_IP:?POD_IP must be injected via the downward API}"
+    export JAX_COORDINATOR_ADDRESS="${POD_IP}:${COORD_PORT}"
+else
+    ip="$(discover_coordinator_ip)" || {
+        echo "entrypoint: coordinator discovery failed" >&2
+        exit 1
+    }
+    export JAX_COORDINATOR_ADDRESS="${ip}:${COORD_PORT}"
+fi
+
+echo "entrypoint: process ${JAX_PROCESS_ID}/${JAX_NUM_PROCESSES} coordinator=${JAX_COORDINATOR_ADDRESS}"
+echo "entrypoint: exec python -m llmtrain_tpu train --config ${CONFIG_PATH}"
+exec python -m llmtrain_tpu train --config "$CONFIG_PATH"

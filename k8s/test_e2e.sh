@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# End-to-end cluster test: kind cluster -> build+load image -> IndexedJob ->
+# assert training completed, every pod exited 0, and artifacts reached the
+# host through the hostPath PV chain.
+#
+#   bash k8s/test_e2e.sh               # full run, cleans up on exit
+#   bash k8s/test_e2e.sh --no-cleanup  # leave the cluster up for debugging
+#
+# Needs: docker, kind, kubectl.
+set -euo pipefail
+
+CLUSTER=llmtrain-tpu
+IMAGE=llmtrain-tpu:dev
+JOB=llmtrain-tpu
+TIMEOUT=300s
+KEEP=false
+[ "${1:-}" = "--no-cleanup" ] && KEEP=true
+
+MANIFESTS=(k8s/rbac.yaml k8s/storage.yaml k8s/configmap.yaml k8s/service.yaml k8s/job.yaml)
+FAILURES=0
+
+say()  { printf '==> %s\n' "$*"; }
+pass() { printf '  PASS: %s\n' "$*"; }
+fail() { printf '  FAIL: %s\n' "$*" >&2; FAILURES=$((FAILURES + 1)); }
+
+finish() {
+    if [ "$KEEP" = true ]; then
+        say "--no-cleanup: cluster '$CLUSTER' left running"
+        return
+    fi
+    say "cleaning up"
+    kubectl delete "${MANIFESTS[@]/#/-f}" --ignore-not-found >/dev/null 2>&1 || true
+    kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
+}
+
+say "creating kind cluster '$CLUSTER'"
+mkdir -p runs mlflow-k8s
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+    kind create cluster --name "$CLUSTER" --config k8s/kind-config.yaml
+fi
+trap finish EXIT
+
+say "building and loading image '$IMAGE'"
+docker build -t "$IMAGE" -f k8s/Dockerfile .
+kind load docker-image "$IMAGE" --name "$CLUSTER"
+
+say "applying manifests"
+kubectl delete -f k8s/job.yaml --ignore-not-found >/dev/null 2>&1 || true
+for m in "${MANIFESTS[@]}"; do kubectl apply -f "$m"; done
+
+say "waiting for job/$JOB (timeout $TIMEOUT)"
+kubectl wait --for=condition=complete --timeout="$TIMEOUT" "job/$JOB"
+
+say "collecting pod logs"
+kubectl logs -l "app=$JOB" --all-containers --prefix || true
+POD0=$(kubectl get pods \
+    -l "app=$JOB,batch.kubernetes.io/job-completion-index=0" \
+    -o jsonpath='{.items[0].metadata.name}')
+LOGS0=$(kubectl logs "$POD0")
+
+say "asserting rank-0 output"
+grep -q "final_step" <<<"$LOGS0" \
+    && pass "rank-0 logs report final_step" \
+    || fail "no final_step in rank-0 logs"
+grep -q "entrypoint: exec python" <<<"$LOGS0" \
+    && pass "entrypoint exec line present" \
+    || fail "entrypoint exec line missing"
+
+say "asserting pod exit codes"
+while IFS=$'\t' read -r name code; do
+    [ -z "$name" ] && continue
+    if [ "$code" = "0" ]; then pass "$name exited 0"; else fail "$name exited ${code:-?}"; fi
+done < <(kubectl get pods -l "app=$JOB" -o jsonpath='{range .items[*]}{.metadata.name}{"\t"}{.status.containerStatuses[0].state.terminated.exitCode}{"\n"}{end}')
+
+say "asserting host artifacts"
+RUN_DIR=$(find ./runs -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)
+if [ -n "$RUN_DIR" ]; then
+    pass "run dir $RUN_DIR exists"
+    for rel in checkpoints logs/train.log config.yaml meta.json; do
+        [ -e "$RUN_DIR/$rel" ] && pass "$rel present" || fail "$rel missing in $RUN_DIR"
+    done
+else
+    fail "no run directory under ./runs"
+fi
+[ -s ./mlflow-k8s/mlflow.db ] && pass "mlflow.db non-empty" || fail "mlflow.db missing/empty"
+
+if [ "$FAILURES" -eq 0 ]; then
+    say "E2E SUCCEEDED"
+else
+    say "E2E FAILED ($FAILURES assertion(s)); re-run with --no-cleanup to debug"
+    exit 1
+fi

@@ -134,6 +134,20 @@ def configure_platform(device: str) -> None:
         get_logger().warning("could not pin jax platform to cpu: %s", exc)
 
 
+def _tpu_autodetect_available(cfg: DistributedConfig) -> bool:
+    """True when a MULTI-host TPU pod-slice env can drive a bare
+    ``initialize()`` and no explicit topology was given (explicit env/config
+    always wins). Single-host slices need no distributed init at all."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) < 2:
+        return False
+    explicit = (
+        _env_int("JAX_NUM_PROCESSES", "WORLD_SIZE") is not None
+        or cfg.num_processes is not None
+    )
+    return not explicit
+
+
 def setup_distributed(cfg: DistributedConfig) -> DistState:
     """Initialize the JAX distributed runtime (idempotent).
 
@@ -148,6 +162,29 @@ def setup_distributed(cfg: DistributedConfig) -> DistState:
     if _ACTIVE_STATE is not None:
         logger.warning("distributed runtime already initialized; returning existing state")
         return _ACTIVE_STATE
+
+    if _tpu_autodetect_available(cfg):
+        # GKE TPU pod slice: the TPU runtime env (TPU_WORKER_ID /
+        # TPU_WORKER_HOSTNAMES, injected by the GKE webhook) lets JAX derive
+        # coordinator + process ids itself — no explicit topology needed.
+        jax.distributed.initialize()
+        _JAX_DIST_INITIALIZED = True
+        state = DistState(
+            process_index=jax.process_index(),
+            num_processes=jax.process_count(),
+            local_device_count=jax.local_device_count(),
+            is_main=jax.process_index() == 0,
+            coordinator=None,
+        )
+        _ACTIVE_STATE = state
+        logger.info(
+            "distributed runtime auto-initialized from TPU environment: "
+            "process %d/%d, %d local device(s)",
+            state.process_index,
+            state.num_processes,
+            state.local_device_count,
+        )
+        return state
 
     process_id, num_processes, coordinator = resolve_topology(cfg)
 

@@ -102,6 +102,27 @@ class TestSetup:
         with pytest.raises(ValueError, match="coordinator"):
             setup_distributed(DistributedConfig(num_processes=2, process_id=0))
 
+    def test_tpu_autodetect_gate(self, monkeypatch):
+        """Bare jax.distributed.initialize() only for MULTI-host TPU slices
+        with no explicit topology (the GKE pod-slice path, docs/k8s.md)."""
+        from llmtrain_tpu.distributed import _tpu_autodetect_available
+
+        cfg = DistributedConfig()
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        assert not _tpu_autodetect_available(cfg)
+        # Single-host slice (what the axon tunnel env looks like): no init.
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        assert not _tpu_autodetect_available(cfg)
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1,host-2,host-3")
+        assert _tpu_autodetect_available(cfg)
+        # Explicit topology always wins over auto-detection.
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        assert not _tpu_autodetect_available(cfg)
+        monkeypatch.delenv("WORLD_SIZE")
+        assert not _tpu_autodetect_available(
+            DistributedConfig(num_processes=4, process_id=0)
+        )
+
 
 class TestMesh:
     def test_wildcard_resolution(self):
