@@ -1,0 +1,190 @@
+"""Autoregressive sampling from a trained model.
+
+Parity target: the reference exposes generation only inside
+``notebooks/trained_vs_random_completion.ipynb`` (``generate_text`` /
+``top_next_tokens`` cells) — an eager python loop calling the model per
+token. Here decoding is a first-class module and ONE jit-compiled program:
+a ``lax.fori_loop`` over a fixed-size token buffer with a sliding
+``dynamic_slice`` context window, so every step reuses the same compiled
+forward (no per-step retrace, static shapes throughout, runs on the MXU).
+
+No KV cache yet: each step re-runs the full forward over the window. For
+the small-context models this framework targets that is compile-simple and
+fast; a decode cache is a later optimization, not a parity requirement.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "window_len", "temperature", "top_k"),
+)
+def _generate_jit(
+    model: Any,
+    params: Any,
+    buffer: jax.Array,  # (B, L) prompt left-aligned, zero-padded
+    prompt_len: jax.Array,  # (B,) int32
+    rng: jax.Array,
+    *,
+    max_new_tokens: int,
+    window_len: int,
+    temperature: float,
+    top_k: int | None,
+    eos_token_id: int | None = None,
+) -> jax.Array:
+    total_len = buffer.shape[1]
+
+    def step(i, carry):
+        buf, done = carry
+        cur = prompt_len + i  # (B,) next position to fill
+
+        # Fixed-size context window ending at the longest current position.
+        # Rows with shorter prompts read their logits at their own last
+        # token's index inside the window.
+        hi = jnp.max(cur)
+        start = jnp.clip(hi - window_len, 0, total_len - window_len)
+        window = jax.lax.dynamic_slice(
+            buf, (0, start), (buf.shape[0], window_len)
+        )
+        mask = (start + jnp.arange(window_len))[None, :] < cur[:, None]
+        logits = model.apply(
+            {"params": params},
+            window,
+            mask.astype(jnp.int32),
+            deterministic=True,
+        )  # (B, W, V)
+        last_idx = jnp.clip(cur - 1 - start, 0, window_len - 1)
+        next_logits = jnp.take_along_axis(
+            logits, last_idx[:, None, None], axis=1
+        )[:, 0, :].astype(jnp.float32)
+
+        if temperature == 0.0:
+            next_tok = jnp.argmax(next_logits, axis=-1)
+        else:
+            scaled = next_logits / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(scaled, top_k)[0][:, -1, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            step_rng = jax.random.fold_in(rng, i)
+            next_tok = jax.random.categorical(step_rng, scaled, axis=-1)
+        next_tok = next_tok.astype(buf.dtype)
+
+        if eos_token_id is not None:
+            next_tok = jnp.where(done, jnp.asarray(eos_token_id, buf.dtype), next_tok)
+            done = done | (next_tok == eos_token_id)
+
+        buf = jax.vmap(
+            lambda row, pos, tok: jax.lax.dynamic_update_slice(row, tok[None], (pos,))
+        )(buf, cur, next_tok)
+        return buf, done
+
+    done0 = jnp.zeros((buffer.shape[0],), jnp.bool_)
+    buffer, _ = jax.lax.fori_loop(0, max_new_tokens, step, (buffer, done0))
+    return buffer
+
+
+def generate(
+    model: Any,
+    params: Any,
+    prompt_ids: np.ndarray | jax.Array,  # (B, Tp) or (Tp,)
+    *,
+    max_new_tokens: int,
+    rng: jax.Array | None = None,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    eos_token_id: int | None = None,
+) -> np.ndarray:
+    """Sample ``max_new_tokens`` continuations; returns (B, Tp+max_new_tokens).
+
+    ``temperature=0`` decodes greedily; otherwise categorical sampling with
+    optional top-k filtering. The context window slides over the model's
+    ``block_size`` for prompts near the limit.
+    """
+    ids = np.asarray(prompt_ids, dtype=np.int32)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, tp = ids.shape
+    if tp == 0:
+        raise ValueError("prompt must contain at least one token")
+    total = tp + max_new_tokens
+
+    block_size = int(getattr(model, "block_size", total))
+    window_len = min(block_size, total)
+
+    buffer = np.zeros((b, total), dtype=np.int32)
+    buffer[:, :tp] = ids
+    prompt_len = jnp.full((b,), tp, jnp.int32)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    out = _generate_jit(
+        model,
+        params,
+        jnp.asarray(buffer),
+        prompt_len,
+        rng,
+        max_new_tokens=max_new_tokens,
+        window_len=window_len,
+        temperature=float(temperature),
+        top_k=top_k,
+        eos_token_id=eos_token_id,
+    )
+    return np.asarray(jax.device_get(out))
+
+
+def generate_text(
+    model: Any,
+    params: Any,
+    tokenizer: Any,
+    prompt: str,
+    *,
+    max_new_tokens: int = 48,
+    temperature: float = 0.8,
+    top_k: int | None = 40,
+    seed: int = 1234,
+) -> str:
+    """Tokenize → sample → decode (the notebook ``generate_text`` contract)."""
+    ids = np.asarray(tokenizer.encode(prompt), dtype=np.int32)
+    out = generate(
+        model,
+        params,
+        ids,
+        max_new_tokens=max_new_tokens,
+        rng=jax.random.key(seed),
+        temperature=temperature,
+        top_k=top_k,
+    )
+    return tokenizer.decode([int(t) for t in out[0]])
+
+
+def top_next_tokens(
+    model: Any,
+    params: Any,
+    tokenizer: Any,
+    text: str,
+    *,
+    k: int = 10,
+) -> list[tuple[str, float]]:
+    """The k most likely next tokens with probabilities (notebook parity)."""
+    ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
+    block_size = int(getattr(model, "block_size", len(ids)))
+    window = jnp.asarray(ids[-block_size:][None, :])
+    logits = model.apply({"params": params}, window, deterministic=True)
+    probs = jax.nn.softmax(logits[0, -1].astype(jnp.float32))
+    k = min(k, probs.shape[-1])
+    top_p, top_i = jax.lax.top_k(probs, k)
+    return [
+        (tokenizer.decode([int(i)]), float(p))
+        for i, p in zip(np.asarray(top_i), np.asarray(top_p))
+    ]
+
+
+__all__ = ["generate", "generate_text", "top_next_tokens"]

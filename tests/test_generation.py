@@ -1,0 +1,125 @@
+"""Sampling-loop behavior: greedy determinism, shapes, window sliding, eos."""
+
+import jax
+import numpy as np
+import pytest
+
+from llmtrain_tpu.generation import generate, generate_text, top_next_tokens
+from llmtrain_tpu.models.gpt import GPT
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = GPT(
+        vocab_size=64,
+        block_size=16,
+        d_model=32,
+        n_layers=1,
+        n_heads=4,
+        d_ff=64,
+        dropout=0.0,
+    )
+    tokens = np.zeros((1, 4), np.int32)
+    params = model.init({"params": jax.random.key(0)}, tokens, deterministic=True)[
+        "params"
+    ]
+    return model, params
+
+
+class _ByteTokenizer:
+    def encode(self, text):
+        return [b % 64 for b in text.encode()]
+
+    def decode(self, ids):
+        return "".join(chr(97 + (i % 26)) for i in ids)
+
+
+class TestGenerate:
+    def test_shapes_and_prompt_preserved(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[1, 2, 3]], np.int32)
+        out = generate(model, params, prompt, max_new_tokens=5, temperature=0.0)
+        assert out.shape == (1, 8)
+        np.testing.assert_array_equal(out[:, :3], prompt)
+        assert ((out >= 0) & (out < 64)).all()
+
+    def test_greedy_deterministic(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[5, 9]], np.int32)
+        a = generate(model, params, prompt, max_new_tokens=6, temperature=0.0)
+        b = generate(model, params, prompt, max_new_tokens=6, temperature=0.0)
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampling_seed_reproducible(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[5, 9]], np.int32)
+        kw = dict(max_new_tokens=6, temperature=0.8, top_k=10)
+        a = generate(model, params, prompt, rng=jax.random.key(3), **kw)
+        b = generate(model, params, prompt, rng=jax.random.key(3), **kw)
+        c = generate(model, params, prompt, rng=jax.random.key(4), **kw)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)  # overwhelmingly likely for 6 tokens
+
+    def test_greedy_matches_stepwise_argmax(self, tiny_model):
+        """The fused loop must equal naive one-token-at-a-time decoding."""
+        model, params = tiny_model
+        prompt = np.array([[7, 3, 11]], np.int32)
+        out = generate(model, params, prompt, max_new_tokens=4, temperature=0.0)
+
+        ids = prompt.copy()
+        for _ in range(4):
+            logits = model.apply({"params": params}, ids, deterministic=True)
+            nxt = np.argmax(np.asarray(logits[:, -1]), axis=-1)
+            ids = np.concatenate([ids, nxt[:, None].astype(np.int32)], axis=1)
+        np.testing.assert_array_equal(out, ids)
+
+    def test_window_slides_past_block_size(self, tiny_model):
+        model, params = tiny_model  # block_size 16
+        prompt = np.arange(12, dtype=np.int32)[None, :] % 64
+        out = generate(model, params, prompt, max_new_tokens=10, temperature=0.0)
+        assert out.shape == (1, 22)  # > block_size: window slid, no raise
+
+    def test_eos_freezes_row(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[1, 2]], np.int32)
+        greedy = generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+        eos = int(greedy[0, 2])  # first generated token becomes "eos"
+        out = generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0, eos_token_id=eos
+        )
+        np.testing.assert_array_equal(out[0, 2:], np.full(8, eos))
+
+    def test_batch_decode(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[1, 2, 3], [9, 8, 7]], np.int32)
+        out = generate(model, params, prompt, max_new_tokens=4, temperature=0.0)
+        assert out.shape == (2, 7)
+        single = generate(model, params, prompt[1:], max_new_tokens=4, temperature=0.0)
+        np.testing.assert_array_equal(out[1], single[0])
+
+    def test_empty_prompt_rejected(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match="at least one token"):
+            generate(model, params, np.zeros((1, 0), np.int32), max_new_tokens=2)
+
+
+class TestTextHelpers:
+    def test_generate_text_roundtrip(self, tiny_model):
+        model, params = tiny_model
+        text = generate_text(
+            model,
+            params,
+            _ByteTokenizer(),
+            "hello",
+            max_new_tokens=4,
+            temperature=0.0,
+        )
+        assert isinstance(text, str) and len(text) == 9
+
+    def test_top_next_tokens(self, tiny_model):
+        model, params = tiny_model
+        top = top_next_tokens(model, params, _ByteTokenizer(), "abc", k=5)
+        assert len(top) == 5
+        probs = [p for _, p in top]
+        assert probs == sorted(probs, reverse=True)
+        assert all(0.0 <= p <= 1.0 for p in probs)
