@@ -7,6 +7,19 @@ shardings and collectives execute for real in a single process.
 """
 
 import os
+import sys
+
+# Make the in-repo package importable without an editable install, both here
+# and in every subprocess the tests spawn (CLI and multi-process tests run
+# ``python -m llmtrain_tpu`` from temp dirs).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+os.environ["PYTHONPATH"] = (
+    _REPO_ROOT + os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH")
+    else _REPO_ROOT
+)
 
 # Force CPU even when the host pre-sets JAX_PLATFORMS to a real TPU platform:
 # unit tests must be hermetic and use the 8-device virtual mesh. The host's
