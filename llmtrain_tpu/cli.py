@@ -70,6 +70,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="checkpoint file, checkpoint dir, or run id to resume from",
     )
 
+    gen = sub.add_parser(
+        "generate", help="sample completions from a trained checkpoint"
+    )
+    gen.add_argument("--config", required=True, help="path to the YAML run config")
+    gen.add_argument(
+        "--from",
+        dest="from_spec",
+        required=True,
+        help="checkpoint file, checkpoint dir, or run id to load params from",
+    )
+    prompt_group = gen.add_mutually_exclusive_group(required=True)
+    prompt_group.add_argument("--prompt", default=None, help="prompt text (needs a tokenizer)")
+    prompt_group.add_argument(
+        "--prompt-ids",
+        default=None,
+        help="comma-separated token ids, bypassing the tokenizer",
+    )
+    gen.add_argument("--max-new-tokens", type=int, default=48)
+    gen.add_argument(
+        "--temperature", type=float, default=0.8, help="0 decodes greedily"
+    )
+    gen.add_argument("--top-k", type=int, default=40, help="0 disables top-k filtering")
+    gen.add_argument("--seed", type=int, default=1234)
+    gen.add_argument("--json", action="store_true", help="emit the result as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -166,6 +191,116 @@ def _agree_flag(local_ok: bool, dist_state: DistState | None) -> bool:
 
     agreed = multihost_utils.broadcast_one_to_all(np.uint8(1 if local_ok else 0))
     return bool(np.asarray(agreed))
+
+
+def _handle_generate(args: argparse.Namespace) -> int:
+    """First-class serving path: checkpoint → jit-compiled sampling.
+
+    The reference exposes generation only as eager notebook cells
+    (reference notebooks/trained_vs_random_completion.ipynb); here it is a
+    CLI subcommand over the single-compile decode loop in
+    ``llmtrain_tpu.generation``.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    try:
+        import jax
+        import numpy as np
+        import yaml
+        from flax.linen import meta as nn_meta
+
+        from .generation import generate
+        from .training.checkpoint import load_inference_params, resolve_resume_path
+
+        initialize_registries()
+        adapter = get_model_adapter(cfg.model.name)()
+
+        tokenizer = None
+        try:
+            tokenizer = adapter.build_tokenizer(cfg)
+        except Exception as exc:  # offline environments: tokenizer optional
+            logger.warning("build_tokenizer failed (%s); continuing without one", exc)
+
+        try:
+            model = adapter.build_model(cfg)
+        except Exception:
+            if cfg.model.vocab_size is None and tokenizer is None:
+                # e.g. gpt derives vocab_size from the tokenizer, which this
+                # environment could not build (gpt.py:330-336).
+                _emit_error(
+                    "building the model needs a vocab size but no tokenizer is "
+                    "available; set model.vocab_size explicitly in the config"
+                )
+                return EXIT_TRAIN_FAILURE
+            raise
+
+        if args.prompt_ids is not None:
+            prompt_ids = np.asarray(
+                [int(t) for t in args.prompt_ids.split(",") if t.strip()],
+                dtype=np.int32,
+            )
+        else:
+            if tokenizer is None:
+                _emit_error(
+                    "no tokenizer available for --prompt; pass --prompt-ids instead"
+                )
+                return EXIT_TRAIN_FAILURE
+            prompt_ids = np.asarray(tokenizer.encode(args.prompt), dtype=np.int32)
+        if prompt_ids.size == 0:
+            _emit_error("prompt must contain at least one token")
+            return EXIT_TRAIN_FAILURE
+
+        ckpt_path = resolve_resume_path(args.from_spec, cfg.output.root_dir)
+        abstract = nn_meta.unbox(
+            jax.eval_shape(
+                lambda rng: adapter.init_params(model, cfg, rng), jax.random.key(0)
+            )
+        )
+        params, step = load_inference_params(
+            ckpt_path,
+            abstract,
+            expected_config_yaml=yaml.safe_dump(cfg.model_dump(), sort_keys=False),
+        )
+        logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
+
+        out = generate(
+            model,
+            params,
+            prompt_ids,
+            max_new_tokens=args.max_new_tokens,
+            rng=jax.random.key(args.seed),
+            temperature=args.temperature,
+            top_k=args.top_k,  # generate() maps <=0 to "disabled"
+        )
+        out_ids = [int(t) for t in out[0]]
+        text = tokenizer.decode(out_ids) if tokenizer is not None else None
+
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "checkpoint": str(ckpt_path),
+                        "step": step,
+                        "prompt_ids": [int(t) for t in prompt_ids],
+                        "completion_ids": out_ids,
+                        "text": text,
+                    }
+                )
+            )
+        else:
+            print(text if text is not None else " ".join(str(t) for t in out_ids))
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        logger.exception("generation failed: %s", exc)
+        _emit_error(f"generation failed: {exc}")
+        return EXIT_TRAIN_FAILURE
+    return EXIT_OK
 
 
 def _handle_train(args: argparse.Namespace) -> int:
@@ -290,6 +425,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     if args.command == "train":
         return _handle_train(args)
+    if args.command == "generate":
+        return _handle_generate(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

@@ -71,7 +71,8 @@ def _generate_jit(
         else:
             scaled = next_logits / temperature
             if top_k is not None:
-                kth = jax.lax.top_k(scaled, top_k)[0][:, -1, None]
+                k = min(top_k, scaled.shape[-1])
+                kth = jax.lax.top_k(scaled, k)[0][:, -1, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             step_rng = jax.random.fold_in(rng, i)
             next_tok = jax.random.categorical(step_rng, scaled, axis=-1)
@@ -108,12 +109,22 @@ def generate(
     optional top-k filtering. The context window slides over the model's
     ``block_size`` for prompts near the limit.
     """
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0; got {max_new_tokens}")
     ids = np.asarray(prompt_ids, dtype=np.int32)
     if ids.ndim == 1:
         ids = ids[None, :]
     b, tp = ids.shape
     if tp == 0:
         raise ValueError("prompt must contain at least one token")
+    vocab_size = getattr(model, "vocab_size", None)
+    if vocab_size is not None and (ids.min() < 0 or ids.max() >= vocab_size):
+        raise ValueError(
+            f"prompt token ids must be in [0, {vocab_size}); "
+            f"got range [{ids.min()}, {ids.max()}]"
+        )
+    if top_k is not None and top_k <= 0:
+        top_k = None  # CLI convention: 0 disables top-k filtering
     total = tp + max_new_tokens
 
     block_size = int(getattr(model, "block_size", total))

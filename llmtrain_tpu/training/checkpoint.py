@@ -141,6 +141,47 @@ class CheckpointManager:
         return payload
 
 
+def load_inference_params(
+    path: str | Path, abstract_params: Any, *, expected_config_yaml: str | None = None
+) -> tuple[Any, int]:
+    """Restore just the model params (no optimizer state) from a checkpoint.
+
+    ``abstract_params`` is an unboxed ``jax.eval_shape`` tree of the model's
+    parameters; it supplies the pytree structure that the flat state dict is
+    mapped back onto. Returns ``(params_on_device, step)`` — the inference
+    path for the ``generate`` CLI, which the reference only offers as eager
+    notebook cells (reference notebooks/trained_vs_random_completion.ipynb).
+
+    When ``expected_config_yaml`` is given and differs from the config stored
+    in the checkpoint, a warning is logged — the same warn-and-continue
+    contract as the resume path (reference trainer.py:315-318).
+    """
+    import jax.numpy as jnp
+
+    payload = CheckpointManager.load(path)
+    if expected_config_yaml is not None:
+        warn_on_config_mismatch(payload, expected_config_yaml, path)
+    host_params = serialization.from_state_dict(abstract_params, payload["params"])
+    params = jax.tree.map(jnp.asarray, host_params)
+    return params, int(payload["step"])
+
+
+def warn_on_config_mismatch(
+    payload: dict[str, Any], current_config_yaml: str, path: str | Path
+) -> None:
+    """Warn-and-continue when a checkpoint's stored config differs from the
+    current one (reference trainer.py:315-318) — shared by resume and the
+    ``generate`` inference loader."""
+    if payload["config_yaml"] != current_config_yaml:
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "checkpoint config differs from current config; "
+            "continuing with the CURRENT config (checkpoint: %s)",
+            path,
+        )
+
+
 def resolve_resume_path(resume_spec: str, output_root: str | Path) -> Path:
     """Resolve a ``--resume`` spec (reference trainer.py:215-241).
 

@@ -494,16 +494,13 @@ class Trainer:
         """Load a checkpoint into the live state; returns the restored step."""
         from flax import serialization
 
+        from .checkpoint import warn_on_config_mismatch
+
         path = resolve_resume_path(resume_spec, self._cfg.output.root_dir)
         payload = CheckpointManager.load(path)
-
-        current_yaml = yaml.safe_dump(self._cfg.model_dump(), sort_keys=False)
-        if payload["config_yaml"] != current_yaml:
-            logger.warning(
-                "checkpoint config differs from current config; "
-                "continuing with the CURRENT config (checkpoint: %s)",
-                path,
-            )
+        warn_on_config_mismatch(
+            payload, yaml.safe_dump(self._cfg.model_dump(), sort_keys=False), path
+        )
 
         step = int(payload["step"])
         host_params = serialization.from_state_dict(

@@ -166,6 +166,85 @@ class TestTrain:
         assert "training failed" in err["error"]
 
 
+class TestGenerate:
+    def test_generate_from_trained_run(self, workdir):
+        first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runG"], workdir)
+        assert first.returncode == 0, first.stderr
+        proc = _run(
+            [
+                "generate",
+                "--config",
+                "config.yaml",
+                "--from",
+                "runG",
+                "--prompt-ids",
+                "1,2,3",
+                "--max-new-tokens",
+                "4",
+                "--temperature",
+                "0",
+                "--json",
+            ],
+            workdir,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["step"] == 6
+        assert out["prompt_ids"] == [1, 2, 3]
+        assert len(out["completion_ids"]) == 7
+        assert out["completion_ids"][:3] == [1, 2, 3]
+        assert all(0 <= t < CFG["model"]["vocab_size"] for t in out["completion_ids"])
+        # dummy adapter has no tokenizer -> no decoded text
+        assert out["text"] is None
+
+    def test_generate_greedy_is_deterministic(self, workdir):
+        first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runH"], workdir)
+        assert first.returncode == 0, first.stderr
+        args = [
+            "generate",
+            "--config",
+            "config.yaml",
+            "--from",
+            str(workdir / "runs" / "runH" / "checkpoints"),
+            "--prompt-ids",
+            "5,6",
+            "--max-new-tokens",
+            "3",
+            "--temperature",
+            "0",
+            "--json",
+        ]
+        a, b = _run(args, workdir), _run(args, workdir)
+        assert a.returncode == 0 and b.returncode == 0, a.stderr + b.stderr
+        assert json.loads(a.stdout)["completion_ids"] == json.loads(b.stdout)["completion_ids"]
+
+    def test_generate_missing_checkpoint_exit_1(self, workdir):
+        proc = _run(
+            [
+                "generate",
+                "--config",
+                "config.yaml",
+                "--from",
+                "no-such-run",
+                "--prompt-ids",
+                "1",
+            ],
+            workdir,
+        )
+        assert proc.returncode == 1
+        assert "generation failed" in proc.stderr
+
+    def test_generate_prompt_without_tokenizer_exit_1(self, workdir):
+        first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runI"], workdir)
+        assert first.returncode == 0, first.stderr
+        proc = _run(
+            ["generate", "--config", "config.yaml", "--from", "runI", "--prompt", "hi"],
+            workdir,
+        )
+        assert proc.returncode == 1
+        assert "prompt-ids" in proc.stderr
+
+
 class TestPresets:
     def test_all_presets_validate(self, workdir):
         import pathlib

@@ -60,6 +60,41 @@ class TestGenerate:
         np.testing.assert_array_equal(a, b)
         assert not np.array_equal(a, c)  # overwhelmingly likely for 6 tokens
 
+    def test_top_k_larger_than_vocab_is_clamped(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[5, 9]], np.int32)
+        out = generate(
+            model,
+            params,
+            prompt,
+            max_new_tokens=4,
+            temperature=0.8,
+            top_k=1000,  # vocab is 64; must clamp, not raise
+            rng=jax.random.key(0),
+        )
+        assert out.shape == (1, 6)
+        assert ((out >= 0) & (out < 64)).all()
+
+    def test_top_k_zero_disables_filtering(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[5, 9]], np.int32)
+        a = generate(
+            model, params, prompt, max_new_tokens=4, temperature=0.8,
+            top_k=0, rng=jax.random.key(1),
+        )
+        b = generate(
+            model, params, prompt, max_new_tokens=4, temperature=0.8,
+            top_k=None, rng=jax.random.key(1),
+        )
+        np.testing.assert_array_equal(a, b)
+
+    def test_out_of_vocab_prompt_rejected(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match=r"\[0, 64\)"):
+            generate(model, params, np.array([[5, 99]], np.int32), max_new_tokens=2)
+        with pytest.raises(ValueError, match=r"\[0, 64\)"):
+            generate(model, params, np.array([[-1]], np.int32), max_new_tokens=2)
+
     def test_greedy_matches_stepwise_argmax(self, tiny_model):
         """The fused loop must equal naive one-token-at-a-time decoding."""
         model, params = tiny_model
