@@ -17,30 +17,20 @@ from __future__ import annotations
 import json
 import time
 
+import os
+
 import jax
+
+# Honour an explicit CPU request before backend init: on hosts whose
+# sitecustomize registers an accelerator PJRT plugin, the env var alone is
+# not enough (see llmtrain_tpu.distributed.configure_platform).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
-# bf16 peak FLOP/s per chip by TPU generation (scaling-book numbers).
-_TPU_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
 _MFU_TARGET = 0.30
-
-
-def _peak_flops() -> float:
-    if jax.default_backend() != "tpu":
-        return 2e11  # nominal host CPU peak; local smoke only
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in _TPU_PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return 197e12
 
 
 def main() -> None:
@@ -112,10 +102,12 @@ def main() -> None:
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * steps / elapsed
 
+    from llmtrain_tpu.utils.hw import mfu as compute_mfu
+
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
-    # Training FLOPs/token ~ 6N + 12*L*T*d (PaLM appendix B approximation).
-    flops_per_token = 6 * n_params + 12 * depth * seq * d_model
-    mfu = tokens_per_sec * flops_per_token / _peak_flops()
+    mfu = compute_mfu(
+        tokens_per_sec, n_params=n_params, n_layers=depth, seq_len=seq, d_model=d_model
+    )
 
     print(
         json.dumps(

@@ -279,8 +279,9 @@ def _handle_generate(args: argparse.Namespace) -> int:
             temperature=args.temperature,
             top_k=args.top_k,  # generate() maps <=0 to "disabled"
         )
-        out_ids = [int(t) for t in out[0]]
-        text = tokenizer.decode(out_ids) if tokenizer is not None else None
+        output_ids = [int(t) for t in out[0]]
+        completion_ids = output_ids[len(prompt_ids) :]  # newly generated only
+        text = tokenizer.decode(output_ids) if tokenizer is not None else None
 
         if args.json:
             print(
@@ -289,13 +290,14 @@ def _handle_generate(args: argparse.Namespace) -> int:
                         "checkpoint": str(ckpt_path),
                         "step": step,
                         "prompt_ids": [int(t) for t in prompt_ids],
-                        "completion_ids": out_ids,
+                        "completion_ids": completion_ids,
+                        "output_ids": output_ids,
                         "text": text,
                     }
                 )
             )
         else:
-            print(text if text is not None else " ".join(str(t) for t in out_ids))
+            print(text if text is not None else " ".join(str(t) for t in output_ids))
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         logger.exception("generation failed: %s", exc)
         _emit_error(f"generation failed: {exc}")

@@ -42,6 +42,8 @@ from ..parallel.sharding import (
 )
 from ..registry import get_data_module, get_model_adapter
 from ..tracking.base import Tracker
+from ..utils.hw import mfu as compute_mfu
+from ..utils.hw import peak_flops_per_chip
 from ..utils.logging import get_logger
 from .checkpoint import CheckpointManager, resolve_resume_path
 from .optimizer import build_optimizer, lr_schedule
@@ -136,6 +138,8 @@ class Trainer:
         self._param_count = int(
             sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
         )
+        self._peak_flops = peak_flops_per_chip()
+        self._train_seqlen = cfg.model.block_size  # refined from data in fit()
 
     # ------------------------------------------------------------------ setup
 
@@ -250,7 +254,9 @@ class Trainer:
             )
 
         run_key = jax.random.key(cfg.run.seed)
-        tokens_per_step = accum * self._global_micro * self._probe_seqlen(train_ds)
+        self._train_seqlen = self._probe_seqlen(train_ds)
+        tokens_per_step = accum * self._global_micro * self._train_seqlen
+        profiler = _StepProfiler(cfg, self._run_dir if self._is_main else None)
 
         self._tracker.log_params(cfg.model_dump())
 
@@ -268,8 +274,10 @@ class Trainer:
 
         with self._mesh, nn.logical_axis_rules(self._rules):
             for step in range(start_step, max_steps + 1):
+                profiler.maybe_start(step)
                 batch = self._global_batch(sampler, train_ds, step)
                 self._state, metrics = self._train_step_fn(self._state, batch, run_key)
+                profiler.maybe_stop(step, sync=metrics["loss"])
 
                 step_loss_dev = metrics["loss"]
                 interval_losses.append(metrics["loss"])
@@ -307,6 +315,7 @@ class Trainer:
                         final_val_metrics = val_metrics
                         final_val_loss = val_metrics.get("val/loss", final_val_loss)
 
+        profiler.close(sync=step_loss_dev)
         total_time = time.perf_counter() - start_time
         final_loss = float(jax.device_get(step_loss_dev)) if step_loss_dev is not None else 0.0
 
@@ -375,6 +384,17 @@ class Trainer:
         avg_step_time = interval_time / steps_in_interval if steps_in_interval else 0.0
         tokens_per_sec = interval_tokens / interval_time if interval_time > 0 else 0.0
         current_lr = float(jax.device_get(self._schedule(step - 1)))
+        # MFU from per-chip throughput — new observability over the reference,
+        # which only tracks tokens_per_sec (SURVEY §5/§6).
+        n_chips = self._mesh.devices.size
+        interval_mfu = compute_mfu(
+            tokens_per_sec / n_chips,
+            n_params=self._param_count,
+            n_layers=self._cfg.model.n_layers,
+            seq_len=self._train_seqlen,  # actual trained length, not block_size
+            d_model=self._cfg.model.d_model,
+            peak_flops=self._peak_flops,
+        )
 
         if self._is_main:
             if self._dp > 1:
@@ -397,18 +417,20 @@ class Trainer:
                     "train/tokens_per_sec": tokens_per_sec,
                     "train/step_time_sec": avg_step_time,
                     "train/tokens_total": float(total_tokens),
+                    "train/mfu": interval_mfu,
                 },
                 step=step,
             )
 
         logger.info(
-            "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs",
+            "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs  mfu=%.4f",
             step,
             max_steps,
             avg_loss,
             current_lr,
             tokens_per_sec,
             avg_step_time,
+            interval_mfu,
         )
 
     # ------------------------------------------------------------------ eval
@@ -528,6 +550,71 @@ class Trainer:
         if not stats:
             return 0.0
         return float(stats.get("peak_bytes_in_use", 0))
+
+
+class _StepProfiler:
+    """Optional ``jax.profiler`` trace over a window of training steps.
+
+    New capability over the reference (SURVEY §5: profiling absent there).
+    Enabled via the ``trainer.extra`` escape hatch — the same mechanism the
+    reference uses for ``keep_last_k`` (reference trainer.py:101):
+
+        trainer:
+          extra:
+            profile_start_step: 10   # 0/absent = disabled
+            profile_num_steps: 3
+
+    The trace (XPlane protos viewable in TensorBoard / xprof) lands in
+    ``{run_dir}/logs/profile``. Only the main process traces.
+    """
+
+    def __init__(self, cfg: RunConfig, run_dir: Path | None) -> None:
+        self._start_step = int(cfg.trainer.extra.get("profile_start_step", 0))
+        self._num_steps = max(1, int(cfg.trainer.extra.get("profile_num_steps", 3)))
+        self._dir = Path(run_dir) / "logs" / "profile" if run_dir is not None else None
+        self._active = False
+        self._begun_at: int | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._start_step > 0 and self._dir is not None
+
+    def maybe_start(self, step: int) -> None:
+        # ``>=`` not ``==``: a resumed run whose first step is already past
+        # the window start still traces (from its first step).
+        if (
+            not self.enabled
+            or self._active
+            or self._begun_at is not None
+            or step < self._start_step
+        ):
+            return
+        try:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(self._dir))
+            self._active = True
+            self._begun_at = step
+            logger.info("profiler trace started at step %d -> %s", step, self._dir)
+        except Exception as exc:  # profiling must never kill training
+            logger.warning("profiler start failed (%s); continuing without trace", exc)
+
+    def maybe_stop(self, step: int, sync: Any = None) -> None:
+        if not self._active or step < self._begun_at + self._num_steps - 1:
+            return
+        self.close(sync=sync)
+
+    def close(self, sync: Any = None) -> None:
+        if not self._active:
+            return
+        try:
+            if sync is not None:
+                jax.block_until_ready(sync)  # capture the full async dispatch
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", self._dir)
+        except Exception as exc:
+            logger.warning("profiler stop failed (%s)", exc)
+        finally:
+            self._active = False
 
 
 def _rebox_like(boxed_template: Any, values: Any) -> Any:

@@ -1,0 +1,69 @@
+"""Hardware peak-FLOPs lookup and MFU arithmetic.
+
+New capability over the reference (SURVEY §5: profiling/MFU absent there —
+``peak_memory`` is a hardcoded 0.0 at reference trainer.py:542). Peak numbers
+are bf16 per-chip figures by TPU generation; the CPU figure is a nominal
+placeholder so local smoke runs still produce a (meaningless in absolute
+terms, but trend-comparable) MFU.
+"""
+
+from __future__ import annotations
+
+# bf16 peak FLOP/s per chip by TPU generation.
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+CPU_NOMINAL_FLOPS = 2e11  # placeholder for local smoke runs
+_DEFAULT_TPU_FLOPS = 197e12
+
+
+def peak_flops_per_chip() -> float:
+    """Best-effort bf16 peak FLOP/s of one local device."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return CPU_NOMINAL_FLOPS
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return _DEFAULT_TPU_FLOPS
+
+
+def transformer_flops_per_token(
+    *, n_params: int, n_layers: int, seq_len: int, d_model: int
+) -> float:
+    """Training FLOPs/token ~ 6N + 12*L*T*d (PaLM appendix B approximation)."""
+    return 6.0 * n_params + 12.0 * n_layers * seq_len * d_model
+
+
+def mfu(
+    tokens_per_sec_per_chip: float,
+    *,
+    n_params: int,
+    n_layers: int,
+    seq_len: int,
+    d_model: int,
+    peak_flops: float | None = None,
+) -> float:
+    """Model FLOPs utilization of one chip at the given throughput."""
+    peak = peak_flops if peak_flops is not None else peak_flops_per_chip()
+    flops_per_token = transformer_flops_per_token(
+        n_params=n_params, n_layers=n_layers, seq_len=seq_len, d_model=d_model
+    )
+    return tokens_per_sec_per_chip * flops_per_token / peak
+
+
+__all__ = [
+    "TPU_PEAK_FLOPS",
+    "CPU_NOMINAL_FLOPS",
+    "peak_flops_per_chip",
+    "transformer_flops_per_token",
+    "mfu",
+]

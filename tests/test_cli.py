@@ -191,9 +191,9 @@ class TestGenerate:
         out = json.loads(proc.stdout)
         assert out["step"] == 6
         assert out["prompt_ids"] == [1, 2, 3]
-        assert len(out["completion_ids"]) == 7
-        assert out["completion_ids"][:3] == [1, 2, 3]
-        assert all(0 <= t < CFG["model"]["vocab_size"] for t in out["completion_ids"])
+        assert len(out["completion_ids"]) == 4  # newly generated only
+        assert out["output_ids"] == out["prompt_ids"] + out["completion_ids"]
+        assert all(0 <= t < CFG["model"]["vocab_size"] for t in out["output_ids"])
         # dummy adapter has no tokenizer -> no decoded text
         assert out["text"] is None
 

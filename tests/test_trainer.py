@@ -140,6 +140,18 @@ class TestLoggingCadence:
         assert "train/step_time_sec" in all_keys
         assert "train/tokens_total" in all_keys
         assert "train/lr" in all_keys
+        assert "train/mfu" in all_keys
+
+    def test_mfu_metric_positive_and_finite(self):
+        tracker = Mock()
+        cfg = _cfg(trainer={"max_steps": 10, "log_every_steps": 10})
+        Trainer(cfg, None, tracker, None).fit()
+        mfus = []
+        for call in tracker.log_metrics.call_args_list:
+            metrics = call.args[0] if call.args else call.kwargs["metrics"]
+            if "train/mfu" in metrics:
+                mfus.append(metrics["train/mfu"])
+        assert mfus and all(math.isfinite(m) and m > 0 for m in mfus)
 
     def test_params_logged_once(self):
         tracker = Mock()
@@ -169,3 +181,50 @@ class TestValEval:
         res = Trainer(cfg, None, NullTracker(), None).fit()
         assert res.val_metrics is not None
         assert np.isfinite(res.val_metrics["val/loss"])
+
+
+class TestProfiler:
+    def test_profile_window_writes_trace(self, tmp_path):
+        run_dir = tmp_path / "run"
+        (run_dir / "logs").mkdir(parents=True)
+        cfg = _cfg(
+            trainer={
+                "max_steps": 5,
+                "extra": {"profile_start_step": 2, "profile_num_steps": 2},
+            }
+        )
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        profile_dir = run_dir / "logs" / "profile"
+        assert profile_dir.is_dir()
+        assert any(profile_dir.rglob("*"))  # xplane trace files written
+
+    def test_profiler_disabled_by_default(self, tmp_path):
+        run_dir = tmp_path / "run"
+        (run_dir / "logs").mkdir(parents=True)
+        cfg = _cfg(trainer={"max_steps": 3})
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        assert not (run_dir / "logs" / "profile").exists()
+
+    def test_profile_window_past_max_steps_still_closes(self, tmp_path):
+        """Window extends past the end of training: close() must stop the trace."""
+        run_dir = tmp_path / "run"
+        (run_dir / "logs").mkdir(parents=True)
+        cfg = _cfg(
+            trainer={
+                "max_steps": 3,
+                "extra": {"profile_start_step": 2, "profile_num_steps": 100},
+            }
+        )
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        assert (run_dir / "logs" / "profile").is_dir()
+        # A second run must be able to start a fresh trace (no dangling session).
+        cfg2 = _cfg(
+            trainer={
+                "max_steps": 3,
+                "extra": {"profile_start_step": 1, "profile_num_steps": 1},
+            }
+        )
+        run_dir2 = tmp_path / "run2"
+        (run_dir2 / "logs").mkdir(parents=True)
+        Trainer(cfg2, run_dir2, NullTracker(), None).fit()
+        assert any((run_dir2 / "logs" / "profile").rglob("*"))

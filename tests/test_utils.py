@@ -90,3 +90,22 @@ def test_summary_json_and_text():
     text = format_run_summary(cfg, run_id="rid", run_dir=None, dry_run=True, as_json=False)
     assert isinstance(text, str) and text.startswith("Planned run:")
     assert "dummy_gpt" in text
+
+
+def test_hw_flops_and_mfu():
+    from llmtrain_tpu.utils import hw
+
+    # 6N dominates when L*T*d is small
+    fpt = hw.transformer_flops_per_token(
+        n_params=1000, n_layers=1, seq_len=2, d_model=4
+    )
+    assert fpt == 6 * 1000 + 12 * 1 * 2 * 4
+
+    # mfu is linear in throughput and inverse in peak
+    m = hw.mfu(
+        100.0, n_params=1000, n_layers=1, seq_len=2, d_model=4, peak_flops=1e6
+    )
+    assert m == pytest.approx(100.0 * fpt / 1e6)
+
+    # CPU backend in tests -> nominal placeholder peak
+    assert hw.peak_flops_per_chip() == hw.CPU_NOMINAL_FLOPS
