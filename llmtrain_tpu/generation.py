@@ -3,14 +3,17 @@
 Parity target: the reference exposes generation only inside
 ``notebooks/trained_vs_random_completion.ipynb`` (``generate_text`` /
 ``top_next_tokens`` cells) — an eager python loop calling the model per
-token. Here decoding is a first-class module and ONE jit-compiled program:
-a ``lax.fori_loop`` over a fixed-size token buffer with a sliding
-``dynamic_slice`` context window, so every step reuses the same compiled
-forward (no per-step retrace, static shapes throughout, runs on the MXU).
+token. Here decoding is a first-class module and ONE jit-compiled program.
 
-No KV cache yet: each step re-runs the full forward over the window. For
-the small-context models this framework targets that is compile-simple and
-fast; a decode cache is a later optimization, not a parity requirement.
+Two paths, chosen automatically:
+
+* **KV-cache decode** (models exposing ``for_decoding()``, e.g. GPT, with
+  the whole output fitting in ``block_size``): prefill writes the prompt's
+  keys/values into per-layer cache variables, then a ``lax.scan`` appends
+  one token per step — O(T) attention per step instead of O(T²) re-forward.
+* **Sliding-window re-forward** (fallback, any model): a ``lax.fori_loop``
+  over a fixed-size token buffer with a ``dynamic_slice`` context window.
+  Handles outputs longer than ``block_size`` and cache-less models.
 """
 
 from __future__ import annotations
@@ -21,6 +24,77 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _sample_next(
+    next_logits: jax.Array,  # (B, V) float32
+    rng: jax.Array,
+    i: jax.Array | int,
+    *,
+    temperature: float,
+    top_k: int | None,
+) -> jax.Array:
+    """One sampling decision, shared by both decode paths."""
+    if temperature == 0.0:
+        return jnp.argmax(next_logits, axis=-1)
+    scaled = next_logits / temperature
+    if top_k is not None:
+        k = min(top_k, scaled.shape[-1])
+        kth = jax.lax.top_k(scaled, k)[0][:, -1, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(jax.random.fold_in(rng, i), scaled, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_token_id"),
+)
+def _generate_cached_jit(
+    model: Any,  # decode-mode module (cache variables enabled)
+    params: Any,
+    cache: Any,  # zero-initialized cache pytree
+    prompt: jax.Array,  # (B, Tp) rectangular
+    rng: jax.Array,
+    *,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int | None,
+    eos_token_id: int | None,
+) -> jax.Array:
+    def apply(cache, tokens):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            deterministic=True,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits.astype(jnp.float32)
+
+    # Prefill: one forward over the whole prompt fills every layer's cache.
+    cache, logits = apply(cache, prompt)
+    tok0 = _sample_next(
+        logits[:, -1], rng, 0, temperature=temperature, top_k=top_k
+    ).astype(prompt.dtype)
+    done0 = jnp.zeros((prompt.shape[0],), jnp.bool_)
+    if eos_token_id is not None:
+        done0 = tok0 == eos_token_id
+
+    def step(carry, i):
+        cache, tok, done = carry
+        cache, logits = apply(cache, tok[:, None])
+        nxt = _sample_next(
+            logits[:, 0], rng, i, temperature=temperature, top_k=top_k
+        ).astype(tok.dtype)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
+            done = done | (nxt == eos_token_id)
+        return (cache, nxt, done), nxt
+
+    _, rest = jax.lax.scan(
+        step, (cache, tok0, done0), jnp.arange(1, max_new_tokens)
+    )  # rest: (max_new_tokens-1, B)
+    new_tokens = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1)
 
 
 @functools.partial(
@@ -66,17 +140,9 @@ def _generate_jit(
             logits, last_idx[:, None, None], axis=1
         )[:, 0, :].astype(jnp.float32)
 
-        if temperature == 0.0:
-            next_tok = jnp.argmax(next_logits, axis=-1)
-        else:
-            scaled = next_logits / temperature
-            if top_k is not None:
-                k = min(top_k, scaled.shape[-1])
-                kth = jax.lax.top_k(scaled, k)[0][:, -1, None]
-                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-            step_rng = jax.random.fold_in(rng, i)
-            next_tok = jax.random.categorical(step_rng, scaled, axis=-1)
-        next_tok = next_tok.astype(buf.dtype)
+        next_tok = _sample_next(
+            next_logits, rng, i, temperature=temperature, top_k=top_k
+        ).astype(buf.dtype)
 
         if eos_token_id is not None:
             next_tok = jnp.where(done, jnp.asarray(eos_token_id, buf.dtype), next_tok)
@@ -102,12 +168,15 @@ def generate(
     temperature: float = 1.0,
     top_k: int | None = None,
     eos_token_id: int | None = None,
+    use_cache: bool | None = None,
 ) -> np.ndarray:
     """Sample ``max_new_tokens`` continuations; returns (B, Tp+max_new_tokens).
 
     ``temperature=0`` decodes greedily; otherwise categorical sampling with
-    optional top-k filtering. The context window slides over the model's
-    ``block_size`` for prompts near the limit.
+    optional top-k filtering. ``use_cache=None`` auto-selects KV-cache decode
+    when the model supports it (``for_decoding()``) and the whole output fits
+    in ``block_size``; ``False`` forces the sliding-window re-forward path
+    (which also handles outputs longer than ``block_size``).
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0; got {max_new_tokens}")
@@ -129,12 +198,53 @@ def generate(
 
     block_size = int(getattr(model, "block_size", total))
     window_len = min(block_size, total)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    cache_capable = hasattr(model, "for_decoding") and total <= block_size
+    if use_cache is None:
+        use_cache = cache_capable
+    elif use_cache and not cache_capable:
+        if not hasattr(model, "for_decoding"):
+            raise ValueError(
+                "use_cache=True needs a model exposing for_decoding(); "
+                f"{type(model).__name__} does not"
+            )
+        raise ValueError(
+            "use_cache=True needs prompt+max_new_tokens <= block_size "
+            f"(got {total} > {block_size})"
+        )
+
+    if max_new_tokens == 0:
+        return ids.copy()
+
+    if use_cache:
+        decode_model = model.for_decoding()
+        # Zero cache pytree from an eval_shape trace — no param init work.
+        var_shapes = jax.eval_shape(
+            lambda: decode_model.init(
+                jax.random.key(0), jnp.zeros((b, 1), jnp.int32), deterministic=True
+            )
+        )
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), var_shapes["cache"]
+        )
+        out = _generate_cached_jit(
+            decode_model,
+            params,
+            cache,
+            jnp.asarray(ids),
+            rng,
+            max_new_tokens=max_new_tokens,
+            temperature=float(temperature),
+            top_k=top_k,
+            eos_token_id=eos_token_id,
+        )
+        return np.asarray(jax.device_get(out))
 
     buffer = np.zeros((b, total), dtype=np.int32)
     buffer[:, :tp] = ids
     prompt_len = jnp.full((b,), tp, jnp.int32)
-    if rng is None:
-        rng = jax.random.key(0)
 
     out = _generate_jit(
         model,

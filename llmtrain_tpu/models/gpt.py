@@ -59,6 +59,8 @@ class CausalSelfAttention(nn.Module):
     dtype: Any
     param_dtype: Any
     attention: str = "dense"
+    decode: bool = False  # autoregressive KV-cache mode (generation only)
+    cache_len: int = 0  # KV-cache capacity; block_size when decode=True
 
     @nn.compact
     def __call__(
@@ -86,7 +88,14 @@ class CausalSelfAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "length", "act_heads", "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
 
-        if self.attention == "flash":
+        if self.decode:
+            # KV-cache decode: append this call's keys/values at the cache
+            # cursor, attend over the filled prefix. One compiled program
+            # serves both prefill (T = prompt length) and per-token steps
+            # (T = 1) — new capability over the reference, whose notebook
+            # generation re-runs the full forward per token.
+            out = self._decode_attention(q, k, v)
+        elif self.attention == "flash":
             # Flash/ring modes are the packed-sequence fast path: padding
             # masks are NOT applied inside attention (the data pipeline emits
             # all-ones masks; the loss still respects the mask). Use 'dense'
@@ -130,6 +139,54 @@ class CausalSelfAttention(nn.Module):
             # (reference gpt.py:73-74).
             out = out * attention_mask[:, :, None].astype(out.dtype)
         return out
+
+    def _decode_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        """Cached causal attention: write k/v at the cursor, read the prefix.
+
+        q/k/v: (B, T, H, Dh) with T = tokens appended this call. The cache
+        holds ``cache_len`` positions; rows must share one sequence length
+        (generation batches rectangular prompts, generation.py:111-120).
+        """
+        if self.cache_len <= 0:
+            raise ValueError("decode=True requires cache_len > 0 (the block size)")
+        batch, t, n_heads, head_dim = q.shape
+        cached_key = self.variable(
+            "cache",
+            "cached_key",
+            jnp.zeros,
+            (batch, self.cache_len, n_heads, head_dim),
+            k.dtype,
+        )
+        cached_value = self.variable(
+            "cache",
+            "cached_value",
+            jnp.zeros,
+            (batch, self.cache_len, n_heads, head_dim),
+            v.dtype,
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+
+        idx = cache_index.value
+        cached_key.value = jax.lax.dynamic_update_slice(
+            cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
+        )
+        cached_value.value = jax.lax.dynamic_update_slice(
+            cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0)
+        )
+        cache_index.value = idx + t
+
+        keys, values = cached_key.value, cached_value.value
+        scale = 1.0 / math.sqrt(head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
+        scores = scores.astype(jnp.float32)
+        # Query at absolute position idx+i may see cache slots <= idx+i.
+        col = jnp.arange(self.cache_len)[None, None, None, :]
+        row = (idx + jnp.arange(t))[None, None, :, None]
+        scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
 
 
 def dense_attention(
@@ -179,6 +236,8 @@ class TransformerBlock(nn.Module):
     dtype: Any
     param_dtype: Any
     attention: str = "dense"
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(
@@ -202,6 +261,8 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             attention=self.attention,
+            decode=self.decode,
+            cache_len=self.cache_len,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -244,6 +305,15 @@ class GPT(nn.Module):
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention: str = "dense"
+    decode: bool = False  # KV-cache generation mode (see for_decoding())
+
+    def for_decoding(self) -> "GPT":
+        """Clone configured for cached autoregressive decoding.
+
+        Same parameter structure (params transfer 1:1); remat is dropped —
+        it trades FLOPs for training memory and would re-run cache writes.
+        """
+        return self.clone(decode=True, remat=False)
 
     @nn.compact
     def __call__(
@@ -276,7 +346,15 @@ class GPT(nn.Module):
             name="position_embedding",
         )
 
-        positions = jnp.arange(seqlen)[None, :]
+        if self.decode:
+            # Positions continue from the cache cursor across apply() calls.
+            position_index = self.variable(
+                "cache", "position_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = (position_index.value + jnp.arange(seqlen))[None, :]
+            position_index.value = position_index.value + seqlen
+        else:
+            positions = jnp.arange(seqlen)[None, :]
         x = token_embedding(input_ids) + position_embedding(positions)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
@@ -297,6 +375,8 @@ class GPT(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 attention=self.attention,
+                decode=self.decode,
+                cache_len=self.block_size if self.decode else 0,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 

@@ -95,6 +95,54 @@ class TestGenerate:
         with pytest.raises(ValueError, match=r"\[0, 64\)"):
             generate(model, params, np.array([[-1]], np.int32), max_new_tokens=2)
 
+    def test_cached_matches_windowed_greedy(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[3, 1, 4, 1, 5]], np.int32)
+        cached = generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0, use_cache=True
+        )
+        windowed = generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0, use_cache=False
+        )
+        np.testing.assert_array_equal(cached, windowed)
+
+    def test_cached_matches_windowed_sampled(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[3, 1, 4]], np.int32)
+        kw = dict(max_new_tokens=6, temperature=0.7, top_k=8, rng=jax.random.key(11))
+        cached = generate(model, params, prompt, use_cache=True, **kw)
+        windowed = generate(model, params, prompt, use_cache=False, **kw)
+        np.testing.assert_array_equal(cached, windowed)
+
+    def test_cached_batch_and_eos(self, tiny_model):
+        model, params = tiny_model
+        prompt = np.array([[1, 2], [3, 4], [5, 6]], np.int32)
+        out = generate(
+            model,
+            params,
+            prompt,
+            max_new_tokens=6,
+            temperature=0.0,
+            eos_token_id=7,
+            use_cache=True,
+        )
+        assert out.shape == (3, 8)
+        for row in out:
+            hits = np.where(row[2:] == 7)[0]
+            if hits.size:  # everything after first eos stays eos
+                assert (row[2 + hits[0] :] == 7).all()
+
+    def test_use_cache_true_rejected_past_block_size(self, tiny_model):
+        model, params = tiny_model  # block_size 16
+        prompt = np.array([[1] * 10], np.int32)
+        with pytest.raises(ValueError, match="block_size"):
+            generate(
+                model, params, prompt, max_new_tokens=10, temperature=0.0, use_cache=True
+            )
+        # auto mode silently falls back to the windowed path
+        out = generate(model, params, prompt, max_new_tokens=10, temperature=0.0)
+        assert out.shape == (1, 20)
+
     def test_greedy_matches_stepwise_argmax(self, tiny_model):
         """The fused loop must equal naive one-token-at-a-time decoding."""
         model, params = tiny_model
