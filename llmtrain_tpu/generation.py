@@ -219,7 +219,7 @@ def generate(
         return ids.copy()
 
     if use_cache:
-        decode_model = model.for_decoding()
+        decode_model = model.for_decoding(cache_len=total)
         # Zero cache pytree from an eval_shape trace — no param init work.
         var_shapes = jax.eval_shape(
             lambda: decode_model.init(

@@ -306,14 +306,22 @@ class GPT(nn.Module):
     remat: bool = False
     attention: str = "dense"
     decode: bool = False  # KV-cache generation mode (see for_decoding())
+    decode_cache_len: int = 0  # KV-cache capacity; 0 = block_size
 
-    def for_decoding(self) -> "GPT":
+    def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
 
         Same parameter structure (params transfer 1:1); remat is dropped —
         it trades FLOPs for training memory and would re-run cache writes.
+        ``cache_len`` sizes the per-layer KV cache to the actual output
+        length (capped at ``block_size``) so short generations don't pay
+        O(block_size) HBM and attention per step.
         """
-        return self.clone(decode=True, remat=False)
+        if cache_len is None:
+            cache_len = self.block_size
+        return self.clone(
+            decode=True, remat=False, decode_cache_len=min(cache_len, self.block_size)
+        )
 
     @nn.compact
     def __call__(
@@ -376,7 +384,7 @@ class GPT(nn.Module):
                 param_dtype=self.param_dtype,
                 attention=self.attention,
                 decode=self.decode,
-                cache_len=self.block_size if self.decode else 0,
+                cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 
