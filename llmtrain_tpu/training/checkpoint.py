@@ -73,6 +73,8 @@ class CheckpointManager:
     def __init__(self, directory: str | Path, *, keep_last_k: int = 3) -> None:
         self._dir = Path(directory)
         self._keep_last_k = max(1, keep_last_k)
+        self._pending: Any = None  # in-flight async write (Future)
+        self._executor: Any = None
 
     @property
     def directory(self) -> Path:
@@ -105,6 +107,45 @@ class CheckpointManager:
         tmp.replace(target)
         self._prune()
         return target
+
+    def save_host_async(
+        self, step: int, host_state: dict[str, Any], resolved_config: dict[str, Any]
+    ) -> None:
+        """Queue ``save_host`` on a background thread (one write in flight).
+
+        The device→host gather has already happened in ``state_to_host``, so
+        the remaining msgpack serialization + disk IO can overlap the next
+        training steps — the reference's ``torch.save`` blocks the step loop
+        (reference trainer.py:402-413). At most one write runs at a time;
+        queueing a new one first drains (and re-raises errors from) the
+        previous. Call ``wait_pending`` before reading checkpoints back.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.wait_pending()
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-write"
+            )
+        self._pending = self._executor.submit(
+            self.save_host, step, host_state, resolved_config
+        )
+
+    def wait_pending(self) -> None:
+        """Block until the in-flight async write (if any) finishes; re-raise
+        its error."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            pending.result()
+
+    def close(self) -> None:
+        """Drain the pending write and stop the worker thread."""
+        try:
+            self.wait_pending()
+        finally:
+            executor, self._executor = self._executor, None
+            if executor is not None:
+                executor.shutdown(wait=True)
 
     def _prune(self) -> None:
         ckpts = self.all_checkpoints()

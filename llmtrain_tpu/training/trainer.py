@@ -18,6 +18,7 @@ the step, and (at log boundaries) pull small scalars off device.
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -272,50 +273,63 @@ class Trainer:
         interval_start = time.perf_counter()
         start_time = time.perf_counter()
 
-        with self._mesh, nn.logical_axis_rules(self._rules):
-            for step in range(start_step, max_steps + 1):
-                profiler.maybe_start(step)
-                batch = self._global_batch(sampler, train_ds, step)
-                self._state, metrics = self._train_step_fn(self._state, batch, run_key)
-                profiler.maybe_stop(step, sync=metrics["loss"])
+        try:
+            with self._mesh, nn.logical_axis_rules(self._rules):
+                for step in range(start_step, max_steps + 1):
+                    profiler.maybe_start(step)
+                    batch = self._global_batch(sampler, train_ds, step)
+                    self._state, metrics = self._train_step_fn(self._state, batch, run_key)
+                    profiler.maybe_stop(step, sync=metrics["loss"])
 
-                step_loss_dev = metrics["loss"]
-                interval_losses.append(metrics["loss"])
-                interval_shard.append(
-                    (metrics["per_example_loss_sum"], metrics["per_example_tokens"])
-                )
-                interval_tokens += tokens_per_step
-                total_tokens += tokens_per_step
-
-                if step == 1:
-                    first_step_loss = float(jax.device_get(metrics["loss"]))
-
-                if step % save_every == 0 or step == max_steps:
-                    self._save_checkpoint(step)
-
-                if step % log_every == 0 or step == max_steps:
-                    interval_time = time.perf_counter() - interval_start
-                    self._log_train_interval(
-                        step=step,
-                        max_steps=max_steps,
-                        interval_losses=interval_losses,
-                        interval_shard=interval_shard,
-                        interval_tokens=interval_tokens,
-                        interval_time=interval_time,
-                        total_tokens=total_tokens,
+                    step_loss_dev = metrics["loss"]
+                    interval_losses.append(metrics["loss"])
+                    interval_shard.append(
+                        (metrics["per_example_loss_sum"], metrics["per_example_tokens"])
                     )
-                    interval_losses = []
-                    interval_shard = []
-                    interval_tokens = 0
-                    interval_start = time.perf_counter()
+                    interval_tokens += tokens_per_step
+                    total_tokens += tokens_per_step
 
-                if step % eval_every == 0 or step == max_steps:
-                    val_metrics = self._evaluate(step, max_steps)
-                    if val_metrics:
-                        final_val_metrics = val_metrics
-                        final_val_loss = val_metrics.get("val/loss", final_val_loss)
+                    if step == 1:
+                        first_step_loss = float(jax.device_get(metrics["loss"]))
 
-        profiler.close(sync=step_loss_dev)
+                    if step % save_every == 0 or step == max_steps:
+                        self._save_checkpoint(step)
+
+                    if step % log_every == 0 or step == max_steps:
+                        interval_time = time.perf_counter() - interval_start
+                        self._log_train_interval(
+                            step=step,
+                            max_steps=max_steps,
+                            interval_losses=interval_losses,
+                            interval_shard=interval_shard,
+                            interval_tokens=interval_tokens,
+                            interval_time=interval_time,
+                            total_tokens=total_tokens,
+                        )
+                        interval_losses = []
+                        interval_shard = []
+                        interval_tokens = 0
+                        interval_start = time.perf_counter()
+
+                    if step % eval_every == 0 or step == max_steps:
+                        val_metrics = self._evaluate(step, max_steps)
+                        if val_metrics:
+                            final_val_metrics = val_metrics
+                            final_val_loss = val_metrics.get("val/loss", final_val_loss)
+        finally:
+            profiler.close(sync=step_loss_dev)
+            if self._ckpt_mgr is not None:
+                # Final save must be durable. When another exception is
+                # already unwinding, log a write failure instead of masking it.
+                if sys.exc_info()[0] is None:
+                    self._ckpt_mgr.close()
+                else:
+                    try:
+                        self._ckpt_mgr.close()
+                    except Exception as ckpt_exc:  # noqa: BLE001
+                        logger.error(
+                            "async checkpoint write failed during unwind: %s", ckpt_exc
+                        )
         total_time = time.perf_counter() - start_time
         final_loss = float(jax.device_get(step_loss_dev)) if step_loss_dev is not None else 0.0
 
@@ -348,7 +362,9 @@ class Trainer:
 
         host_state = state_to_host(self._state)
         if self._ckpt_mgr is not None and self._is_main:
-            self._ckpt_mgr.save_host(step, host_state, self._cfg.model_dump())
+            # Async: msgpack + disk IO overlap the next steps (the collective
+            # device→host gather above already completed synchronously).
+            self._ckpt_mgr.save_host_async(step, host_state, self._cfg.model_dump())
 
     # ------------------------------------------------------------------ metrics
 

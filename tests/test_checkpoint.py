@@ -99,6 +99,33 @@ class TestCheckpointManager:
         with pytest.raises(CheckpointError, match="not found"):
             CheckpointManager.load(tmp_path / "nope.ckpt")
 
+    def test_async_save_is_durable_after_wait(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "c")
+        host_state = {"step": 1, "params": {"w": np.ones(3)}, "opt_state": {}}
+        mgr.save_host_async(1, host_state, {"a": 1})
+        mgr.wait_pending()
+        assert (tmp_path / "c" / "step_000001.ckpt").is_file()
+        payload = CheckpointManager.load(tmp_path / "c" / "step_000001.ckpt")
+        assert int(payload["step"]) == 1
+
+    def test_async_save_error_surfaces_on_wait(self, tmp_path):
+        target = tmp_path / "c"
+        target.write_text("a file where the checkpoint dir should be")
+        mgr = CheckpointManager(target)
+        host_state = {"step": 1, "params": {}, "opt_state": {}}
+        mgr.save_host_async(1, host_state, {})
+        with pytest.raises(OSError):
+            mgr.wait_pending()
+
+    def test_async_queue_drains_previous_before_next(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "c", keep_last_k=5)
+        for step in (1, 2, 3):
+            host_state = {"step": step, "params": {"w": np.full(2, step)}, "opt_state": {}}
+            mgr.save_host_async(step, host_state, {})
+        mgr.wait_pending()
+        names = sorted(p.name for p in (tmp_path / "c").iterdir())
+        assert names == ["step_000001.ckpt", "step_000002.ckpt", "step_000003.ckpt"]
+
 
 class TestResumeResolution:
     def test_explicit_file(self, tmp_path):
