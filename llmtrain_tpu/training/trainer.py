@@ -18,7 +18,6 @@ the step, and (at log boundaries) pull small scalars off device.
 
 from __future__ import annotations
 
-import sys
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -273,6 +272,7 @@ class Trainer:
         interval_start = time.perf_counter()
         start_time = time.perf_counter()
 
+        loop_completed = False
         try:
             with self._mesh, nn.logical_axis_rules(self._rules):
                 for step in range(start_step, max_steps + 1):
@@ -316,12 +316,15 @@ class Trainer:
                         if val_metrics:
                             final_val_metrics = val_metrics
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
+            loop_completed = True
         finally:
             profiler.close(sync=step_loss_dev)
             if self._ckpt_mgr is not None:
-                # Final save must be durable. When another exception is
-                # already unwinding, log a write failure instead of masking it.
-                if sys.exc_info()[0] is None:
+                # Final save must be durable. When an exception is unwinding
+                # out of the loop, log a write failure instead of masking it.
+                # (An explicit flag, not sys.exc_info(): the latter also sees
+                # exceptions being handled further up the call stack.)
+                if loop_completed:
                     self._ckpt_mgr.close()
                 else:
                     try:

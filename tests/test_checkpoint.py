@@ -117,14 +117,34 @@ class TestCheckpointManager:
         with pytest.raises(OSError):
             mgr.wait_pending()
 
-    def test_async_queue_drains_previous_before_next(self, tmp_path):
+    def test_async_queue_drains_previous_before_next(self, tmp_path, monkeypatch):
+        """Single write in flight: queueing save N+1 blocks until N finished."""
+        import threading
+
+        release = threading.Event()
+        order = []
+        real_save = CheckpointManager.save_host
+
+        def slow_save(self, step, host_state, cfg):
+            if step == 1:
+                release.wait(timeout=10)
+            order.append(step)
+            return real_save(self, step, host_state, cfg)
+
+        monkeypatch.setattr(CheckpointManager, "save_host", slow_save)
         mgr = CheckpointManager(tmp_path / "c", keep_last_k=5)
-        for step in (1, 2, 3):
-            host_state = {"step": step, "params": {"w": np.full(2, step)}, "opt_state": {}}
-            mgr.save_host_async(step, host_state, {})
-        mgr.wait_pending()
+        state = lambda s: {"step": s, "params": {"w": np.full(2, s)}, "opt_state": {}}  # noqa: E731
+
+        mgr.save_host_async(1, state(1), {})  # worker blocked on the event
+        # Queueing the second save must first drain save 1; release it from
+        # a timer shortly after this call starts waiting.
+        threading.Timer(0.2, release.set).start()
+        mgr.save_host_async(2, state(2), {})
+        assert order == [1]  # save 1 fully drained before save 2 was queued
+        mgr.close()
+        assert order == [1, 2]
         names = sorted(p.name for p in (tmp_path / "c").iterdir())
-        assert names == ["step_000001.ckpt", "step_000002.ckpt", "step_000003.ckpt"]
+        assert names == ["step_000001.ckpt", "step_000002.ckpt"]
 
 
 class TestResumeResolution:

@@ -1,0 +1,110 @@
+"""Tracker contract tests (parity with reference tests/test_cli.py:628-704's
+MLflow coverage, adapted for an environment without the optional mlflow
+dependency: the module is stubbed, and the full call sequence is asserted)."""
+
+import sys
+import types
+from unittest.mock import Mock
+
+import pytest
+
+from llmtrain_tpu.tracking import MLflowTracker, NullTracker
+from llmtrain_tpu.tracking.mlflow import _flatten_params
+
+
+class TestFlattenParams:
+    def test_nested_dicts_become_dot_keys(self):
+        flat = _flatten_params({"a": {"b": {"c": 1}}, "d": 2})
+        assert flat == {"a.b.c": 1, "d": 2}
+
+    def test_lists_json_encoded(self):
+        flat = _flatten_params({"a": [1, 2], "b": ("x", "y")})
+        assert flat == {"a": "[1, 2]", "b": '["x", "y"]'}
+
+    def test_scalars_passthrough(self):
+        flat = _flatten_params({"s": "v", "i": 3, "f": 0.5, "n": None, "t": True})
+        assert flat == {"s": "v", "i": 3, "f": 0.5, "n": None, "t": True}
+
+
+class TestNullTracker:
+    def test_all_methods_noop(self):
+        t = NullTracker()
+        t.start_run("rid", None)
+        t.log_params({"a": 1})
+        t.log_metrics({"m": 1.0}, step=1)
+        t.log_artifact("/nope")
+        t.end_run("FINISHED")
+
+
+@pytest.fixture()
+def fake_mlflow(monkeypatch):
+    """Inject a recording stub as the ``mlflow`` module."""
+    stub = types.ModuleType("mlflow")
+    mock = Mock()
+    for name in (
+        "set_tracking_uri",
+        "set_experiment",
+        "start_run",
+        "set_tag",
+        "log_params",
+        "log_metrics",
+        "log_artifact",
+        "end_run",
+    ):
+        setattr(stub, name, getattr(mock, name))
+    monkeypatch.setitem(sys.modules, "mlflow", stub)
+    return mock
+
+
+class TestMLflowTracker:
+    def test_missing_dependency_raises_clear_error(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "mlflow", None)  # forces ImportError
+        t = MLflowTracker("file:./mlruns", "exp")
+        with pytest.raises(RuntimeError, match="mlflow is not installed"):
+            t.start_run("rid")
+
+    def test_lifecycle_call_sequence(self, fake_mlflow):
+        t = MLflowTracker("sqlite:///x.db", "exp", run_name="pretty")
+        t.start_run("rid-1")
+        fake_mlflow.set_tracking_uri.assert_called_once_with("sqlite:///x.db")
+        fake_mlflow.set_experiment.assert_called_once_with("exp")
+        fake_mlflow.start_run.assert_called_once_with(run_name="pretty")
+        fake_mlflow.set_tag.assert_called_once_with("llmtrain.run_id", "rid-1")
+
+        t.log_params({"model": {"d_model": 8}})
+        fake_mlflow.log_params.assert_called_once_with({"model.d_model": 8})
+
+        t.log_metrics({"train/loss": 1.5}, step=3)
+        fake_mlflow.log_metrics.assert_called_once_with({"train/loss": 1.5}, step=3)
+
+        t.log_artifact("/tmp/config.yaml")
+        fake_mlflow.log_artifact.assert_called_once_with(
+            "/tmp/config.yaml", artifact_path=None
+        )
+
+        t.end_run("FINISHED")
+        fake_mlflow.end_run.assert_called_once_with(status="FINISHED")
+
+    def test_methods_inactive_before_start(self, fake_mlflow):
+        t = MLflowTracker("file:./mlruns", "exp")
+        t.log_params({"a": 1})
+        t.log_metrics({"m": 1.0}, step=1)
+        t.log_artifact("/x")
+        t.end_run()
+        fake_mlflow.log_params.assert_not_called()
+        fake_mlflow.log_metrics.assert_not_called()
+        fake_mlflow.log_artifact.assert_not_called()
+        fake_mlflow.end_run.assert_not_called()
+
+    def test_end_run_deactivates(self, fake_mlflow):
+        t = MLflowTracker("file:./mlruns", "exp")
+        t.start_run("rid")
+        t.end_run("FAILED")
+        fake_mlflow.end_run.assert_called_once_with(status="FAILED")
+        t.log_metrics({"m": 1.0}, step=1)
+        fake_mlflow.log_metrics.assert_not_called()
+
+    def test_run_id_used_when_no_run_name(self, fake_mlflow):
+        t = MLflowTracker("file:./mlruns", "exp")
+        t.start_run("rid-9")
+        fake_mlflow.start_run.assert_called_once_with(run_name="rid-9")
