@@ -66,5 +66,14 @@ else
 fi
 
 echo "entrypoint: process ${JAX_PROCESS_ID}/${JAX_NUM_PROCESSES} coordinator=${JAX_COORDINATOR_ADDRESS}"
-echo "entrypoint: exec python -m llmtrain_tpu train --config ${CONFIG_PATH}"
-exec python -m llmtrain_tpu train --config "$CONFIG_PATH"
+
+# With LLMTRAIN_RUN_ID set, restarts of the same Job reuse the run dir and
+# continue from the latest checkpoint (the CLI's --auto-resume). Leave unset
+# for the reference-parity behavior of one fresh run dir per launch.
+EXTRA_ARGS=()
+if [ -n "${LLMTRAIN_RUN_ID:-}" ]; then
+    EXTRA_ARGS+=(--run-id "$LLMTRAIN_RUN_ID" --auto-resume)
+fi
+
+echo "entrypoint: exec python -m llmtrain_tpu train --config ${CONFIG_PATH} ${EXTRA_ARGS[*]:-}"
+exec python -m llmtrain_tpu train --config "$CONFIG_PATH" "${EXTRA_ARGS[@]+"${EXTRA_ARGS[@]}"}"

@@ -64,10 +64,19 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--dry-run", action="store_true", help="forward-only sanity check")
     train.add_argument("--json", action="store_true", help="emit the run summary as JSON")
     train.add_argument("-v", "--verbose", action="store_true", help="DEBUG logging")
-    train.add_argument(
+    resume_group = train.add_mutually_exclusive_group()
+    resume_group.add_argument(
         "--resume",
         default=None,
         help="checkpoint file, checkpoint dir, or run id to resume from",
+    )
+    resume_group.add_argument(
+        "--auto-resume",
+        action="store_true",
+        help=(
+            "reuse the run dir for --run-id if it exists and resume from its "
+            "latest checkpoint (fresh start otherwise); for preemptible pods"
+        ),
     )
 
     gen = sub.add_parser(
@@ -324,6 +333,12 @@ def _handle_train(args: argparse.Namespace) -> int:
     tracker_started = False
     try:
         run_id = args.run_id or cfg.output.run_id
+        if args.auto_resume and run_id is None:
+            _emit_error(
+                "--auto-resume requires a stable run id (--run-id or output.run_id): "
+                "a generated id is fresh on every restart"
+            )
+            return EXIT_CONFIG_ERROR
         if run_id is None:
             run_id = generate_run_id(cfg.run.name, cfg.output.root_dir)
         run_id = _agree_run_id(run_id, dist_state)
@@ -334,11 +349,25 @@ def _handle_train(args: argparse.Namespace) -> int:
         # would run on into the first collective and hang until timeout.
         run_dir: Path | None = None
         run_dir_ok = True
+        resuming_existing = False
         if is_main:
             try:
                 run_dir = create_run_directory(cfg.output.root_dir, run_id)
             except FileExistsError:
-                run_dir_ok = False
+                if args.auto_resume:
+                    # Preemption restart: reuse the dir, continue from its
+                    # latest checkpoint if one exists (new capability — the
+                    # reference only has manual --resume, SURVEY §5).
+                    run_dir = Path(cfg.output.root_dir) / run_id
+                    (run_dir / "logs").mkdir(parents=True, exist_ok=True)
+                    from .training.checkpoint import CheckpointManager
+
+                    resuming_existing = (
+                        CheckpointManager(run_dir / "checkpoints").latest_checkpoint()
+                        is not None
+                    )
+                else:
+                    run_dir_ok = False
         if not _agree_flag(run_dir_ok, dist_state):
             if is_main:
                 _emit_error(
@@ -346,6 +375,10 @@ def _handle_train(args: argparse.Namespace) -> int:
                     details="pass a fresh --run-id or let the run id be generated",
                 )
             return EXIT_TRAIN_FAILURE
+        resume_spec = args.resume
+        if _agree_flag(resuming_existing, dist_state):
+            # run-id spec: every rank resolves {root_dir}/{run_id}/checkpoints.
+            resume_spec = run_id
 
         log_file = None
         if cfg.logging.log_to_file and run_dir is not None:
@@ -397,7 +430,7 @@ def _handle_train(args: argparse.Namespace) -> int:
             from .training import Trainer
 
             trainer = Trainer(cfg, run_dir, tracker, dist_state)
-            result = trainer.fit(resume_from=args.resume)
+            result = trainer.fit(resume_from=resume_spec)
             summary = format_run_summary(
                 cfg,
                 run_id=run_id,

@@ -123,11 +123,15 @@ class TestCheckpointManager:
 
         release = threading.Event()
         order = []
+        seen_at_step2 = []
         real_save = CheckpointManager.save_host
 
         def slow_save(self, step, host_state, cfg):
             if step == 1:
                 release.wait(timeout=10)
+            if step == 2:
+                # Snapshot on the worker thread itself — no race with main.
+                seen_at_step2.append(list(order))
             order.append(step)
             return real_save(self, step, host_state, cfg)
 
@@ -140,8 +144,9 @@ class TestCheckpointManager:
         # a timer shortly after this call starts waiting.
         threading.Timer(0.2, release.set).start()
         mgr.save_host_async(2, state(2), {})
-        assert order == [1]  # save 1 fully drained before save 2 was queued
         mgr.close()
+        # Save 1 had fully completed before save 2 began.
+        assert seen_at_step2 == [[1]]
         assert order == [1, 2]
         names = sorted(p.name for p in (tmp_path / "c").iterdir())
         assert names == ["step_000001.ckpt", "step_000002.ckpt"]

@@ -149,6 +149,40 @@ class TestTrain:
         tr = json.loads(second.stdout)["train_result"]
         assert tr["resumed_from_step"] == 3
 
+    def test_auto_resume_fresh_then_continue(self, workdir):
+        short = {**CFG, "trainer": {**CFG["trainer"], "max_steps": 3}}
+        (workdir / "short.yaml").write_text(yaml.safe_dump(short))
+        first = _run(
+            ["train", "--config", "short.yaml", "--json", "--run-id", "runAR", "--auto-resume"],
+            workdir,
+        )
+        assert first.returncode == 0, first.stderr
+        tr1 = json.loads(first.stdout)["train_result"]
+        assert tr1["resumed_from_step"] is None and tr1["final_step"] == 3
+
+        # Simulated preemption restart with a longer schedule: same run id,
+        # dir already exists, training continues from the checkpoint.
+        second = _run(
+            ["train", "--config", "config.yaml", "--json", "--run-id", "runAR", "--auto-resume"],
+            workdir,
+        )
+        assert second.returncode == 0, second.stderr
+        tr2 = json.loads(second.stdout)["train_result"]
+        assert tr2["resumed_from_step"] == 3
+        assert tr2["final_step"] == 6
+
+    def test_auto_resume_requires_run_id(self, workdir):
+        proc = _run(["train", "--config", "config.yaml", "--auto-resume"], workdir)
+        assert proc.returncode == 2
+        assert "stable run id" in proc.stderr
+
+    def test_auto_resume_excludes_resume(self, workdir):
+        proc = _run(
+            ["train", "--config", "config.yaml", "--auto-resume", "--resume", "x"],
+            workdir,
+        )
+        assert proc.returncode == 2  # argparse mutual exclusion
+
     def test_unknown_adapter_exit_2(self, workdir):
         bad = {**CFG, "model": {**CFG["model"], "name": "nonexistent"}}
         (workdir / "bad.yaml").write_text(yaml.safe_dump(bad))
