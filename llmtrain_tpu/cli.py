@@ -377,8 +377,10 @@ def _handle_train(args: argparse.Namespace) -> int:
             return EXIT_TRAIN_FAILURE
         resume_spec = args.resume
         if _agree_flag(resuming_existing, dist_state):
-            # run-id spec: every rank resolves {root_dir}/{run_id}/checkpoints.
-            resume_spec = run_id
+            # Unambiguous dir spec, computable on every rank. (A bare run id
+            # would first be tried as a CWD-relative path by
+            # resolve_resume_path and can collide with unrelated entries.)
+            resume_spec = str(Path(cfg.output.root_dir) / run_id / "checkpoints")
 
         log_file = None
         if cfg.logging.log_to_file and run_dir is not None:

@@ -108,13 +108,20 @@ def ring_attention_sharded(
 
 def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
     """Route to ring attention when an ambient mesh has a sequence axis > 1;
-    otherwise fall back to single-device blockwise (same math, no ring)."""
+    otherwise fall back to single-device blockwise (same math, no ring).
+
+    Every dim the shard_map specs shard must divide evenly, or the fallback
+    is taken — notably batch=1 traces (param init uses a (1, block_size)
+    probe, models/base.py:46) can never shard over data×fsdp.
+    """
     mesh = _ambient_mesh()
     if (
         mesh is not None
         and "sequence" in mesh.axis_names
         and mesh.shape["sequence"] > 1
         and q.shape[1] % mesh.shape["sequence"] == 0
+        and q.shape[0] % (mesh.shape["data"] * mesh.shape["fsdp"]) == 0
+        and q.shape[2] % mesh.shape["tensor"] == 0
     ):
         return ring_attention_sharded(q, k, v, mesh, causal=causal)
     return blockwise_attention(q, k, v, causal=causal)

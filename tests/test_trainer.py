@@ -183,6 +183,38 @@ class TestValEval:
         assert np.isfinite(res.val_metrics["val/loss"])
 
 
+class TestRingRematTrainer:
+    def test_ring_attention_with_remat_trains(self):
+        """gpt_longctx_ring.yaml's feature combination (ring attention +
+        remat + sequence-parallel mesh) runs end-to-end; regression for the
+        param-init batch=1 shard_map failure in ring_or_blockwise."""
+        cfg = _cfg(
+            model={
+                "name": "gpt",
+                "d_model": 16,
+                "n_heads": 4,
+                "d_ff": 32,
+                "attention": "ring",
+                "remat": True,
+            },
+            trainer={"max_steps": 3, "micro_batch_size": 4, "log_every_steps": 3,
+                     "eval_every_steps": 3},
+        )
+        cfg = cfg.model_copy(
+            update={
+                "distributed": cfg.distributed.model_copy(
+                    update={
+                        "mesh": cfg.distributed.mesh.model_copy(
+                            update={"data": 4, "sequence": 2}
+                        )
+                    }
+                )
+            }
+        )
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert math.isfinite(res.final_loss) and res.final_step == 3
+
+
 class TestProfiler:
     def test_profile_window_writes_trace(self, tmp_path):
         run_dir = tmp_path / "run"
