@@ -40,15 +40,14 @@ k8s-build:
 	kind load docker-image llmtrain-tpu:dev --name llmtrain-tpu
 
 k8s-train:
-	kubectl apply -f k8s/rbac.yaml -f k8s/storage.yaml -f k8s/configmap.yaml \
-		-f k8s/service.yaml -f k8s/job.yaml
+	kubectl apply -f k8s/infra.yaml -f k8s/configmap.yaml -f k8s/job.yaml
 
 k8s-logs:
 	kubectl logs -l app=llmtrain-tpu --all-containers --prefix -f
 
 k8s-clean:
-	kubectl delete -f k8s/job.yaml -f k8s/service.yaml -f k8s/configmap.yaml \
-		-f k8s/storage.yaml -f k8s/rbac.yaml --ignore-not-found
+	kubectl delete -f k8s/job.yaml -f k8s/configmap.yaml -f k8s/infra.yaml \
+		--ignore-not-found
 
 k8s-full: k8s-cluster k8s-build k8s-train k8s-logs
 

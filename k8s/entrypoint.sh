@@ -5,7 +5,7 @@
 # (JAX_PROCESS_ID / JAX_NUM_PROCESSES / JAX_COORDINATOR_ADDRESS) from the
 # IndexedJob controller's JOB_COMPLETION_INDEX. The coordinator (process 0)
 # advertises its own pod IP; other processes discover it by polling the
-# Kubernetes API for the index-0 pod of the same job (RBAC: k8s/rbac.yaml).
+# Kubernetes API for the index-0 pod of the same job (RBAC: k8s/infra.yaml).
 #
 # On a GKE TPU pod slice this script is NOT needed: the TPU runtime env
 # (TPU_WORKER_ID/TPU_WORKER_HOSTNAMES) lets jax.distributed.initialize()

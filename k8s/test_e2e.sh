@@ -16,7 +16,7 @@ TIMEOUT=300s
 KEEP=false
 [ "${1:-}" = "--no-cleanup" ] && KEEP=true
 
-MANIFESTS=(k8s/rbac.yaml k8s/storage.yaml k8s/configmap.yaml k8s/service.yaml k8s/job.yaml)
+MANIFESTS=(k8s/infra.yaml k8s/configmap.yaml k8s/job.yaml)
 FAILURES=0
 
 say()  { printf '==> %s\n' "$*"; }

@@ -80,6 +80,18 @@ def ring_attention(
     return (acc / row_sum[..., None]).astype(q.dtype)
 
 
+# Mesh axes each (B, T, H, D) dim shards over — single source of truth for
+# both the shard_map spec and the divisibility guard in ring_or_blockwise.
+# Matches the activation logical-axis rules in parallel/sharding.py.
+RING_DIM_AXES: tuple = (("data", "fsdp"), ("sequence",), ("tensor",), ())
+
+
+def _dim_shards(mesh: jax.sharding.Mesh, dim: int) -> int:
+    import math
+
+    return math.prod(mesh.shape[a] for a in RING_DIM_AXES[dim])
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -91,11 +103,15 @@ def ring_attention_sharded(
     """shard_map wrapper: global (B, T, H, D) arrays over the named mesh.
 
     Batch shards over (data, fsdp), sequence over ``sequence``, heads over
-    ``tensor`` — matching the activation logical-axis rules in
-    parallel/sharding.py.
+    ``tensor`` (``RING_DIM_AXES``).
     """
     P = jax.sharding.PartitionSpec
-    spec = P(("data", "fsdp"), "sequence", "tensor", None)
+    spec = P(
+        *(
+            axes if len(axes) > 1 else (axes[0] if axes else None)
+            for axes in RING_DIM_AXES
+        )
+    )
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name="sequence", causal=causal),
         mesh=mesh,
@@ -119,11 +135,27 @@ def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool 
         mesh is not None
         and "sequence" in mesh.axis_names
         and mesh.shape["sequence"] > 1
-        and q.shape[1] % mesh.shape["sequence"] == 0
-        and q.shape[0] % (mesh.shape["data"] * mesh.shape["fsdp"]) == 0
-        and q.shape[2] % mesh.shape["tensor"] == 0
     ):
-        return ring_attention_sharded(q, k, v, mesh, causal=causal)
+        if all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3)):
+            return ring_attention_sharded(q, k, v, mesh, causal=causal)
+        if q.shape[0] > 1:
+            # Batch-1 traces (the param-init probe, models/base.py:46) fall
+            # back silently by design; real batches losing sequence
+            # parallelism deserve a trace-time diagnostic.
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "ring attention falling back to single-device blockwise: "
+                "shape (B=%d, T=%d, H=%d) not divisible by mesh shards "
+                "(batch %d, sequence %d, heads %d) — sequence parallelism "
+                "is DISABLED for this computation",
+                q.shape[0],
+                q.shape[1],
+                q.shape[2],
+                _dim_shards(mesh, 0),
+                _dim_shards(mesh, 1),
+                _dim_shards(mesh, 2),
+            )
     return blockwise_attention(q, k, v, causal=causal)
 
 
