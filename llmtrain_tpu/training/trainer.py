@@ -213,6 +213,45 @@ class Trainer:
             for key in keys
         }
 
+    def _restored_step_loss(self, sampler: DeterministicSampler, dataset, step: int) -> float:
+        """Token-weighted forward loss over the batch of training step ``step``.
+
+        Used when resume lands at/past max_steps, so the summary reports a
+        measured loss for the restored parameters instead of a 0.0
+        placeholder. Runs the eval step over each accumulation micro-batch
+        of the step the checkpoint was saved at.
+        """
+        accum = self._cfg.trainer.grad_accum_steps
+        ds_keys, seqlen = self._dataset_spec(dataset)
+        # Same key union as _evaluate: synthesize an all-ones mask for
+        # datasets that don't produce one, keeping token weighting uniform.
+        keys = tuple(set(ds_keys) | {"attention_mask"})
+        sharding = batch_sharding(self._mesh, with_accum_dim=False)
+        params = nn_meta.unbox(self._state.params)
+        base = (step - 1) * accum
+        total_loss = 0.0
+        total_tok = 0.0
+        for a in range(accum):
+            indices = sampler.batch_indices(base + a)
+
+            def fetch(key: str, index, indices=indices) -> np.ndarray:
+                b_sl, t_sl = index
+                examples = dataset.get_examples(indices[b_sl])
+                if key == "attention_mask" and key not in examples:
+                    return np.ones_like(examples["input_ids"][:, t_sl])
+                return examples[key][:, t_sl]
+
+            batch = {
+                key: jax.make_array_from_callback(
+                    (self._global_micro, seqlen), sharding, lambda i, k=key: fetch(k, i)
+                )
+                for key in keys
+            }
+            loss_sum, tokens = self._eval_step_fn(params, batch)
+            total_loss += float(jnp.sum(jax.device_get(loss_sum)))
+            total_tok += float(jnp.sum(jax.device_get(tokens)))
+        return total_loss / max(total_tok, 1.0)
+
     def _dataset_spec(self, dataset) -> tuple[tuple[str, ...], int]:
         """Cached (batch keys, sequence length) of a dataset."""
         cached = self._dataset_specs.get(id(dataset))
@@ -272,9 +311,17 @@ class Trainer:
         interval_start = time.perf_counter()
         start_time = time.perf_counter()
 
+        past_end_loss: float | None = None
         loop_completed = False
         try:
             with self._mesh, nn.logical_axis_rules(self._rules):
+                if start_step > max_steps and resumed_from_step:
+                    # Resume landed at/past max_steps: the loop body never
+                    # runs, so measure a real loss for the restored state
+                    # instead of reporting 0.0.
+                    past_end_loss = self._restored_step_loss(
+                        sampler, train_ds, resumed_from_step
+                    )
                 for step in range(start_step, max_steps + 1):
                     profiler.maybe_start(step)
                     batch = self._global_batch(sampler, train_ds, step)
@@ -335,9 +382,16 @@ class Trainer:
                         )
         total_time = time.perf_counter() - start_time
         final_loss = float(jax.device_get(step_loss_dev)) if step_loss_dev is not None else 0.0
+        final_step = max_steps
+        if start_step > max_steps:
+            # No steps ran: report the restored step and its measured loss
+            # rather than pretending training reached max_steps.
+            final_step = resumed_from_step or 0
+            if past_end_loss is not None:
+                final_loss = past_end_loss
 
         return TrainResult(
-            final_step=max_steps,
+            final_step=final_step,
             final_loss=final_loss,
             final_val_loss=final_val_loss,
             total_time=total_time,

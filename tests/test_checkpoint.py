@@ -210,6 +210,23 @@ class TestResumeParity:
         )
         assert res_resumed.final_loss == pytest.approx(res_full.final_loss, abs=1e-5)
 
+    def test_resume_past_end_reports_restored_state(self, tmp_path, caplog):
+        """Resume at step >= max_steps: no steps run, and the summary must
+        carry the restored step and a measured loss — not max_steps / 0.0."""
+        cfg = _cfg(tmp_path, trainer={"save_every_steps": 10})
+        run_a = _run_dir(tmp_path, "past_end")
+        Trainer(cfg, run_a, NullTracker(), None).fit(max_steps_override=10)
+
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, None, NullTracker(), None).fit(
+                max_steps_override=10, resume_from=str(run_a / "checkpoints")
+            )
+        assert any("no training steps will run" in r.message for r in caplog.records)
+        assert res.resumed_from_step == 10
+        assert res.final_step == 10
+        assert res.final_loss > 0.0
+        assert np.isfinite(res.final_loss)
+
     def test_config_mismatch_warns(self, tmp_path, caplog):
         cfg = _cfg(tmp_path)
         run_a = _run_dir(tmp_path, "warn_run")
