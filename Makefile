@@ -1,9 +1,28 @@
-.PHONY: test test-all train-smoke train-multiproc bench mlflow \
+.PHONY: test test-all lint train-smoke train-multiproc bench mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
 test:
 	python -m pytest tests/ -q -m "not slow"
+
+# Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
+# Runs ruff+mypy when installed; otherwise the stdlib fallback checker.
+lint:
+	@if python -c "import ruff" 2>/dev/null; then \
+		python -m ruff format --check llmtrain_tpu tests && \
+		python -m ruff check llmtrain_tpu tests; \
+	elif command -v ruff >/dev/null; then \
+		ruff format --check llmtrain_tpu tests && \
+		ruff check llmtrain_tpu tests; \
+	else \
+		echo "ruff not installed; using stdlib fallback"; \
+	fi
+	@if python -c "import mypy" 2>/dev/null; then \
+		python -m mypy --config-file=pyproject.toml llmtrain_tpu; \
+	else \
+		echo "mypy not installed; using stdlib fallback"; \
+	fi
+	@JAX_PLATFORMS=cpu python tools/static_check.py
 
 test-all:
 	python -m pytest tests/ -q

@@ -101,6 +101,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--temperature", type=float, default=0.8, help="0 decodes greedily"
     )
     gen.add_argument("--top-k", type=int, default=40, help="0 disables top-k filtering")
+    gen.add_argument(
+        "--eos-token-id",
+        type=int,
+        default=None,
+        help="stop early on this token (default: the tokenizer's EOS, if any)",
+    )
     gen.add_argument("--seed", type=int, default=1234)
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
@@ -279,6 +285,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
 
+        eos_token_id = args.eos_token_id
+        if eos_token_id is None and tokenizer is not None:
+            # tiktoken encodings expose the end-of-text id as eot_token.
+            eos_token_id = getattr(tokenizer, "eot_token", None)
         out = generate(
             model,
             params,
@@ -287,6 +297,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
             rng=jax.random.key(args.seed),
             temperature=args.temperature,
             top_k=args.top_k,  # generate() maps <=0 to "disabled"
+            eos_token_id=eos_token_id,
         )
         output_ids = [int(t) for t in out[0]]
         completion_ids = output_ids[len(prompt_ids) :]  # newly generated only

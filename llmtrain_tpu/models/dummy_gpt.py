@@ -13,14 +13,12 @@ from __future__ import annotations
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from flax import linen as nn
 
 from ..config.schemas import RunConfig
 from ..registry.models import register_model
 from .base import (
     Batch,
-    Metrics,
     ModelAdapter,
     Params,
     lm_loss_components,

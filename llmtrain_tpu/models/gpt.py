@@ -36,7 +36,6 @@ from ..config.schemas import RunConfig
 from ..registry.models import register_model
 from .base import (
     Batch,
-    Metrics,
     ModelAdapter,
     Params,
     lm_loss_components,

@@ -87,9 +87,18 @@ RING_DIM_AXES: tuple = (("data", "fsdp"), ("sequence",), ("tensor",), ())
 
 
 def _dim_shards(mesh: jax.sharding.Mesh, dim: int) -> int:
+    # Externally built meshes may carry a sequence axis without data/fsdp/
+    # tensor names; absent axes count as unsharded (size 1).
     import math
 
-    return math.prod(mesh.shape[a] for a in RING_DIM_AXES[dim])
+    return math.prod(mesh.shape.get(a, 1) for a in RING_DIM_AXES[dim])
+
+
+def _mesh_dim_axes(mesh: jax.sharding.Mesh) -> tuple:
+    """RING_DIM_AXES restricted to axes the mesh actually has."""
+    return tuple(
+        tuple(a for a in axes if a in mesh.shape) for axes in RING_DIM_AXES
+    )
 
 
 def ring_attention_sharded(
@@ -109,7 +118,7 @@ def ring_attention_sharded(
     spec = P(
         *(
             axes if len(axes) > 1 else (axes[0] if axes else None)
-            for axes in RING_DIM_AXES
+            for axes in _mesh_dim_axes(mesh)
         )
     )
     fn = jax.shard_map(

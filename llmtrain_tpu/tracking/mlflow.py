@@ -5,10 +5,13 @@ import raising a clear RuntimeError when the extra is missing (:45-51),
 set_tracking_uri/set_experiment/start_run (:54-61), nested-param flattening
 to dot keys with JSON-encoded lists (:11-29).
 
-Intentional divergence: the reference's join-an-existing-mlflow-run path is
-not implemented — in this framework exactly one process (rank 0) ever gets a
-real tracker (non-main ranks get NullTracker, see cli.py), so every tracked
-run is fresh and the framework run id is recorded as a tag.
+Join semantics (reference mlflow.py:57-59, adapted): the reference joins by
+an explicit MLflow run id; here the join key is the ``llmtrain.run_id`` tag.
+``start_run`` searches the experiment for a run already tagged with the
+framework run id and reattaches to it — so an ``--auto-resume`` relaunch
+after preemption CONTINUES the original MLflow run instead of opening a
+second one. Only one process (rank 0) ever gets a real tracker (non-main
+ranks get NullTracker, see cli.py), so there is no concurrent-writer risk.
 """
 
 from __future__ import annotations
@@ -60,9 +63,53 @@ class MLflowTracker:
         mlflow = self._require_mlflow()
         mlflow.set_tracking_uri(self._tracking_uri)
         mlflow.set_experiment(self._experiment)
-        mlflow.start_run(run_name=run_name or self._run_name or run_id)
-        mlflow.set_tag("llmtrain.run_id", run_id)
+        existing = self._find_existing_run(run_id)
+        if existing is not None:
+            mlflow.start_run(run_id=existing)
+        else:
+            mlflow.start_run(run_name=run_name or self._run_name or run_id)
+            mlflow.set_tag("llmtrain.run_id", run_id)
         self._active = True
+
+    def _find_existing_run(self, run_id: str) -> str | None:
+        """MLflow run id of an existing run tagged with this framework run id.
+
+        The join key for crash-restart continuity: a relaunch with the same
+        stable run id (``--auto-resume``) reattaches instead of starting a
+        second MLflow run. Best-effort — any search failure means a fresh
+        run, never a crashed launch.
+        """
+        mlflow = self._require_mlflow()
+        if "'" in run_id or '"' in run_id:
+            # Quotes can't be escaped portably in MLflow filter strings;
+            # generated ids never contain them (run_id.py slugs), only a
+            # hand-picked --run-id can. Skip the join rather than crash.
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "run id %r contains quotes; skipping MLflow run-join search",
+                run_id,
+            )
+            return None
+        try:
+            experiment = mlflow.get_experiment_by_name(self._experiment)
+            if experiment is None:
+                return None
+            runs = mlflow.search_runs(
+                experiment_ids=[experiment.experiment_id],
+                filter_string=f"tags.\"llmtrain.run_id\" = '{run_id}'",
+                max_results=1,
+                output_format="list",
+            )
+        except Exception as exc:  # noqa: BLE001
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "could not search for an existing MLflow run (%s); starting fresh",
+                exc,
+            )
+            return None
+        return runs[0].info.run_id if runs else None
 
     def log_params(self, params: dict[str, Any]) -> None:
         if self._active:

@@ -131,6 +131,16 @@ class CheckpointManager:
             self.save_host, step, host_state, resolved_config
         )
 
+    def poll(self) -> None:
+        """Non-blocking failure check: if the in-flight async write has
+        already finished with an error, re-raise it now. Called by the
+        trainer each log interval so a failed write surfaces within one
+        interval instead of at the next save or at close()."""
+        pending = self._pending
+        if pending is not None and pending.done():
+            self._pending = None
+            pending.result()
+
     def wait_pending(self) -> None:
         """Block until the in-flight async write (if any) finishes; re-raise
         its error."""

@@ -19,7 +19,7 @@ the step, and (at log boundaries) pull small scalars off device.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any
 
@@ -213,6 +213,45 @@ class Trainer:
             for key in keys
         }
 
+    def _eval_batch(self, dataset, indices: np.ndarray, *, n_pad: int = 0) -> dict:
+        """Sharded (B, T) batch for the eval step from explicit example indices.
+
+        Single assembly point for every forward-only batch (_evaluate and
+        _restored_step_loss). Always includes an attention_mask —
+        synthesized all-ones when the dataset doesn't produce one — and with
+        ``n_pad`` > 0 the trailing rows are zero-masked so duplicated
+        padding rows contribute 0 loss and 0 tokens to the token-weighted
+        aggregation.
+        """
+        ds_keys, seqlen = self._dataset_spec(dataset)
+        keys = set(ds_keys) | {"attention_mask"}
+        bs = len(indices)
+        sharding = batch_sharding(self._mesh, with_accum_dim=False)
+
+        def fetch(key: str, index) -> np.ndarray:
+            b_sl, t_sl = index
+            examples = dataset.get_examples(indices[b_sl])
+            if key == "attention_mask" and key not in examples:
+                block = np.ones_like(examples["input_ids"][:, t_sl])
+            else:
+                block = examples[key][:, t_sl]
+            if n_pad and key == "attention_mask":
+                # Zero the mask of padded rows in this shard. Unsharded dims
+                # arrive as slice(None) — default the bounds.
+                start = b_sl.start if b_sl.start is not None else 0
+                stop = b_sl.stop if b_sl.stop is not None else bs
+                row_ids = np.arange(start, stop)[: block.shape[0]]
+                block = block.copy()
+                block[row_ids >= bs - n_pad] = 0
+            return block
+
+        return {
+            key: jax.make_array_from_callback(
+                (bs, seqlen), sharding, lambda i, k=key: fetch(k, i)
+            )
+            for key in keys
+        }
+
     def _restored_step_loss(self, sampler: DeterministicSampler, dataset, step: int) -> float:
         """Token-weighted forward loss over the batch of training step ``step``.
 
@@ -222,31 +261,12 @@ class Trainer:
         of the step the checkpoint was saved at.
         """
         accum = self._cfg.trainer.grad_accum_steps
-        ds_keys, seqlen = self._dataset_spec(dataset)
-        # Same key union as _evaluate: synthesize an all-ones mask for
-        # datasets that don't produce one, keeping token weighting uniform.
-        keys = tuple(set(ds_keys) | {"attention_mask"})
-        sharding = batch_sharding(self._mesh, with_accum_dim=False)
         params = nn_meta.unbox(self._state.params)
         base = (step - 1) * accum
         total_loss = 0.0
         total_tok = 0.0
         for a in range(accum):
-            indices = sampler.batch_indices(base + a)
-
-            def fetch(key: str, index, indices=indices) -> np.ndarray:
-                b_sl, t_sl = index
-                examples = dataset.get_examples(indices[b_sl])
-                if key == "attention_mask" and key not in examples:
-                    return np.ones_like(examples["input_ids"][:, t_sl])
-                return examples[key][:, t_sl]
-
-            batch = {
-                key: jax.make_array_from_callback(
-                    (self._global_micro, seqlen), sharding, lambda i, k=key: fetch(k, i)
-                )
-                for key in keys
-            }
+            batch = self._eval_batch(dataset, sampler.batch_indices(base + a))
             loss_sum, tokens = self._eval_step_fn(params, batch)
             total_loss += float(jnp.sum(jax.device_get(loss_sum)))
             total_tok += float(jnp.sum(jax.device_get(tokens)))
@@ -451,6 +471,10 @@ class Trainer:
         interval_time: float,
         total_tokens: int,
     ) -> None:
+        if self._ckpt_mgr is not None:
+            # Surface a failed async checkpoint write within one log
+            # interval instead of at the next save or at close().
+            self._ckpt_mgr.poll()
         losses = np.asarray(jax.device_get(jnp.stack(interval_losses)))
         avg_loss = float(losses.mean())
         steps_in_interval = len(losses)
@@ -513,8 +537,6 @@ class Trainer:
         if val_ds is None:
             return None
         n = len(val_ds)
-        sharding = batch_sharding(self._mesh, with_accum_dim=False)
-        seqlen = self._probe_seqlen(val_ds)
 
         # Pad the last batch up to a multiple of the data-parallel degree with
         # zero-masked rows: token-weighted aggregation makes padding exact
@@ -529,35 +551,7 @@ class Trainer:
             real = np.arange(b * eval_bs, min((b + 1) * eval_bs, n))
             pad = eval_bs - len(real)
             indices = np.concatenate([real, np.zeros(pad, dtype=np.int64)])
-
-            def fetch(key, index, pad=pad):
-                b_sl, t_sl = index
-                examples = val_ds.get_examples(indices[b_sl])
-                if key == "attention_mask" and key not in examples:
-                    block = np.ones_like(examples["input_ids"][:, t_sl])
-                else:
-                    block = examples[key][:, t_sl]
-                if pad and key == "attention_mask":
-                    # Zero the attention mask of padded rows in this shard.
-                    # Unsharded dims arrive as slice(None) — default the bounds.
-                    start = b_sl.start if b_sl.start is not None else 0
-                    stop = b_sl.stop if b_sl.stop is not None else eval_bs
-                    row_ids = np.arange(start, stop)[: block.shape[0]]
-                    block = block.copy()
-                    block[row_ids >= eval_bs - pad] = 0
-                return block
-
-            # Always include an attention_mask: padded duplicate rows must be
-            # zero-masked or they'd be counted in the token-weighted val loss
-            # even for datasets that don't produce masks themselves.
-            ds_keys = self._dataset_spec(val_ds)[0]
-            batch_keys = set(ds_keys) | {"attention_mask"}
-            batch = {
-                key: jax.make_array_from_callback(
-                    (eval_bs, seqlen), sharding, lambda i, k=key: fetch(k, i)
-                )
-                for key in batch_keys
-            }
+            batch = self._eval_batch(val_ds, indices, n_pad=pad)
             loss_sum, tokens = self._eval_step_fn(
                 nn_meta.unbox(self._state.params), batch
             )

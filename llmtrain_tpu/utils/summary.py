@@ -73,19 +73,27 @@ def _render_text(summary: dict[str, Any]) -> str:
     lines.append(f"  run_dir: {summary['run_dir']}")
     for section in ("run", "model", "data", "trainer", "distributed", "mlflow", "logging", "output"):
         lines.append(f"  {section}:")
-        for key, value in summary[section].items():
-            lines.append(f"    {key}: {value}")
+        _render_mapping(lines, summary[section], indent=2)
     env = summary.get("distributed_env") or {}
     if env:
         lines.append("  distributed_env:")
-        for key, value in env.items():
-            lines.append(f"    {key}: {value}")
+        _render_mapping(lines, env, indent=2)
     if "dry_run_resolution" in summary:
         lines.append("  dry_run_resolution:")
-        for key, value in summary["dry_run_resolution"].items():
-            lines.append(f"    {key}: {value}")
+        _render_mapping(lines, summary["dry_run_resolution"], indent=2)
     if "train_result" in summary:
         lines.append("  train_result:")
-        for key, value in summary["train_result"].items():
-            lines.append(f"    {key}: {value}")
+        _render_mapping(lines, summary["train_result"], indent=2)
     return "\n".join(lines)
+
+
+def _render_mapping(lines: list[str], mapping: dict[str, Any], indent: int) -> None:
+    """Indented key/value rendering; nested dicts (e.g. ``distributed.mesh``)
+    recurse instead of printing a one-line Python repr."""
+    pad = "  " * indent
+    for key, value in mapping.items():
+        if isinstance(value, dict) and value:
+            lines.append(f"{pad}{key}:")
+            _render_mapping(lines, value, indent + 1)
+        else:
+            lines.append(f"{pad}{key}: {value}")

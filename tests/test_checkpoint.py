@@ -117,6 +117,27 @@ class TestCheckpointManager:
         with pytest.raises(OSError):
             mgr.wait_pending()
 
+    def test_poll_surfaces_async_error_nonblocking(self, tmp_path):
+        """poll() re-raises a finished write's error without blocking; the
+        trainer calls it each log interval (ADVICE r1 item 4)."""
+        import time
+
+        target = tmp_path / "c"
+        target.write_text("a file where the checkpoint dir should be")
+        mgr = CheckpointManager(target)
+        mgr.save_host_async(1, {"step": 1, "params": {}, "opt_state": {}}, {})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                mgr.poll()
+            except OSError:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("poll() never surfaced the async write failure")
+        mgr.poll()  # drained: subsequent polls are clean no-ops
+        mgr.close()
+
     def test_async_queue_drains_previous_before_next(self, tmp_path, monkeypatch):
         """Single write in flight: queueing save N+1 blocks until N finished."""
         import threading

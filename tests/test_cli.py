@@ -252,6 +252,36 @@ class TestGenerate:
         assert a.returncode == 0 and b.returncode == 0, a.stderr + b.stderr
         assert json.loads(a.stdout)["completion_ids"] == json.loads(b.stdout)["completion_ids"]
 
+    def test_generate_eos_token_stops_early(self, workdir):
+        """--eos-token-id is wired through to generate(): once the EOS token
+        is produced, the rest of the completion is EOS-filled (ADVICE r1)."""
+        first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runE"], workdir)
+        assert first.returncode == 0, first.stderr
+        base = [
+            "generate",
+            "--config",
+            "config.yaml",
+            "--from",
+            "runE",
+            "--prompt-ids",
+            "1,2,3",
+            "--max-new-tokens",
+            "5",
+            "--temperature",
+            "0",
+            "--json",
+        ]
+        plain = _run(base, workdir)
+        assert plain.returncode == 0, plain.stderr
+        eos = json.loads(plain.stdout)["completion_ids"][0]
+        stopped = _run(base + ["--eos-token-id", str(eos)], workdir)
+        assert stopped.returncode == 0, stopped.stderr
+        completion = json.loads(stopped.stdout)["completion_ids"]
+        # Greedy decode reproduces the same first token, which is now EOS;
+        # every subsequent slot must be EOS-filled.
+        assert completion[0] == eos
+        assert all(t == eos for t in completion)
+
     def test_generate_missing_checkpoint_exit_1(self, workdir):
         proc = _run(
             [

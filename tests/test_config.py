@@ -90,6 +90,18 @@ def test_mesh_rejects_zero_axis():
         MeshConfig(tensor=0)
 
 
+def test_mesh_rejects_unwired_pipeline_expert_axes():
+    """pipeline/expert are reserved: nothing maps onto them, so sizes > 1
+    (or wildcard) must fail loudly instead of computing misleading layouts."""
+    with pytest.raises(Exception, match="reserved"):
+        MeshConfig(pipeline=2)
+    with pytest.raises(Exception, match="reserved"):
+        MeshConfig(expert=2)
+    with pytest.raises(Exception, match="reserved"):
+        MeshConfig(data=1, pipeline=-1)  # wildcard doesn't bypass the fence
+    assert MeshConfig(pipeline=1, expert=1).axis_sizes()["pipeline"] == 1
+
+
 def test_device_literal_is_cpu_or_tpu():
     bad = {**MINIMAL, "run": {"name": "t", "device": "mps"}}
     with pytest.raises(Exception):

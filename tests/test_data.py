@@ -136,8 +136,6 @@ def _hf_cfg(tmp_path, block_size=8):
 
 class TestHFText:
     def _patch_load(self, monkeypatch, rows):
-        import llmtrain_tpu.data.hf_text as mod
-
         calls = {"n": 0}
 
         class _FakeDS:

@@ -1,0 +1,137 @@
+"""Real-MLflow SQLite round-trip (parity with reference tests/test_cli.py:628-704).
+
+A full CLI train against a ``sqlite:///`` tracking URI, then the runs,
+params, metrics, and artifacts queried back via ``MlflowClient``, asserting
+the ``llmtrain.run_id`` tag. Plus the crash-restart story: an
+``--auto-resume`` relaunch with the same stable run id must CONTINUE the
+original MLflow run (join by tag), not open a second one.
+
+Skips when the optional mlflow extra is not installed (this image ships
+without it); runs for real wherever ``pip install .[mlflow]`` happened —
+e.g. the k8s image (k8s/Dockerfile).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+mlflow = pytest.importorskip("mlflow")
+
+from mlflow.tracking import MlflowClient  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+CFG = {
+    "schema_version": 1,
+    "run": {"name": "mlflow-rt", "seed": 11, "device": "cpu", "deterministic": True},
+    "model": {
+        "name": "dummy_gpt",
+        "block_size": 8,
+        "d_model": 48,
+        "n_layers": 1,
+        "n_heads": 2,
+        "d_ff": 96,
+        "dropout": 0.0,
+        "vocab_size": 32,
+    },
+    "data": {"name": "dummy_text"},
+    "trainer": {
+        "max_steps": 6,
+        "micro_batch_size": 2,
+        "grad_accum_steps": 1,
+        "lr": 0.003,
+        "warmup_steps": 0,
+        "log_every_steps": 3,
+        "eval_every_steps": 3,
+        "save_every_steps": 3,
+    },
+    "logging": {"level": "INFO", "json_output": True, "log_to_file": True},
+    "output": {"root_dir": "runs"},
+}
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=env,
+        timeout=420,
+    )
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    db = tmp_path / "mlflow.db"
+    cfg = {
+        **CFG,
+        "mlflow": {
+            "enabled": True,
+            "tracking_uri": f"sqlite:///{db}",
+            "experiment": "rt-exp",
+        },
+    }
+    (tmp_path / "config.yaml").write_text(yaml.safe_dump(cfg))
+    return tmp_path
+
+
+class TestMLflowRoundTrip:
+    def test_train_then_query_back(self, workdir):
+        proc = _run_cli(
+            ["train", "--config", "config.yaml", "--json", "--run-id", "rt1"], workdir
+        )
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["train_result"]["final_step"] == 6
+
+        client = MlflowClient(tracking_uri=f"sqlite:///{workdir / 'mlflow.db'}")
+        experiment = client.get_experiment_by_name("rt-exp")
+        assert experiment is not None
+        runs = client.search_runs([experiment.experiment_id])
+        assert len(runs) == 1
+        run = runs[0]
+
+        assert run.data.tags["llmtrain.run_id"] == "rt1"
+        assert run.data.params["model.d_model"] == "48"
+        assert run.data.params["trainer.max_steps"] == "6"
+        assert "train/loss" in run.data.metrics
+        assert "val/loss" in run.data.metrics
+        history = client.get_metric_history(run.info.run_id, "train/loss")
+        assert [m.step for m in history] == [3, 6]
+
+        artifacts = {a.path for a in client.list_artifacts(run.info.run_id)}
+        assert "config.yaml" in artifacts
+        assert "meta.json" in artifacts
+        assert run.info.status == "FINISHED"
+
+    def test_auto_resume_continues_same_mlflow_run(self, workdir):
+        first = _run_cli(
+            [
+                "train", "--config", "config.yaml", "--json",
+                "--run-id", "rt2", "--auto-resume",
+            ],
+            workdir,
+        )
+        assert first.returncode == 0, first.stderr
+        second = _run_cli(
+            [
+                "train", "--config", "config.yaml", "--json",
+                "--run-id", "rt2", "--auto-resume",
+            ],
+            workdir,
+        )
+        assert second.returncode == 0, second.stderr
+
+        client = MlflowClient(tracking_uri=f"sqlite:///{workdir / 'mlflow.db'}")
+        experiment = client.get_experiment_by_name("rt-exp")
+        runs = client.search_runs([experiment.experiment_id])
+        # The relaunch joined the original run via the llmtrain.run_id tag.
+        assert len(runs) == 1
+        assert runs[0].data.tags["llmtrain.run_id"] == "rt2"

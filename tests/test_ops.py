@@ -146,6 +146,19 @@ class TestRingAttention:
         out = ring_or_blockwise(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(_dense_ref(q, k, v)), atol=1e-5)
 
+    def test_external_mesh_with_only_sequence_axis(self):
+        """An externally built mesh carrying a sequence axis but none of
+        data/fsdp/tensor must still route through ring attention (missing
+        axes count as unsharded), not KeyError at trace time (ADVICE r1)."""
+        from llmtrain_tpu.ops.ring_attention import ring_or_blockwise
+
+        q, k, v = _qkv(b=4, t=16, h=2, d=8)
+        ref = _dense_ref(q, k, v)
+        mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:2]), ("sequence",))
+        with mesh:
+            out = jax.jit(ring_or_blockwise)(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
     def test_ring_gpt_matches_dense_gpt_under_mesh(self):
         kwargs = dict(
             vocab_size=64,

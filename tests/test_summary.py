@@ -1,0 +1,191 @@
+"""Run-summary contract tests (parity with reference tests/test_summary.py,
+309 lines of coverage on both render modes): every config section echoed,
+``Planned run:`` vs ``Run summary:`` headers, nested ``distributed.mesh``
+rendering, dry-run resolution block, train-result block, env snapshot."""
+
+from dataclasses import dataclass
+
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.utils import format_run_summary
+
+MINIMAL = {
+    "run": {"name": "sum-test", "seed": 13},
+    "model": {"name": "dummy_gpt", "block_size": 8, "vocab_size": 32},
+    "data": {"name": "dummy_text"},
+    "trainer": {"max_steps": 10, "warmup_steps": 0},
+    "distributed": {"mesh": {"data": 2, "fsdp": 2, "tensor": 1, "sequence": 1}},
+}
+
+
+@dataclass
+class _FakeDryRunResult:
+    model_adapter: str = "DummyGPTAdapter"
+    data_module: str = "DummyTextDataModule"
+    steps_executed: int = 5
+
+
+@dataclass
+class _FakeTrainResult:
+    final_step: int = 10
+    final_loss: float = 1.25
+    final_val_loss: float | None = 1.5
+    first_step_loss: float | None = 3.0
+    total_tokens: int = 640
+    total_time: float = 2.5
+    peak_memory: float = 0.0
+    parameter_count: int = 1000
+    trainable_parameter_count: int = 1000
+    val_metrics: dict | None = None
+    resumed_from_step: int | None = None
+
+
+def _cfg(overrides=None):
+    base = dict(MINIMAL)
+    if overrides:
+        base = {**base, **overrides}
+    return RunConfig.model_validate(base)
+
+
+class TestJsonSummary:
+    def test_every_section_echoed(self):
+        s = format_run_summary(_cfg(), run_id="rid", run_dir="/r/rid", as_json=True)
+        for section in (
+            "run", "model", "data", "trainer", "distributed",
+            "mlflow", "logging", "output", "distributed_env",
+        ):
+            assert section in s, f"missing section {section}"
+        assert s["run_id"] == "rid"
+        assert s["run_dir"] == "/r/rid"
+        assert s["dry_run"] is False
+
+    def test_mesh_round_trips_in_json(self):
+        s = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=True)
+        assert s["distributed"]["mesh"]["data"] == 2
+        assert s["distributed"]["mesh"]["fsdp"] == 2
+
+    def test_defaults_materialized(self):
+        """Sections absent from the input YAML appear fully defaulted."""
+        s = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=True)
+        assert s["mlflow"]["enabled"] is True  # reference default: enabled
+        assert s["output"]["root_dir"] == "runs"
+        assert s["logging"]["level"] == "INFO"
+
+    def test_dry_run_resolution_block(self):
+        s = format_run_summary(
+            _cfg(),
+            run_id="r",
+            run_dir=None,
+            dry_run=True,
+            dry_run_result=_FakeDryRunResult(),
+            as_json=True,
+        )
+        assert s["dry_run"] is True
+        assert s["dry_run_resolution"] == {
+            "model_adapter": "DummyGPTAdapter",
+            "data_module": "DummyTextDataModule",
+            "steps_executed": 5,
+        }
+
+    def test_train_result_block_complete(self):
+        result = _FakeTrainResult(val_metrics={"val/loss": 1.5}, resumed_from_step=5)
+        s = format_run_summary(
+            _cfg(), run_id="r", run_dir=None, train_result=result, as_json=True
+        )
+        tr = s["train_result"]
+        assert tr["final_step"] == 10
+        assert tr["final_loss"] == 1.25
+        assert tr["final_val_loss"] == 1.5
+        assert tr["first_step_loss"] == 3.0
+        assert tr["total_tokens"] == 640
+        assert tr["parameter_count"] == 1000
+        assert tr["trainable_parameter_count"] == 1000
+        assert tr["val_metrics"] == {"val/loss": 1.5}
+        assert tr["resumed_from_step"] == 5
+
+    def test_val_metrics_none_becomes_empty_dict(self):
+        s = format_run_summary(
+            _cfg(), run_id="r", run_dir=None, train_result=_FakeTrainResult(), as_json=True
+        )
+        assert s["train_result"]["val_metrics"] == {}
+
+    def test_env_snapshot_captures_rank_vars(self, monkeypatch):
+        monkeypatch.setenv("RANK", "3")
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        s = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=True)
+        assert s["distributed_env"].get("RANK") == "3"
+        assert s["distributed_env"].get("WORLD_SIZE") == "8"
+
+
+class TestTextSummary:
+    def test_planned_run_header_for_dry_run(self):
+        text = format_run_summary(
+            _cfg(), run_id="r", run_dir=None, dry_run=True, as_json=False
+        )
+        assert text.startswith("Planned run:")
+
+    def test_run_summary_header_otherwise(self):
+        text = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=False)
+        assert text.startswith("Run summary:")
+
+    def test_all_sections_present_as_headers(self):
+        text = format_run_summary(_cfg(), run_id="rid", run_dir="/r/rid", as_json=False)
+        for section in (
+            "run:", "model:", "data:", "trainer:", "distributed:",
+            "mlflow:", "logging:", "output:",
+        ):
+            assert f"\n  {section}" in text, f"missing text section {section}"
+        assert "  run_id: rid" in text
+        assert "  run_dir: /r/rid" in text
+
+    def test_nested_mesh_renders_indented_not_repr(self):
+        """distributed.mesh is a nested dict: each axis gets its own indented
+        line; no one-line Python dict repr leaks into the report."""
+        text = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=False)
+        assert "    mesh:\n" in text
+        assert "      data: 2\n" in text
+        assert "      fsdp: 2\n" in text
+        assert "{'data'" not in text
+
+    def test_indentation_hierarchy(self):
+        text = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=False)
+        lines = text.splitlines()
+        section_lines = [ln for ln in lines if ln == "  model:"]
+        assert len(section_lines) == 1
+        i = lines.index("  model:")
+        assert lines[i + 1].startswith("    ")
+
+    def test_train_result_rendered(self):
+        text = format_run_summary(
+            _cfg(),
+            run_id="r",
+            run_dir=None,
+            train_result=_FakeTrainResult(resumed_from_step=7),
+            as_json=False,
+        )
+        assert "  train_result:" in text
+        assert "    final_step: 10" in text
+        assert "    resumed_from_step: 7" in text
+
+    def test_dry_run_resolution_rendered(self):
+        text = format_run_summary(
+            _cfg(),
+            run_id="r",
+            run_dir=None,
+            dry_run=True,
+            dry_run_result=_FakeDryRunResult(),
+            as_json=False,
+        )
+        assert "  dry_run_resolution:" in text
+        assert "    model_adapter: DummyGPTAdapter" in text
+        assert "    steps_executed: 5" in text
+
+    def test_empty_env_snapshot_section_omitted(self, monkeypatch):
+        for var in (
+            "RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR", "MASTER_PORT",
+            "JAX_PROCESS_ID", "JAX_NUM_PROCESSES", "JAX_COORDINATOR_ADDRESS",
+            "JOB_COMPLETION_INDEX",
+        ):
+            monkeypatch.delenv(var, raising=False)
+        text = format_run_summary(_cfg(), run_id="r", run_dir=None, as_json=False)
+        assert "distributed_env:" not in text

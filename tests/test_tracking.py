@@ -50,8 +50,12 @@ def fake_mlflow(monkeypatch):
         "log_metrics",
         "log_artifact",
         "end_run",
+        "get_experiment_by_name",
+        "search_runs",
     ):
         setattr(stub, name, getattr(mock, name))
+    # Default: no experiment yet -> no join-search -> fresh run.
+    mock.get_experiment_by_name.return_value = None
     monkeypatch.setitem(sys.modules, "mlflow", stub)
     return mock
 
@@ -108,3 +112,31 @@ class TestMLflowTracker:
         t = MLflowTracker("file:./mlruns", "exp")
         t.start_run("rid-9")
         fake_mlflow.start_run.assert_called_once_with(run_name="rid-9")
+
+    def test_reattaches_to_run_with_matching_tag(self, fake_mlflow):
+        """A relaunch with the same framework run id (--auto-resume) must
+        CONTINUE the original MLflow run, keyed by the llmtrain.run_id tag."""
+        exp = Mock()
+        exp.experiment_id = "7"
+        fake_mlflow.get_experiment_by_name.return_value = exp
+        found = Mock()
+        found.info.run_id = "mlflow-abc"
+        fake_mlflow.search_runs.return_value = [found]
+
+        t = MLflowTracker("sqlite:///x.db", "exp")
+        t.start_run("rid-stable")
+        fake_mlflow.search_runs.assert_called_once_with(
+            experiment_ids=["7"],
+            filter_string="tags.\"llmtrain.run_id\" = 'rid-stable'",
+            max_results=1,
+            output_format="list",
+        )
+        fake_mlflow.start_run.assert_called_once_with(run_id="mlflow-abc")
+        fake_mlflow.set_tag.assert_not_called()  # tag already on the run
+
+    def test_search_failure_falls_back_to_fresh_run(self, fake_mlflow):
+        fake_mlflow.get_experiment_by_name.side_effect = RuntimeError("backend down")
+        t = MLflowTracker("sqlite:///x.db", "exp")
+        t.start_run("rid-2")
+        fake_mlflow.start_run.assert_called_once_with(run_name="rid-2")
+        fake_mlflow.set_tag.assert_called_once_with("llmtrain.run_id", "rid-2")

@@ -5,7 +5,6 @@ per-rank (per-data-shard) metric naming."""
 import math
 from unittest.mock import Mock
 
-import jax
 import numpy as np
 import pytest
 
