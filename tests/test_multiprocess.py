@@ -49,12 +49,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_data_parallel_train(tmp_path):
-    cfg_path = tmp_path / "config.yaml"
-    cfg_path.write_text(yaml.safe_dump(CFG))
+def _launch_two_process(tmp_path, config_name: str, run_id: str, extra_args=()):
+    """Run the CLI as two rendezvousing processes; returns [(rc, out, err)]."""
     port = _free_port()
-
     procs = []
     for rank in range(2):
         env = dict(os.environ)
@@ -74,10 +71,11 @@ def test_two_process_data_parallel_train(tmp_path):
                     "llmtrain_tpu",
                     "train",
                     "--config",
-                    "config.yaml",
+                    config_name,
                     "--json",
                     "--run-id",
-                    "mp_run",
+                    run_id,
+                    *extra_args,
                 ],
                 cwd=tmp_path,
                 env=env,
@@ -86,11 +84,38 @@ def test_two_process_data_parallel_train(tmp_path):
                 text=True,
             )
         )
-
     outs = []
-    for proc in procs:
-        out, err = proc.communicate(timeout=300)
-        outs.append((proc.returncode, out, err))
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=300)
+            outs.append((proc.returncode, out, err))
+    finally:
+        # A deadlocked collective leaves the other rank hung holding the
+        # rendezvous port; kill survivors so later launches can't hang.
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return outs
+
+
+def _summary_lines(out: str) -> list[str]:
+    return [ln for ln in out.splitlines() if ln.startswith("{")]
+
+
+def _summary(outs) -> dict:
+    """Rank 0's JSON summary (its only '{'-prefixed stdout line)."""
+    lines = _summary_lines(outs[0][1])
+    assert len(lines) == 1
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_train(tmp_path):
+    cfg_path = tmp_path / "config.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+
+    outs = _launch_two_process(tmp_path, "config.yaml", "mp_run")
 
     for rc, out, err in outs:
         assert rc == 0, f"rank failed: {err[-2000:]}"
@@ -98,18 +123,66 @@ def test_two_process_data_parallel_train(tmp_path):
     # Rank 0 prints the JSON summary as its last stdout line; rank 1 prints
     # no summary. (XLA's CPU gloo backend chats "[Gloo] ..." on stdout — a
     # CPU-test artifact that doesn't exist on TPU.)
-    def summary_lines(out):
-        return [ln for ln in out.splitlines() if ln.startswith("{")]
-
-    rank0_json = summary_lines(outs[0][1])
-    assert len(rank0_json) == 1
-    summary = json.loads(rank0_json[0])
+    summary = _summary(outs)
     assert summary["train_result"]["final_step"] == 4
     assert summary["train_result"]["final_loss"] > 0
-    assert summary_lines(outs[1][1]) == []
+    assert _summary_lines(outs[1][1]) == []
 
     # Exactly one run dir, created by rank 0 only, with the expected ckpts.
     runs = list((tmp_path / "runs").iterdir())
     assert [p.name for p in runs] == ["mp_run"]
     ckpts = sorted(p.name for p in (tmp_path / "runs" / "mp_run" / "checkpoints").iterdir())
     assert ckpts == ["step_000002.ckpt", "step_000004.ckpt"]
+
+
+@pytest.mark.slow
+def test_two_process_fsdp_sharded_checkpoint_resume(tmp_path):
+    """2-process GPT run with fsdp:2 spanning the process boundary: save at
+    step 2, resume in fresh processes, final loss within 1e-5 of the
+    continuous run (VERDICT r1 #5). Params are NOT fully addressable from
+    either process, so the save path exercises checkpoint._to_host's
+    process_allgather collective and restore exercises _rebox_like +
+    resharding of fsdp-sharded state (reference counterpart:
+    tests/test_distributed.py:705-784 + test_checkpoint.py:301-320)."""
+    fsdp_cfg = {
+        **CFG,
+        "run": {"name": "mp-fsdp", "seed": 23, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 1,
+            "n_heads": 2,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+        },
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 60,
+            # 8 global devices: data=4 outer, fsdp=2 inner — each fsdp
+            # shard-pair spans devices owned by different processes.
+            "mesh": {"data": -1, "fsdp": 2, "tensor": 1, "sequence": 1},
+        },
+    }
+    (tmp_path / "full.yaml").write_text(yaml.safe_dump(fsdp_cfg))
+
+    # Continuous 4-step run; save_every=2 leaves a mid-run step-2 checkpoint.
+    # (Resuming from the SAME config keeps the cosine-decay horizon identical
+    # — a shorter-max_steps run would train steps 1-2 under different LRs.)
+    full = _launch_two_process(tmp_path, "full.yaml", "mp_full")
+    for rc, _, err in full:
+        assert rc == 0, f"continuous run failed: {err[-2000:]}"
+    full_loss = _summary(full)["train_result"]["final_loss"]
+    mid_ckpt = tmp_path / "runs" / "mp_full" / "checkpoints" / "step_000002.ckpt"
+    assert mid_ckpt.is_file()
+
+    resumed = _launch_two_process(
+        tmp_path, "full.yaml", "mp_resumed", extra_args=("--resume", str(mid_ckpt))
+    )
+    for rc, _, err in resumed:
+        assert rc == 0, f"resumed run failed: {err[-2000:]}"
+    result = _summary(resumed)["train_result"]
+    assert result["resumed_from_step"] == 2
+    assert result["final_step"] == 4
+    assert result["final_loss"] == pytest.approx(full_loss, abs=1e-5)
