@@ -143,16 +143,16 @@ class MeshConfig(BaseModel):
         for axis, v in sizes.items():
             if v == 0 or v < -1:
                 raise ValueError(f"mesh axis {axis!r} must be a positive int or -1")
-        # No sharding rule maps onto pipeline/expert yet — reject sizes > 1
-        # loudly instead of silently computing layouts that ignore the axis.
-        for axis in ("pipeline", "expert"):
-            if sizes[axis] != 1:
-                raise ValueError(
-                    f"mesh axis {axis!r} is reserved for future "
-                    f"pipeline/expert parallelism and must be 1 (got "
-                    f"{sizes[axis]}): no parameter or activation sharding "
-                    "rule maps onto it yet"
-                )
+        # No sharding rule maps onto pipeline yet — reject sizes > 1 loudly
+        # instead of silently computing layouts that ignore the axis.
+        # (`expert` is wired: MoE expert weights shard over it and it carries
+        # batch shards for dense compute — parallel/sharding.py.)
+        if sizes["pipeline"] != 1:
+            raise ValueError(
+                "mesh axis 'pipeline' is reserved for future pipeline "
+                f"parallelism and must be 1 (got {sizes['pipeline']}): no "
+                "parameter or activation sharding rule maps onto it yet"
+            )
         return self
 
     def axis_sizes(self) -> dict[str, int]:

@@ -237,6 +237,10 @@ class TransformerBlock(nn.Module):
     attention: str = "dense"
     decode: bool = False
     cache_len: int = 0
+    # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @nn.compact
     def __call__(
@@ -266,24 +270,39 @@ class TransformerBlock(nn.Module):
         )(h, attention_mask, deterministic=deterministic)
 
         h = nn.LayerNorm(name="ln_2", **ln_kw)(x)
-        h = nn.Dense(
-            self.d_ff,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("mlp",)),
-            name="mlp_fc",
-        )(h)
-        h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
-        h = nn.gelu(h, approximate=False)
-        h = nn.Dense(
-            self.d_model,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(_scaled_init(self.n_layers), ("mlp", "embed")),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
-            name="mlp_proj",
-        )(h)
+        if self.n_experts > 0:
+            from .moe import MoEMLP
+
+            h = MoEMLP(
+                d_model=self.d_model,
+                d_ff=self.d_ff,
+                n_experts=self.n_experts,
+                n_layers=self.n_layers,
+                capacity_factor=self.capacity_factor,
+                aux_loss_weight=self.moe_aux_weight,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="moe_mlp",
+            )(h)
+        else:
+            h = nn.Dense(
+                self.d_ff,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
+                bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("mlp",)),
+                name="mlp_fc",
+            )(h)
+            h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
+            h = nn.gelu(h, approximate=False)
+            h = nn.Dense(
+                self.d_model,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_scaled_init(self.n_layers), ("mlp", "embed")),
+                bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+                name="mlp_proj",
+            )(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
         return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
@@ -306,6 +325,10 @@ class GPT(nn.Module):
     attention: str = "dense"
     decode: bool = False  # KV-cache generation mode (see for_decoding())
     decode_cache_len: int = 0  # KV-cache capacity; 0 = block_size
+    # Mixture-of-Experts (models/moe.py); 0 = dense MLPs in every block.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -384,6 +407,9 @@ class GPT(nn.Module):
                 attention=self.attention,
                 decode=self.decode,
                 cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
+                n_experts=self.n_experts,
+                capacity_factor=self.capacity_factor,
+                moe_aux_weight=self.moe_aux_weight,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 

@@ -1,0 +1,78 @@
+"""Mixture-of-Experts GPT adapter (``gpt_moe``).
+
+New model family beyond the reference (dense-MLP GPT only). Reuses the GPT
+trunk (models/gpt.py) with every block's MLP replaced by the Switch-style
+``MoEMLP`` (models/moe.py); expert parallelism comes from the mesh's
+``expert`` axis via sharding annotations alone.
+
+Config knobs ride the ``model.extra`` escape hatch (the reference's plugin
+mechanism, reference config/schemas.py:37):
+
+    model:
+      name: gpt_moe
+      extra:
+        n_experts: 8           # required, >= 2
+        capacity_factor: 1.25  # optional
+        moe_aux_weight: 0.01   # optional; load-balance loss scale
+
+The training objective is CE + load-balance aux (sown by each MoE layer);
+the aux term is folded into the per-example loss sums proportionally to
+token counts, so the trainer's token-weighted aggregation reports exactly
+``CE + aux`` with unchanged per-rank metric semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .base import Batch, Params, masked_ce_components, validate_lm_batch
+from .gpt import GPTAdapter
+
+
+@register_model("gpt_moe")
+class GPTMoEAdapter(GPTAdapter):
+    """GPT with Mixture-of-Experts MLPs and expert parallelism."""
+
+    def build_model(self, cfg: RunConfig):
+        extra = cfg.model.extra
+        n_experts = int(extra.get("n_experts", 0))
+        if n_experts < 2:
+            raise ValueError(
+                "gpt_moe requires model.extra.n_experts >= 2 "
+                f"(got {n_experts}); use model.name 'gpt' for a dense MLP"
+            )
+        base = super().build_model(cfg)
+        return base.clone(
+            n_experts=n_experts,
+            capacity_factor=float(extra.get("capacity_factor", 1.25)),
+            moe_aux_weight=float(extra.get("moe_aux_weight", 0.01)),
+        )
+
+    def compute_loss_components(
+        self,
+        model,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, jax.Array]:
+        input_ids, labels, attention_mask = validate_lm_batch(batch)
+        logits, mutated = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask=attention_mask,
+            deterministic=deterministic,
+            rngs=rngs,
+            mutable=["losses"],
+        )
+        loss_sum, tokens = masked_ce_components(logits, labels, attention_mask)
+        aux = sum(jax.tree.leaves(mutated.get("losses", {})))
+        # Fold aux in proportionally to tokens: the trainer's
+        # sum(loss_sum)/sum(tokens) then equals CE + aux exactly.
+        return loss_sum + aux * tokens, tokens
+
+
+__all__ = ["GPTMoEAdapter"]

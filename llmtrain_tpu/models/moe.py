@@ -1,0 +1,145 @@
+"""Mixture-of-Experts MLP (Switch-style top-1 routing), mesh-first.
+
+New capability beyond the reference (dense MLP only, reference
+models/gpt.py:94-97), designed the TPU/XLA way (GShard/Switch pattern):
+routing is expressed as dense one-hot dispatch/combine einsums over a
+(tokens, experts, capacity) layout, and expert parallelism falls out of
+sharding annotations — expert weights carry the logical ``expert`` axis and
+dispatched activations carry ``act_expert``; with a mesh whose ``expert``
+axis is > 1, XLA's SPMD partitioner inserts the token all-to-alls. No
+hand-written collectives.
+
+Semantics:
+
+* top-1 routing (Switch Transformer): each token goes to its argmax expert,
+  output scaled by the router probability.
+* fixed expert capacity ``ceil(capacity_factor * T / n_experts)`` per
+  sequence; tokens over capacity are dropped — they pass through the
+  residual connection unchanged (output 0 from the MoE layer).
+* load-balance auxiliary loss ``aux_weight * E^2 * mean_e(f_e * P_e)``
+  sown into the ``losses`` collection; the gpt_moe adapter folds it into
+  the training objective. ``sow`` is a no-op when the collection isn't
+  mutable, so eval/generation paths need no changes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+_DENSE_INIT = nn.initializers.normal(stddev=0.02)
+
+
+def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
+    return nn.initializers.normal(stddev=0.02 / math.sqrt(2 * n_layers))
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense MLP inside a transformer block."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    n_layers: int
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, seqlen, d_model = x.shape
+        n_exp = self.n_experts
+        capacity = max(1, int(math.ceil(self.capacity_factor * seqlen / n_exp)))
+
+        # Router in float32: softmax over tiny expert dim must not run bf16.
+        router_logits = nn.Dense(
+            n_exp,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", None)),
+            name="router",
+        )(x.astype(jnp.float32))
+        gates = jax.nn.softmax(router_logits, axis=-1)  # (B, T, E) f32
+
+        expert_index = jnp.argmax(gates, axis=-1)  # (B, T)
+        expert_mask = jax.nn.one_hot(expert_index, n_exp, dtype=jnp.float32)
+
+        # Switch load-balance loss: E * sum_e f_e * P_e per sequence
+        # (fraction of tokens routed to e times mean router prob of e),
+        # scaled so a perfectly uniform router gives aux_weight * 1.0.
+        density = expert_mask.mean(axis=1)  # (B, E)
+        density_proxy = gates.mean(axis=1)  # (B, E)
+        aux = self.aux_loss_weight * n_exp * n_exp * jnp.mean(density * density_proxy)
+        self.sow("losses", "moe_aux", aux)
+
+        # Position of each token in its expert's queue (1-based), capacity cut.
+        position_in_expert = jnp.cumsum(expert_mask, axis=1) * expert_mask
+        expert_mask = expert_mask * (position_in_expert <= capacity)
+        gate = jnp.sum(gates * expert_mask, axis=-1)  # (B, T); 0 when dropped
+
+        # One-hot over capacity slots; dropped tokens (position 0 -> -1) map
+        # to all-zero rows.
+        position = jnp.sum(position_in_expert * expert_mask, axis=-1) - 1.0
+        position_oh = jax.nn.one_hot(position.astype(jnp.int32), capacity, dtype=jnp.float32)
+        dispatch = expert_mask[..., None] * position_oh[:, :, None, :]  # (B,T,E,C)
+        combine = dispatch * gate[:, :, None, None]
+
+        # Dispatch tokens: (B,T,E,C) x (B,T,D) -> (E,B,C,D). The E dim is
+        # expert-sharded, B stays data-sharded (act_expert_group) — the
+        # resharding between the two layouts is the all-to-all.
+        expert_in = jnp.einsum(
+            "btec,btd->ebcd", dispatch.astype(x.dtype), x.astype(x.dtype)
+        )
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("act_expert", "act_expert_group", None, "act_embed")
+        )
+
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(_DENSE_INIT, ("expert", "embed", "mlp")),
+            (n_exp, d_model, self.d_ff),
+            self.param_dtype,
+        )
+        bi = self.param(
+            "bi",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "mlp")),
+            (n_exp, self.d_ff),
+            self.param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                _scaled_init(self.n_layers), ("expert", "mlp", "embed")
+            ),
+            (n_exp, self.d_ff, d_model),
+            self.param_dtype,
+        )
+        bo = self.param(
+            "bo",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "embed")),
+            (n_exp, d_model),
+            self.param_dtype,
+        )
+
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(self.dtype))
+        h = h + bi.astype(self.dtype)[:, None, None, :]
+        h = nn.with_logical_constraint(h, ("act_expert", "act_expert_group", None, "act_mlp"))
+        h = nn.gelu(h, approximate=False)
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+        expert_out = expert_out + bo.astype(self.dtype)[:, None, None, :]
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("act_expert", "act_expert_group", None, "act_embed")
+        )
+
+        # Combine back to (B, T, D); dropped tokens get 0 (residual carries them).
+        out = jnp.einsum("btec,ebcd->btd", combine.astype(x.dtype), expert_out)
+        return nn.with_logical_constraint(out, ("batch", "length", "act_embed"))
+
+
+__all__ = ["MoEMLP"]

@@ -1,10 +1,18 @@
-"""Flash attention dispatch: Pallas forward on TPU, blockwise everywhere.
+"""Flash attention dispatch: Pallas kernels on TPU, blockwise everywhere.
 
 New TPU capability beyond the reference (full-matrix attention only,
 reference models/gpt.py:56-69). Training differentiates through a
-``jax.custom_vjp``: the forward runs the Pallas kernel on TPU (or blockwise
-on CPU), the backward recomputes via the checkpointed blockwise
-implementation — O(T) memory both directions, no (T, T) materialization.
+``jax.custom_vjp``:
+
+* on TPU both directions run the Pallas kernels (pallas_attention.py) —
+  the forward saves its logsumexp residual and the backward computes
+  dq/dk/dv in two fused kernels (FlashAttention-2 scheme);
+* elsewhere the backward differentiates the checkpointed XLA blockwise
+  implementation.
+
+Both paths are O(T) memory — no (T, T) materialization. Set
+``LLMTRAIN_FLASH_BWD=blockwise`` to force the recompute backward on TPU
+(the A/B knob for benchmarking fused vs recompute).
 
 Padding masks route to the model's dense path (``models/gpt.py``); flash is
 the packed/causal fast path, which is also what the data pipeline produces
@@ -13,33 +21,47 @@ the packed/causal fast path, which is also what the data pipeline produces
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 from .blockwise_attention import blockwise_attention
 
 
-def _forward_best(q, k, v, causal: bool):
-    # The Pallas kernel tiles with block_q=block_k=256 (min'd with T), so T
+def _use_pallas(t: int) -> bool:
+    # The Pallas kernels tile with block_q=block_k=256 (min'd with T), so T
     # must divide evenly by the actual block size or the kernel raises.
-    t = q.shape[1]
-    if jax.default_backend() == "tpu" and t >= 128 and t % min(256, t) == 0:
-        from .pallas_attention import pallas_flash_attention
+    return jax.default_backend() == "tpu" and t >= 128 and t % min(256, t) == 0
 
-        return pallas_flash_attention(q, k, v, causal=causal)
-    return blockwise_attention(q, k, v, causal=causal)
+
+def _pallas_bwd_enabled() -> bool:
+    return os.environ.get("LLMTRAIN_FLASH_BWD", "pallas").lower() != "blockwise"
 
 
 @jax.custom_vjp
 def _flash(q, k, v):
-    return _forward_best(q, k, v, causal=True)
+    if _use_pallas(q.shape[1]):
+        from .pallas_attention import pallas_flash_attention
+
+        return pallas_flash_attention(q, k, v, causal=True)
+    return blockwise_attention(q, k, v, causal=True)
 
 
 def _flash_fwd(q, k, v):
-    return _flash(q, k, v), (q, k, v)
+    if _use_pallas(q.shape[1]) and _pallas_bwd_enabled():
+        from .pallas_attention import pallas_flash_attention_fwd
+
+        out, lse = pallas_flash_attention_fwd(q, k, v, causal=True)
+        return out, (q, k, v, out, lse)
+    return _flash(q, k, v), (q, k, v, None, None)
 
 
 def _flash_bwd(residuals, g):
-    q, k, v = residuals
+    q, k, v, out, lse = residuals
+    if out is not None:
+        from .pallas_attention import pallas_flash_attention_bwd
+
+        return pallas_flash_attention_bwd(q, k, v, out, lse, g, causal=True)
     _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=True), q, k, v)
     return vjp(g)
 

@@ -1,11 +1,23 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels: forward and backward.
 
 The MXU-resident hot path for causal attention: one grid program per
 (batch*head, q-block), streaming K/V through VMEM with online softmax, so
 nothing of shape (T, T) ever exists. Written per the Pallas TPU guide
 (grid/BlockSpec tiling, f32 accumulation via preferred_element_type, 2-D
-iota for masks). Differentiability is provided in ``ops/flash_attention.py``
-via custom_vjp with a blockwise-recompute backward.
+iota for masks).
+
+Backward (FlashAttention-2 recompute scheme): the forward also emits the
+per-row logsumexp L; the backward recomputes P = exp(S - L) block-by-block
+— never materializing (T, T) — in two kernels:
+
+* dq kernel, gridded like the forward (per q-block, streaming K/V):
+  dS = P * (dO Vᵀ - D),  dQ = scale * dS K,  with D = rowsum(dO * O).
+* dk/dv kernel, gridded per k-block, streaming Q/dO/L/D from the causal
+  diagonal down:  dV = Pᵀ dO,  dK = scale * dSᵀ Q.
+
+``ops/flash_attention.py`` wires these into a ``jax.custom_vjp``; on
+non-TPU backends it falls back to differentiating the XLA blockwise
+implementation.
 """
 
 from __future__ import annotations
@@ -20,10 +32,14 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, causal: bool):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int, scale: float, causal: bool
+):
     """One q-block vs the streamed K/V sequence.
 
-    Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D).
+    Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D), l (1, BQ).
+    ``l`` is the per-row logsumexp of the scaled/masked logits — the
+    residual the backward kernels use to recompute P without a re-softmax.
     """
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -70,13 +86,77 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, cau
         jnp.full((block_q,), _NEG_INF, jnp.float32),
         jnp.zeros((block_q,), jnp.float32),
     )
-    acc, _, row_sum = jax.lax.fori_loop(0, num_kv, body, init)
+    acc, row_max, row_sum = jax.lax.fori_loop(0, num_kv, body, init)
     o_ref[0] = (acc / row_sum[:, None]).astype(o_ref.dtype)
+    l_ref[0] = row_max + jnp.log(row_sum)
+
+
+def _fold(x: jax.Array) -> jax.Array:
+    """(B, T, H, D) -> (B*H, T, D): heads join the grid batch dimension."""
+    b, t, h, d = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+
+
+def _unfold(x: jax.Array, b: int, h: int) -> jax.Array:
+    bh, t, d = x.shape
+    return jnp.moveaxis(x.reshape(b, h, t, d), 1, 2)
+
+
+def _check_blocks(t: int, block_q: int, block_k: int) -> tuple[int, int]:
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError(f"sequence length {t} must be divisible by block sizes")
+    return block_q, block_k
 
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
+def pallas_flash_attention_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Flash attention over (B, T, H, D) returning ``(out, lse)``.
+
+    ``lse`` has shape (B*H, T), float32 — the backward-pass residual.
+    Falls back to smaller blocks automatically when T < block size.
+    """
+    b, t, h, d = q.shape
+    block_q, block_k = _check_blocks(t, block_q, block_k)
+
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    scale = 1.0 / math.sqrt(d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    return _unfold(out, b, h), lse
+
+
 def pallas_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -87,35 +167,206 @@ def pallas_flash_attention(
     block_k: int = 256,
     interpret: bool = False,
 ) -> jax.Array:
-    """Causal flash attention over (B, T, H, D); forward only.
+    """Causal flash attention over (B, T, H, D); forward only."""
+    out, _ = pallas_flash_attention_fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+    return out
 
-    Falls back to smaller blocks automatically when T < block size.
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref,
+    *, block_k: int, scale: float, causal: bool,
+):
+    """dQ for one q-block, streaming K/V (same schedule as the forward).
+
+    Ref shapes: q/do/dq (1, BQ, D), k/v (1, T, D), l/d (1, BQ).
+    """
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    seq_len = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)  # (BQ, D)
+    lse = l_ref[0]  # (BQ,)
+    delta = d_ref[0]  # (BQ,) rowsum(dO * O)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kv = seq_len // block_k
+    if causal:
+        num_kv_live = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
+        num_kv = jnp.minimum(num_kv, num_kv_live)
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK), already scaled via q
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, num_kv, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dk_ref, dv_ref,
+    *, block_q: int, scale: float, causal: bool,
+):
+    """dK/dV for one k-block, streaming Q/dO/L/D from the causal diagonal.
+
+    Ref shapes: k/v/dk/dv (1, BK, D), q/do (1, T, D), l/d (1, T).
+    """
+    block_k = k_ref.shape[1]
+    head_dim = k_ref.shape[2]
+    seq_len = q_ref.shape[1]
+    ki = pl.program_id(1)
+
+    k_blk = k_ref[0].astype(jnp.float32)  # (BK, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    num_q = seq_len // block_q
+    start_q = 0
+    if causal:
+        # Q blocks strictly above the diagonal see none of this k-block.
+        start_q = jax.lax.div(ki * block_k, block_q)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = l_ref[0, pl.ds(qb * block_q, block_q)]
+        delta = d_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (zeros, zeros))
+    # q was pre-scaled, so dk already carries one factor of scale.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def pallas_flash_attention_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    g: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused flash-attention backward: ``(dq, dk, dv)`` for (B, T, H, D) inputs.
+
+    ``out``/``lse`` are the forward results (``pallas_flash_attention_fwd``);
+    ``g`` is the output cotangent. O(T) memory — P is recomputed per block
+    from ``lse``, mirroring FlashAttention-2's backward.
     """
     b, t, h, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q != 0 or t % block_k != 0:
-        raise ValueError(f"sequence length {t} must be divisible by block sizes")
+    block_q, block_k = _check_blocks(t, block_q, block_k)
 
-    # Fold heads into the grid's batch dimension: (B*H, T, D).
-    def fold(x):
-        return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    of, gf = _fold(out), _fold(g)
     scale = 1.0 / math.sqrt(d)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale, causal=causal)
-    out = pl.pallas_call(
-        kernel,
+    # D = rowsum(dO * O): one cheap fused elementwise+reduce in XLA.
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    seq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),  # k
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
+        pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),  # lse
+        pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),  # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal),
         grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-        ],
+        in_specs=seq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(qf, kf, vf, gf, lse, delta)
 
-    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+    kv_specs = [
+        pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # v
+        pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),  # do
+        pl.BlockSpec((1, t), lambda bh, ki: (bh, 0)),  # lse
+        pl.BlockSpec((1, t), lambda bh, ki: (bh, 0)),  # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, block_q=block_q, scale=scale, causal=causal),
+        grid=(b * h, t // block_k),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)

@@ -26,13 +26,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # fmt: off
 DEFAULT_LOGICAL_AXIS_RULES = (
     # activations
-    ("batch", ("data", "fsdp")),
+    ("batch", ("data", "fsdp", "expert")),
     ("length", "sequence"),
     ("act_embed", None),
     ("act_mlp", "tensor"),
     ("act_heads", "tensor"),
     ("act_kv", None),
     ("act_vocab", "tensor"),
+    # MoE dispatch layout (models/moe.py): the leading expert dim shards
+    # over the mesh `expert` axis while the token-group dim keeps the
+    # remaining batch axes — the reshard between the two IS the all-to-all.
+    ("act_expert", "expert"),
+    ("act_expert_group", ("data", "fsdp")),
     # params
     ("vocab", "tensor"),
     ("embed", "fsdp"),
@@ -41,20 +46,27 @@ DEFAULT_LOGICAL_AXIS_RULES = (
     ("kv", None),
     ("qkv", None),
     ("position", None),
+    ("expert", "expert"),
 )
 # fmt: on
 
 
 def data_parallel_degree(mesh: Mesh) -> int:
-    """Number of batch shards = product of the axes 'batch' maps onto."""
-    return mesh.shape["data"] * mesh.shape["fsdp"]
+    """Number of batch shards = product of the axes 'batch' maps onto.
+
+    The ``expert`` axis carries batch shards too: dense params replicate
+    over it while MoE expert weights shard over it (GShard layout), so
+    non-MoE compute is never duplicated across expert devices.
+    """
+    return mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape.get("expert", 1)
 
 
 def batch_sharding(mesh: Mesh, *, with_accum_dim: bool = False) -> NamedSharding:
     """Sharding for (accum, B, T) or (B, T) token batches."""
+    batch_axes = ("data", "fsdp", "expert")
     if with_accum_dim:
-        return NamedSharding(mesh, P(None, ("data", "fsdp"), "sequence"))
-    return NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
+        return NamedSharding(mesh, P(None, batch_axes, "sequence"))
+    return NamedSharding(mesh, P(batch_axes, "sequence"))
 
 
 def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_RULES):

@@ -20,6 +20,7 @@ from .models import (
 _PLUGIN_MODULES = (
     "llmtrain_tpu.models.dummy_gpt",
     "llmtrain_tpu.models.gpt",
+    "llmtrain_tpu.models.gpt_moe",
     "llmtrain_tpu.data.dummy_text",
     "llmtrain_tpu.data.hf_text",
 )

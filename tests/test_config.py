@@ -90,16 +90,15 @@ def test_mesh_rejects_zero_axis():
         MeshConfig(tensor=0)
 
 
-def test_mesh_rejects_unwired_pipeline_expert_axes():
-    """pipeline/expert are reserved: nothing maps onto them, so sizes > 1
-    (or wildcard) must fail loudly instead of computing misleading layouts."""
+def test_mesh_rejects_unwired_pipeline_axis():
+    """pipeline is reserved: nothing maps onto it, so sizes > 1 (or
+    wildcard) must fail loudly instead of computing misleading layouts.
+    expert is wired (MoE) and accepts any size."""
     with pytest.raises(Exception, match="reserved"):
         MeshConfig(pipeline=2)
     with pytest.raises(Exception, match="reserved"):
-        MeshConfig(expert=2)
-    with pytest.raises(Exception, match="reserved"):
         MeshConfig(data=1, pipeline=-1)  # wildcard doesn't bypass the fence
-    assert MeshConfig(pipeline=1, expert=1).axis_sizes()["pipeline"] == 1
+    assert MeshConfig(pipeline=1, expert=2).axis_sizes()["expert"] == 2
 
 
 def test_device_literal_is_cpu_or_tpu():

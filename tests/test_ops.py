@@ -91,6 +91,48 @@ class TestPallasInterpret:
         with pytest.raises(ValueError, match="divisible"):
             pallas_flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
 
+    def test_lse_matches_reference(self):
+        """Forward's logsumexp residual == logsumexp of scaled masked logits."""
+        import math
+
+        from llmtrain_tpu.ops.pallas_attention import pallas_flash_attention_fwd
+
+        q, k, v = _qkv(b=1, t=16, h=1, d=8)
+        _, lse = pallas_flash_attention_fwd(q, k, v, block_q=8, block_k=8, interpret=True)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((16, 16), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        ref = jax.scipy.special.logsumexp(s, axis=-1).reshape(1, 16)  # b*h=1
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("block_q,block_k", [(8, 8), (8, 16), (16, 8), (32, 32)])
+    def test_fused_backward_matches_dense_grads(self, block_q, block_k):
+        """The Pallas dq/dk/dv kernels against jax.grad of the dense
+        reference, over a block-shape sweep (VERDICT r1 #4)."""
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = _qkv(b=2, t=32, h=2, d=8, seed=3)
+        g = jax.random.normal(jax.random.key(9), q.shape, jnp.float32)
+
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, block_q=block_q, block_k=block_k, interpret=True
+        )
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, block_q=block_q, block_k=block_k, interpret=True
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(_dense_ref(q, k, v) * g)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
+
 
 class TestFlashDispatch:
     def test_cpu_dispatch_and_grads(self):

@@ -1,0 +1,169 @@
+"""Compiled-Pallas correctness on real TPU hardware (VERDICT r1 #3).
+
+Interpret-mode tests (tests/test_ops.py) validate kernel math on CPU; a
+kernel that passes interpreted can still fail or misbehave when actually
+lowered (tiling, VMEM limits, dtype rules). These tests run the compiled
+kernels against the dense reference at bf16 tolerance, sweeping the
+VMEM-relevant block shapes — they skip everywhere except a TPU backend and
+run for real in the bench environment.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _on_tpu(), reason="requires a TPU backend")
+
+
+def _qkv(b=2, t=512, h=4, d=64, dtype=jnp.bfloat16, seed=0):
+    keys = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), dtype=dtype) for k in keys)
+
+
+def _dense_ref(q, k, v):
+    from llmtrain_tpu.models.gpt import dense_attention
+
+    return dense_attention(q, k, v, attention_mask=None)
+
+
+class TestCompiledForward:
+    def test_matches_dense_bf16(self):
+        from llmtrain_tpu.ops.pallas_attention import pallas_flash_attention
+
+        q, k, v = _qkv()
+        out = jax.device_get(pallas_flash_attention(q, k, v))
+        ref = jax.device_get(_dense_ref(q, k, v))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+        )
+
+    @pytest.mark.parametrize(
+        "block_q,block_k",
+        [(128, 128), (128, 256), (256, 128), (256, 256), (512, 512)],
+    )
+    def test_block_shape_sweep(self, block_q, block_k):
+        """VMEM-relevant tilings: every (block_q, block_k) must lower and
+        agree with the dense reference."""
+        from llmtrain_tpu.ops.pallas_attention import pallas_flash_attention
+
+        q, k, v = _qkv(t=512, seed=1)
+        out = jax.device_get(
+            pallas_flash_attention(q, k, v, block_q=block_q, block_k=block_k)
+        )
+        ref = jax.device_get(_dense_ref(q, k, v))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+        )
+
+    def test_f32_tight_tolerance(self):
+        from llmtrain_tpu.ops.pallas_attention import pallas_flash_attention
+
+        q, k, v = _qkv(t=256, dtype=jnp.float32, seed=2)
+        out = jax.device_get(pallas_flash_attention(q, k, v))
+        ref = jax.device_get(_dense_ref(q, k, v))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+class TestCompiledBackward:
+    @pytest.mark.parametrize("block_q,block_k", [(128, 128), (256, 256)])
+    def test_fused_bwd_matches_dense_grads(self, block_q, block_k):
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = _qkv(t=256, dtype=jnp.float32, seed=3)
+        g = jax.random.normal(jax.random.key(7), q.shape, jnp.float32)
+
+        out, lse = pallas_flash_attention_fwd(q, k, v, block_q=block_q, block_k=block_k)
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, block_q=block_q, block_k=block_k
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(_dense_ref(q, k, v) * g)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(dq)), np.asarray(jax.device_get(rq)), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(dk)), np.asarray(jax.device_get(rk)), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(dv)), np.asarray(jax.device_get(rv)), atol=1e-3
+        )
+
+    def test_custom_vjp_dispatch_uses_pallas_bwd(self, monkeypatch):
+        """flash_attention's grad on TPU goes through the fused kernels and
+        agrees with the blockwise-recompute path (the A/B knob)."""
+        from llmtrain_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(t=256, dtype=jnp.float32, seed=4)
+
+        def loss(q):
+            return flash_attention(q, k, v).sum()
+
+        g_fused = jax.device_get(jax.grad(loss)(q))
+        monkeypatch.setenv("LLMTRAIN_FLASH_BWD", "blockwise")
+        g_recompute = jax.device_get(jax.grad(loss)(q))
+        np.testing.assert_allclose(
+            np.asarray(g_fused), np.asarray(g_recompute), atol=1e-3
+        )
+
+
+class TestCompiledTrainStep:
+    def test_gpt_flash_train_step_runs(self):
+        """One real optimizer step of the flagship GPT with attention=flash,
+        compiled on the chip — the end-to-end smoke the bench relies on."""
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.models.gpt import GPTAdapter
+        from llmtrain_tpu.training.optimizer import build_optimizer
+        from llmtrain_tpu.training.train_step import create_train_state, make_train_step
+
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "tpu-smoke", "device": "tpu"},
+                "model": {
+                    "name": "gpt",
+                    "block_size": 256,
+                    "d_model": 128,
+                    "n_layers": 2,
+                    "n_heads": 4,
+                    "d_ff": 512,
+                    "dropout": 0.0,
+                    "vocab_size": 1024,
+                    "dtype": "bfloat16",
+                    "attention": "flash",
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {"micro_batch_size": 4, "grad_accum_steps": 1, "warmup_steps": 0},
+            }
+        )
+        adapter = GPTAdapter()
+        model = adapter.build_model(cfg)
+        tx = build_optimizer(cfg.trainer)
+        rng = jax.random.key(0)
+        params = adapter.init_params(model, cfg, rng)
+        state = create_train_state(params, tx)
+        step_fn = jax.jit(
+            make_train_step(adapter, model, tx, grad_accum_steps=1, use_dropout=False)
+        )
+        tokens = np.random.default_rng(0).integers(0, 1024, size=(1, 4, 256), dtype=np.int32)
+        batch = {
+            "input_ids": jnp.asarray(tokens),
+            "labels": jnp.asarray(tokens),
+            "attention_mask": jnp.ones_like(jnp.asarray(tokens)),
+        }
+        state, metrics = step_fn(state, batch, rng)
+        loss = float(jax.device_get(metrics["loss"]))
+        assert np.isfinite(loss) and loss > 0
