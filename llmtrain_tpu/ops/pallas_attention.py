@@ -37,9 +37,11 @@ def _flash_kernel(
 ):
     """One q-block vs the streamed K/V sequence.
 
-    Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D), l (1, BQ).
+    Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D), l (1, 1, BQ).
     ``l`` is the per-row logsumexp of the scaled/masked logits — the
     residual the backward kernels use to recompute P without a re-softmax.
+    It is carried with a singleton middle dim so its block shape satisfies
+    Mosaic's tiling rule (second-to-last block dim == array dim).
     """
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -88,7 +90,7 @@ def _flash_kernel(
     )
     acc, row_max, row_sum = jax.lax.fori_loop(0, num_kv, body, init)
     o_ref[0] = (acc / row_sum[:, None]).astype(o_ref.dtype)
-    l_ref[0] = row_max + jnp.log(row_sum)
+    l_ref[0] = (row_max + jnp.log(row_sum))[None, :]
 
 
 def _fold(x: jax.Array) -> jax.Array:
@@ -145,16 +147,16 @@ def pallas_flash_attention_fwd(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
 
-    return _unfold(out, b, h), lse
+    return _unfold(out, b, h), lse.reshape(b * h, t)
 
 
 def pallas_flash_attention(
@@ -180,7 +182,7 @@ def _bwd_dq_kernel(
 ):
     """dQ for one q-block, streaming K/V (same schedule as the forward).
 
-    Ref shapes: q/do/dq (1, BQ, D), k/v (1, T, D), l/d (1, BQ).
+    Ref shapes: q/do/dq (1, BQ, D), k/v (1, T, D), l/d (1, 1, BQ).
     """
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -189,8 +191,8 @@ def _bwd_dq_kernel(
 
     q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
     do = do_ref[0].astype(jnp.float32)  # (BQ, D)
-    lse = l_ref[0]  # (BQ,)
-    delta = d_ref[0]  # (BQ,) rowsum(dO * O)
+    lse = l_ref[0, 0]  # (BQ,)
+    delta = d_ref[0, 0]  # (BQ,) rowsum(dO * O)
 
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -237,7 +239,7 @@ def _bwd_dkdv_kernel(
 ):
     """dK/dV for one k-block, streaming Q/dO/L/D from the causal diagonal.
 
-    Ref shapes: k/v/dk/dv (1, BK, D), q/do (1, T, D), l/d (1, T).
+    Ref shapes: k/v/dk/dv (1, BK, D), q/do (1, T, D), l/d (1, 1, T).
     """
     block_k = k_ref.shape[1]
     head_dim = k_ref.shape[2]
@@ -259,8 +261,8 @@ def _bwd_dkdv_kernel(
         dk_acc, dv_acc = carry
         q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = l_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = d_ref[0, pl.ds(qb * block_q, block_q)]
+        lse = l_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = d_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(
             q_blk, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -326,16 +328,19 @@ def pallas_flash_attention_bwd(
     of, gf = _fold(out), _fold(g)
     scale = 1.0 / math.sqrt(d)
 
-    # D = rowsum(dO * O): one cheap fused elementwise+reduce in XLA.
+    # D = rowsum(dO * O): one cheap fused elementwise+reduce in XLA. lse and
+    # delta travel as (BH, 1, T) so their (1, 1, block) specs tile legally.
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    lse3 = lse.reshape(b * h, 1, t)
+    delta3 = delta.reshape(b * h, 1, t)
 
     seq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
         pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),  # k
         pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),  # v
         pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
-        pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),  # lse
-        pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),  # delta
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),  # lse
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),  # delta
     ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal),
@@ -344,15 +349,15 @@ def pallas_flash_attention_bwd(
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     kv_specs = [
         pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),  # q
         pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # v
         pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),  # do
-        pl.BlockSpec((1, t), lambda bh, ki: (bh, 0)),  # lse
-        pl.BlockSpec((1, t), lambda bh, ki: (bh, 0)),  # delta
+        pl.BlockSpec((1, 1, t), lambda bh, ki: (bh, 0, 0)),  # lse
+        pl.BlockSpec((1, 1, t), lambda bh, ki: (bh, 0, 0)),  # delta
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, block_q=block_q, scale=scale, causal=causal),
@@ -367,6 +372,6 @@ def pallas_flash_attention_bwd(
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse3, delta3)
 
     return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)

@@ -25,14 +25,22 @@ os.environ["PYTHONPATH"] = (
 # unit tests must be hermetic and use the 8-device virtual mesh. The host's
 # sitecustomize pre-imports jax, so the env var alone is too late — update the
 # config directly (the backend itself is still uninitialized at this point).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Escape hatch: LLMTRAIN_TEST_TPU=1 keeps the real accelerator so the
+# TPU-gated compiled-kernel tests (tests/test_tpu_compiled.py) can run in the
+# bench environment.
+_use_tpu = os.environ.get("LLMTRAIN_TEST_TPU") == "1"
+if not _use_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _use_tpu:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402
