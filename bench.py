@@ -86,7 +86,13 @@ def _watchdog_main() -> None:
     if not force_cpu:
         attempts.append(({}, tpu_timeout))
         attempts.append(({}, retry_timeout))
-    attempts.append(({"JAX_PLATFORMS": "cpu"}, cpu_timeout))
+        # The last-resort CPU child must ignore TPU-sweep knobs (a batch
+        # tuned for the chip would blow the CPU timeout).
+        attempts.append(
+            ({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout)
+        )
+    else:
+        attempts.append(({"JAX_PLATFORMS": "cpu"}, cpu_timeout))
 
     for env, timeout_sec in attempts:
         label = env.get("JAX_PLATFORMS", "auto")
@@ -153,26 +159,59 @@ def _child_main() -> None:
 
     if on_tpu:
         depth, d_model, n_heads, d_ff = 12, 768, 12, 3072
-        vocab, seq, batch = 50257, 512, 16
+        vocab, seq, batch = 50257, 512, 64
         steps = 10
     else:
         depth, d_model, n_heads, d_ff = 2, 128, 4, 512
         vocab, seq, batch = 1024, 128, 4
         steps = 3
 
-    attention = "flash" if on_tpu else "dense"
-    try:
-        _run(on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, batch, steps, attention)
-    except Exception:
-        if attention == "dense":
-            raise
-        # Flash (Pallas) failed on this platform/runtime — a slower number
-        # beats no number. The fallback is reported in the JSON detail.
-        import traceback
+    # Tuning knobs (used by perf sweeps; defaults above are the contract).
+    # Ignored in the watchdog's last-resort CPU child: sweep values are
+    # tuned for the chip and would blow the CPU timeout.
+    if os.environ.get("LLMTRAIN_BENCH_FALLBACK") != "1":
+        batch = int(os.environ.get("LLMTRAIN_BENCH_BATCH", batch))
+        seq = int(os.environ.get("LLMTRAIN_BENCH_SEQ", seq))
+        steps = int(os.environ.get("LLMTRAIN_BENCH_STEPS", steps))
 
-        traceback.print_exc()
-        print("flash attention failed; retrying with dense", file=sys.stderr, flush=True)
-        _run(on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, batch, steps, "dense")
+    # Degradation ladder: halve the batch on OOM; on any other flash failure
+    # go straight to dense at the SAME batch (a deterministic kernel bug
+    # won't be fixed by a smaller batch, and recompiling doomed configs
+    # burns the parent watchdog's budget). A slower number beats no number;
+    # the fallback used is visible in the JSON ``detail`` (attention +
+    # batch fields).
+    att, b = ("flash" if on_tpu else "dense"), batch
+    # Each rung costs a full jit compile (~minutes on a tunneled TPU); cap
+    # the ladder so a cascade of OOMs can't eat the parent watchdog's whole
+    # budget before any JSON line is printed. The final rung is always
+    # dense, preserving the any-flash-failure-falls-back-to-dense guarantee
+    # even for batch-independent RESOURCE_EXHAUSTED (e.g. VMEM exhaustion).
+    attempts_left = 4
+    while True:
+        attempts_left -= 1
+        try:
+            _run(on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, b, steps, att)
+            return
+        except Exception as exc:
+            import traceback
+
+            traceback.print_exc()
+            if attempts_left <= 0:
+                raise
+            oom = "RESOURCE_EXHAUSTED" in repr(exc) or "out of memory" in repr(exc).lower()
+            if oom and b > 1 and not (att == "flash" and attempts_left == 1):
+                nxt = (att, b // 2)
+            elif att == "flash":
+                nxt = ("dense", b)
+            else:
+                raise
+            print(
+                f"bench attempt (attention={att}, batch={b}) failed "
+                f"({'OOM' if oom else 'non-OOM'}); degrading to {nxt}",
+                file=sys.stderr,
+                flush=True,
+            )
+            att, b = nxt
 
 
 def _run(
@@ -195,6 +234,16 @@ def _run(
     from llmtrain_tpu.models.gpt import GPTAdapter
     from llmtrain_tpu.training.optimizer import build_optimizer
     from llmtrain_tpu.training.train_step import create_train_state, make_train_step
+
+    # Report what actually executes: attention="flash" silently routes to
+    # the XLA blockwise path when T doesn't meet the Pallas tiling gate
+    # (ops/flash_attention._use_pallas), e.g. under an odd LLMTRAIN_BENCH_SEQ.
+    effective_attention = attention
+    if attention == "flash":
+        from llmtrain_tpu.ops.flash_attention import _use_pallas
+
+        if not _use_pallas(seq):
+            effective_attention = "flash(blockwise-fallback)"
 
     cfg = RunConfig.model_validate(
         {
@@ -267,7 +316,8 @@ def _run(
                     "backend": jax.default_backend(),
                     "device_kind": jax.devices()[0].device_kind,
                     "model": f"gpt L{depth} d{d_model} T{seq}",
-                    "attention": attention,
+                    "attention": effective_attention,
+                    "batch": batch,
                     "params": n_params,
                     "mfu": round(mfu, 4),
                     "step_time_ms": round(elapsed / steps * 1e3, 2),

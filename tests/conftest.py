@@ -46,6 +46,35 @@ jax.config.update("jax_threefry_partitionable", True)
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Under LLMTRAIN_TEST_TPU=1 run ONLY the TPU-gated compiled tests.
+
+    Everything else assumes the hermetic 8-virtual-device CPU mesh this
+    flag disables, so running it against the real backend would fail (or
+    pass against the wrong topology)."""
+    if not _use_tpu:
+        return
+    # Fail loudly rather than silently skipping everything: an all-skipped
+    # run exits 0 and would record the compiled-kernel suite as green when
+    # nothing executed (e.g. the TPU tunnel is down).
+    try:
+        backend = jax.default_backend()
+    except Exception as exc:  # backend init failure
+        raise pytest.UsageError(
+            f"LLMTRAIN_TEST_TPU=1 but the TPU backend failed to initialize: {exc}"
+        ) from exc
+    if backend != "tpu":
+        raise pytest.UsageError(
+            f"LLMTRAIN_TEST_TPU=1 but jax.default_backend() is {backend!r}, not 'tpu'"
+        )
+    skip = pytest.mark.skip(
+        reason="LLMTRAIN_TEST_TPU=1 runs only tests/test_tpu_compiled.py"
+    )
+    for item in items:
+        if "test_tpu_compiled" not in str(item.fspath):
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _reset_distributed_state():
     """Guarantee distributed-state teardown between tests.
