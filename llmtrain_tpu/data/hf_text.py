@@ -16,6 +16,7 @@ non-overlapping ``block_size + 1`` chunks of the concatenated stream.
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
 from typing import Any
 
@@ -110,7 +111,9 @@ class HFTextDataModule(DataModule):
         tokens = self._tokenize_stream(raw, tokenizer, text_column)
         cache_path.parent.mkdir(parents=True, exist_ok=True)
         # np.save appends ".npy" unless the name already ends with it.
-        tmp = cache_path.with_suffix(".tmp.npy")
+        # Per-process tmp name: concurrent ranks building a cold cache must
+        # not scribble into each other's file before the atomic rename.
+        tmp = cache_path.with_suffix(f".tmp{os.getpid()}.npy")
         np.save(tmp, tokens)
         tmp.replace(cache_path)
         return tokens

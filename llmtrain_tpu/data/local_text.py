@@ -1,0 +1,134 @@
+"""Local-filesystem text data module: glob → read → tokenize → window.
+
+Fully offline counterpart of ``hf_text`` (same flat-stream token cache and
+``TokenWindowDataset`` windows; reference behavior spec at
+src/llmtrain/data/hf_text.py:108-174): instead of a HuggingFace dataset it
+concatenates the text of local files matched by glob patterns, so training
+works with zero network egress — e.g. on a source-code corpus.
+
+Config::
+
+    data:
+      name: "local_text"
+      extra:
+        globs: ["/usr/local/lib/python3.12/**/*.py"]
+        val_fraction: 0.01   # tail of the token stream held out for eval
+
+Train/val are a deterministic head/tail split of the single token stream
+(files sorted lexicographically), so the split is stable across runs and
+processes.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from ..config.schemas import RunConfig
+from ..registry.data import register_data_module
+from .base import DataModule, IndexedDataset
+from .hf_text import TokenWindowDataset
+
+_DEFAULT_VAL_FRACTION = 0.01
+
+
+@register_data_module("local_text")
+class LocalTextDataModule(DataModule):
+    """Serves fixed token windows over a corpus of local text files."""
+
+    def __init__(self) -> None:
+        self._train: TokenWindowDataset | None = None
+        self._val: TokenWindowDataset | None = None
+
+    def setup(self, cfg: RunConfig, tokenizer: Any | None = None) -> None:
+        if tokenizer is None:
+            raise ValueError("local_text requires a tokenizer from the model adapter")
+        globs = cfg.data.extra.get("globs")
+        if not globs or not isinstance(globs, (list, tuple)):
+            raise ValueError("local_text requires data.extra.globs (list of glob patterns)")
+        val_fraction = float(cfg.data.extra.get("val_fraction", _DEFAULT_VAL_FRACTION))
+        if not 0.0 <= val_fraction < 1.0:
+            raise ValueError(f"val_fraction must be in [0, 1), got {val_fraction}")
+
+        files = sorted({f for pattern in globs for f in glob.glob(pattern, recursive=True)})
+        files = [f for f in files if Path(f).is_file()]
+        if not files:
+            raise ValueError(f"local_text globs matched no files: {globs}")
+
+        tokens = self._load_or_build_cache(cfg, files, tokenizer)
+        n_val = int(len(tokens) * val_fraction)
+        train_tokens, val_tokens = tokens[: len(tokens) - n_val], tokens[len(tokens) - n_val :]
+
+        self._train = TokenWindowDataset(train_tokens, cfg.model.block_size)
+        if len(self._train) == 0:
+            raise ValueError(
+                f"corpus too small: {len(train_tokens)} train tokens for "
+                f"block_size {cfg.model.block_size}"
+            )
+        val_ds = TokenWindowDataset(val_tokens, cfg.model.block_size)
+        self._val = val_ds if len(val_ds) > 0 else None
+
+    def _load_or_build_cache(
+        self, cfg: RunConfig, files: list[str], tokenizer: Any
+    ) -> np.ndarray:
+        # Key by file list + size + mtime (size alone misses equal-length
+        # edits) + tokenizer identity — token ids from a different
+        # tokenizer would silently corrupt training (hf_text's cache rule).
+        h = hashlib.sha256()
+        for f in files:
+            st = Path(f).stat()
+            h.update(f.encode())
+            h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+        tok_id = f"{type(tokenizer).__name__}{getattr(tokenizer, 'n_vocab', 'x')}"
+        cache_path = (
+            Path(cfg.data.cache_dir) / "processed" / f"local__{h.hexdigest()[:16]}__{tok_id}.npy"
+        )
+        if cache_path.exists():
+            return np.load(cache_path, mmap_mode="r")
+
+        encode_np = getattr(tokenizer, "encode_np", None)
+        pieces: list[np.ndarray] = []
+        for f in files:
+            text = Path(f).read_text(encoding="utf-8", errors="ignore")
+            if not text:
+                continue
+            if encode_np is not None:
+                ids = encode_np(text)
+            else:
+                ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
+            if ids.size:
+                pieces.append(ids)
+                # File boundary marker: newline keeps documents separated
+                # without inventing an out-of-vocab separator id.
+                pieces.append(np.asarray(tokenizer.encode("\n\n"), dtype=np.int32))
+        tokens = (
+            np.concatenate(pieces).astype(np.int32)
+            if pieces
+            else np.zeros((0,), dtype=np.int32)
+        )
+
+        cache_path.parent.mkdir(parents=True, exist_ok=True)
+        # Per-process tmp name: concurrent ranks building a cold cache must
+        # not scribble into each other's file before the atomic rename.
+        tmp = cache_path.with_suffix(f".tmp{os.getpid()}.npy")
+        np.save(tmp, tokens)
+        tmp.replace(cache_path)
+        return tokens
+
+    def train_dataset(self) -> IndexedDataset:
+        if self._train is None:
+            raise RuntimeError("setup must be called before train_dataset")
+        return self._train
+
+    def val_dataset(self) -> IndexedDataset | None:
+        if self._train is None:
+            raise RuntimeError("setup must be called before val_dataset")
+        return self._val
+
+
+__all__ = ["LocalTextDataModule"]

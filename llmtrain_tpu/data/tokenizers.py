@@ -1,0 +1,46 @@
+"""Offline tokenizers.
+
+The reference's only tokenizer is tiktoken's downloaded gpt2 BPE
+(reference models/gpt.py:210-212), which makes every training run depend on
+network egress at startup. The byte-level tokenizer below is the
+zero-dependency fallback: 256-symbol vocabulary, UTF-8 bytes as token ids —
+the ByT5/byte-level-GPT construction. Select it with
+``model.extra.tokenizer: "byte"``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: token id == byte value, vocab 256."""
+
+    n_vocab = 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def encode_np(self, text: str) -> np.ndarray:
+        """Vectorized encode — the fast path for corpus preprocessing."""
+        return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(np.int32)
+
+    def decode(self, ids) -> str:
+        arr = np.asarray(ids, dtype=np.int64)
+        if arr.size and (arr.min() < 0 or arr.max() > 255):
+            raise ValueError("byte tokenizer ids must be in [0, 255]")
+        return bytes(arr.astype(np.uint8).tolist()).decode("utf-8", errors="replace")
+
+
+def build_tokenizer(name: str):
+    """Resolve a tokenizer by config name: "gpt2" (tiktoken) or "byte"."""
+    if name == "byte":
+        return ByteTokenizer()
+    if name == "gpt2":
+        import tiktoken
+
+        return tiktoken.get_encoding("gpt2")
+    raise ValueError(f"unknown tokenizer {name!r}; expected 'gpt2' or 'byte'")
+
+
+__all__ = ["ByteTokenizer", "build_tokenizer"]

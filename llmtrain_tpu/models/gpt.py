@@ -469,10 +469,12 @@ class GPTAdapter(ModelAdapter):
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
-        del cfg
-        import tiktoken
+        """tiktoken gpt2 by default (reference models/gpt.py:210-212);
+        ``model.extra.tokenizer: "byte"`` selects the offline byte-level
+        tokenizer (no network egress at startup)."""
+        from ..data.tokenizers import build_tokenizer
 
-        return tiktoken.get_encoding("gpt2")
+        return build_tokenizer(cfg.model.extra.get("tokenizer", "gpt2"))
 
     def compute_loss_components(
         self,

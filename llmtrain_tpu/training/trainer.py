@@ -97,6 +97,15 @@ class Trainer:
 
         devices = jax.devices() if cfg.run.device == "tpu" else jax.devices("cpu")
         self._mesh = build_mesh(cfg.distributed.mesh, devices)
+        if self._mesh.shape.get("pipeline", 1) > 1 and not getattr(
+            self._adapter, "supports_pipeline", False
+        ):
+            raise ValueError(
+                f"mesh axis 'pipeline' is {self._mesh.shape['pipeline']} but "
+                f"model {cfg.model.name!r} does not stack its layers for "
+                "pipeline stages; use a pipeline-capable model "
+                "(e.g. 'gpt_pipeline') or set pipeline to 1"
+            )
         self._rules = list(DEFAULT_LOGICAL_AXIS_RULES)
         self._dp = data_parallel_degree(self._mesh)
         self._global_micro = cfg.trainer.micro_batch_size * self._dp
@@ -363,6 +372,14 @@ class Trainer:
                         self._save_checkpoint(step)
 
                     if step % log_every == 0 or step == max_steps:
+                        # Steps dispatch asynchronously; sync on the
+                        # interval's last loss BEFORE stamping the end time
+                        # so queued execution is charged to this interval.
+                        # Without this, step_time measures dispatch only and
+                        # tokens_per_sec/mfu are nonsense. (device_get, not
+                        # block_until_ready: on remote-tunnel platforms the
+                        # latter can return before execution finishes.)
+                        jax.device_get(metrics["loss"])
                         interval_time = time.perf_counter() - interval_start
                         self._log_train_interval(
                             step=step,

@@ -181,3 +181,124 @@ class TestHFText:
         dm = HFTextDataModule()
         dm.setup(cfg, _ToyTokenizer())
         assert dm.val_dataset() is None
+
+
+class TestByteTokenizer:
+    def test_roundtrip_and_vocab(self):
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer, build_tokenizer
+
+        tok = ByteTokenizer()
+        assert tok.n_vocab == 256
+        text = "def f(x):\n    return x  # ünïcode"
+        ids = tok.encode(text)
+        assert all(0 <= i <= 255 for i in ids)
+        assert tok.decode(ids) == text
+        np.testing.assert_array_equal(tok.encode_np(text), np.asarray(ids, np.int32))
+        assert isinstance(build_tokenizer("byte"), ByteTokenizer)
+        with pytest.raises(ValueError, match="unknown tokenizer"):
+            build_tokenizer("nope")
+
+    def test_decode_rejects_out_of_range(self):
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        with pytest.raises(ValueError, match="255"):
+            ByteTokenizer().decode([300])
+
+
+def _local_cfg(tmp_path, globs, **extra):
+    raw = {
+        "run": {"name": "t", "seed": 11},
+        "model": {"name": "gpt", "block_size": 8, "vocab_size": 256},
+        "data": {
+            "name": "local_text",
+            "cache_dir": str(tmp_path / "cache"),
+            "extra": {"globs": globs, **extra},
+        },
+        "trainer": {"max_steps": 10, "micro_batch_size": 4, "warmup_steps": 0},
+    }
+    return RunConfig.model_validate(raw)
+
+
+class TestLocalText:
+    def _corpus(self, tmp_path):
+        d = tmp_path / "corpus"
+        d.mkdir()
+        (d / "a.py").write_text("a" * 100)
+        (d / "b.py").write_text("b" * 100)
+        (d / "ignored.txt").write_text("x" * 500)
+        return str(d / "*.py")
+
+    def test_windows_split_and_cache(self, tmp_path):
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        pattern = self._corpus(tmp_path)
+        cfg = _local_cfg(tmp_path, [pattern], val_fraction=0.25)
+        dm = LocalTextDataModule()
+        dm.setup(cfg, ByteTokenizer())
+        # 204 tokens (2x100 + 2x2 separators); val=51 -> 5 windows of 9,
+        # train=153 -> 17 windows.
+        assert len(dm.train_dataset()) == 17
+        assert len(dm.val_dataset()) == 5
+        batch = dm.train_dataset().get_examples(np.array([0]))
+        assert batch["input_ids"][0].tolist() == [ord("a")] * 8
+
+        cache_dir = tmp_path / "cache" / "processed"
+        assert len(list(cache_dir.glob("*.npy"))) == 1
+
+        # Unchanged corpus -> same cache file reused.
+        dm2 = LocalTextDataModule()
+        dm2.setup(cfg, ByteTokenizer())
+        assert len(dm2.train_dataset()) == 17
+        assert len(list(cache_dir.glob("*.npy"))) == 1
+
+        # Same-length edit -> mtime changes -> cache rebuilt, not reused.
+        (tmp_path / "corpus" / "a.py").write_text("c" * 100)
+        dm3 = LocalTextDataModule()
+        dm3.setup(cfg, ByteTokenizer())
+        assert len(list(cache_dir.glob("*.npy"))) == 2
+        batch3 = dm3.train_dataset().get_examples(np.array([0]))
+        assert batch3["input_ids"][0].tolist() == [ord("c")] * 8
+
+    def test_requires_globs_and_matches(self, tmp_path):
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        with pytest.raises(ValueError, match="tokenizer"):
+            LocalTextDataModule().setup(_local_cfg(tmp_path, ["x"]), None)
+        with pytest.raises(ValueError, match="globs"):
+            LocalTextDataModule().setup(
+                RunConfig.model_validate(
+                    {
+                        "run": {"name": "t"},
+                        "model": {"name": "gpt", "block_size": 8, "vocab_size": 256},
+                        "data": {"name": "local_text"},
+                        "trainer": {"max_steps": 1, "micro_batch_size": 1, "warmup_steps": 0},
+                    }
+                ),
+                ByteTokenizer(),
+            )
+        with pytest.raises(ValueError, match="matched no files"):
+            LocalTextDataModule().setup(
+                _local_cfg(tmp_path, [str(tmp_path / "nothing-*.py")]), ByteTokenizer()
+            )
+
+    def test_corpus_too_small_raises(self, tmp_path):
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        d = tmp_path / "tiny"
+        d.mkdir()
+        (d / "t.py").write_text("ab")
+        with pytest.raises(ValueError, match="corpus too small"):
+            LocalTextDataModule().setup(
+                _local_cfg(tmp_path, [str(d / "*.py")]), ByteTokenizer()
+            )
+
+    def test_registered(self):
+        from llmtrain_tpu.registry import get_data_module, initialize_registries
+
+        initialize_registries()
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+
+        assert get_data_module("local_text") is LocalTextDataModule
