@@ -37,6 +37,11 @@ train-multiproc:
 		bash -c 'RANK=1 python -m llmtrain_tpu train --config configs/presets/ddp_smoke.yaml & \
 		RANK=0 python -m llmtrain_tpu train --config configs/presets/ddp_smoke.yaml; wait'
 
+# GPipe pipeline parallelism on the 8-virtual-device CPU mesh.
+train-pipeline:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m llmtrain_tpu train --config configs/presets/gpt_pipeline_smoke.yaml
+
 bench:
 	python bench.py
 

@@ -143,16 +143,12 @@ class MeshConfig(BaseModel):
         for axis, v in sizes.items():
             if v == 0 or v < -1:
                 raise ValueError(f"mesh axis {axis!r} must be a positive int or -1")
-        # No sharding rule maps onto pipeline yet — reject sizes > 1 loudly
-        # instead of silently computing layouts that ignore the axis.
+        # `pipeline` is only consumed by models that stack their layer dim
+        # on the "layers" logical axis (gpt_pipeline); whether the selected
+        # model supports it is validated by the Trainer against the
+        # adapter's `supports_pipeline` flag — config can't see the model.
         # (`expert` is wired: MoE expert weights shard over it and it carries
         # batch shards for dense compute — parallel/sharding.py.)
-        if sizes["pipeline"] != 1:
-            raise ValueError(
-                "mesh axis 'pipeline' is reserved for future pipeline "
-                f"parallelism and must be 1 (got {sizes['pipeline']}): no "
-                "parameter or activation sharding rule maps onto it yet"
-            )
         return self
 
     def axis_sizes(self) -> dict[str, int]:

@@ -30,6 +30,11 @@ Metrics = dict[str, jax.Array]
 class ModelAdapter(ABC):
     """Builds a Flax model + tokenizer and defines its training loss."""
 
+    # True only for models that stack their layer dim on the "layers"
+    # logical axis so a mesh `pipeline` axis can shard stages
+    # (models/gpt_pipeline.py). The Trainer rejects pipeline > 1 otherwise.
+    supports_pipeline = False
+
     @abstractmethod
     def build_model(self, cfg: RunConfig) -> nn.Module:
         """Construct the (uninitialized) Flax module from config."""

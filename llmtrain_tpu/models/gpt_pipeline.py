@@ -1,0 +1,326 @@
+"""Pipeline-parallel GPT: stacked-block params scheduled with GPipe.
+
+New capability beyond the reference (no model parallelism of any kind
+there — SURVEY §2.3/§2.4). Same architecture family as ``models/gpt.py``
+(learned token+position embeddings, pre-norm blocks, GELU MLP, final LN,
+tied lm_head — behavior spec reference models/gpt.py:99-165) but built for
+stage execution: every block parameter carries a LEADING layer dim
+(logical axis ``"layers"`` → mesh ``pipeline``), blocks are applied by a
+``lax.scan`` over that dim, and under a mesh with ``pipeline > 1`` the
+stack runs through ``parallel/pipeline.gpipe_apply`` — microbatches
+rotating across stages over ICI.
+
+Scope (v1, validated loudly): causal packed sequences only (padding masks
+apply to the loss, not inside attention — same contract as the flash
+path), no dropout inside pipelined blocks, and ``pipeline`` composes with
+``data`` only (``tensor``/``fsdp``/``sequence`` must be 1: stage params
+are replicated across those axes by the shard_map specs, so sharding them
+would silently all-gather).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .base import ModelAdapter, Params, lm_loss_components
+from .gpt import dense_attention
+
+_INIT_STD = 0.02
+
+
+def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    """f32-statistics layernorm over the trailing dim."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def make_block_apply(*, n_heads: int, attention: str, dtype: Any):
+    """Functional pre-norm transformer block over stacked params.
+
+    ``p`` leaves are ONE layer's slice (no leading layer dim); ``h`` is
+    (B, T, D). Mirrors TransformerBlock (models/gpt.py:245-308) without
+    module machinery so it can run under shard_map/scan.
+    """
+
+    def block_apply(p: dict[str, jax.Array], h: jax.Array) -> jax.Array:
+        b, t, d = h.shape
+        head_dim = d // n_heads
+
+        hn = _layernorm(h, p["ln1_scale"], p["ln1_bias"])
+        qkv = hn.astype(dtype) @ p["qkv_kernel"].astype(dtype) + p["qkv_bias"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, n_heads, head_dim)
+        k = k.reshape(b, t, n_heads, head_dim)
+        v = v.reshape(b, t, n_heads, head_dim)
+        if attention == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            att = flash_attention(q, k, v, causal=True)
+        else:
+            att = dense_attention(q, k, v, attention_mask=None)
+        att = att.reshape(b, t, d)
+        h = h + (att.astype(dtype) @ p["out_kernel"].astype(dtype) + p["out_bias"].astype(dtype))
+
+        hn = _layernorm(h, p["ln2_scale"], p["ln2_bias"])
+        m = hn.astype(dtype) @ p["fc_kernel"].astype(dtype) + p["fc_bias"].astype(dtype)
+        m = nn.gelu(m, approximate=False)
+        h = h + (m @ p["proj_kernel"].astype(dtype) + p["proj_bias"].astype(dtype))
+        return h
+
+    return block_apply
+
+
+def make_stage_fn(*, n_heads: int, attention: str, dtype: Any):
+    """Stage program: scan ``block_apply`` over this stage's layer slice."""
+    block_apply = make_block_apply(n_heads=n_heads, attention=attention, dtype=dtype)
+
+    def stage_fn(stage_params: dict[str, jax.Array], h: jax.Array) -> jax.Array:
+        def body(h, layer_params):
+            return block_apply(layer_params, h), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    return stage_fn
+
+
+def _ambient_mesh() -> jax.sharding.Mesh | None:
+    from jax._src import mesh as mesh_lib
+
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    return None if physical.empty else physical
+
+
+class PipelineGPT(nn.Module):
+    """Decoder-only GPT with a stacked, pipeline-shardable block stack."""
+
+    vocab_size: int
+    block_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    tie_embeddings: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention: str = "dense"
+    n_microbatches: int = 4
+    remat: bool = True
+
+    def _stacked(self, name: str, shape: tuple[int, ...], init) -> jax.Array:
+        """A per-layer-stacked parameter: leading dim n_layers on logical
+        axis "layers" (→ mesh ``pipeline``)."""
+        axes = ("layers",) + tuple(f"unstacked_{i}" for i in range(len(shape)))
+        return self.param(
+            name,
+            nn.with_logical_partitioning(init, axes),
+            (self.n_layers, *shape),
+            self.param_dtype,
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        *,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        del deterministic  # no dropout inside pipelined blocks (v1)
+        _, seqlen = input_ids.shape
+        if seqlen > self.block_size:
+            raise ValueError(
+                f"Input sequence length {seqlen} exceeds block size {self.block_size}."
+            )
+
+        embed_init = nn.initializers.normal(stddev=_INIT_STD)
+        dense_init = nn.initializers.normal(stddev=_INIT_STD)
+        scaled_init = nn.initializers.normal(
+            stddev=_INIT_STD / math.sqrt(2 * self.n_layers)
+        )
+
+        token_embedding = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            embedding_init=nn.with_logical_partitioning(embed_init, ("vocab", "embed")),
+            name="token_embedding",
+        )
+        position_embedding = nn.Embed(
+            self.block_size,
+            self.d_model,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            embedding_init=nn.with_logical_partitioning(embed_init, ("position", "embed")),
+            name="position_embedding",
+        )
+        x = token_embedding(input_ids) + position_embedding(
+            jnp.arange(seqlen)[None, :]
+        )
+        x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
+        d, f = self.d_model, self.d_ff
+        blocks = {
+            "ln1_scale": self._stacked("ln1_scale", (d,), nn.initializers.ones_init()),
+            "ln1_bias": self._stacked("ln1_bias", (d,), nn.initializers.zeros_init()),
+            "qkv_kernel": self._stacked("qkv_kernel", (d, 3 * d), dense_init),
+            "qkv_bias": self._stacked("qkv_bias", (3 * d,), nn.initializers.zeros_init()),
+            "out_kernel": self._stacked("out_kernel", (d, d), scaled_init),
+            "out_bias": self._stacked("out_bias", (d,), nn.initializers.zeros_init()),
+            "ln2_scale": self._stacked("ln2_scale", (d,), nn.initializers.ones_init()),
+            "ln2_bias": self._stacked("ln2_bias", (d,), nn.initializers.zeros_init()),
+            "fc_kernel": self._stacked("fc_kernel", (d, f), dense_init),
+            "fc_bias": self._stacked("fc_bias", (f,), nn.initializers.zeros_init()),
+            "proj_kernel": self._stacked("proj_kernel", (f, d), scaled_init),
+            "proj_bias": self._stacked("proj_bias", (d,), nn.initializers.zeros_init()),
+        }
+
+        stage_fn = make_stage_fn(
+            n_heads=self.n_heads, attention=self.attention, dtype=self.dtype
+        )
+        mesh = _ambient_mesh()
+        n_stages = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+        if n_stages > 1:
+            from ..parallel.pipeline import BATCH_AXES, gpipe_apply
+
+            for banned in ("tensor", "fsdp", "sequence"):
+                if int(mesh.shape.get(banned, 1)) != 1:
+                    raise ValueError(
+                        f"gpt_pipeline composes pipeline with data parallelism "
+                        f"only; mesh axis {banned!r} must be 1, got "
+                        f"{mesh.shape[banned]}"
+                    )
+            if self.n_layers % n_stages != 0:
+                raise ValueError(
+                    f"n_layers {self.n_layers} must divide evenly into "
+                    f"{n_stages} pipeline stages"
+                )
+            dp = math.prod(int(mesh.shape.get(a, 1)) for a in BATCH_AXES)
+            needed = dp * self.n_microbatches
+            if x.shape[0] % needed != 0:
+                # Batch-1 traces (the param-init probe, models/base.py:52)
+                # fall back silently by design; a real batch losing the
+                # pipeline deserves a trace-time diagnostic.
+                if x.shape[0] > 1:
+                    from ..utils.logging import get_logger
+
+                    get_logger().warning(
+                        "gpt_pipeline: batch %d not divisible by data shards "
+                        "x microbatches (%d); running WITHOUT pipeline "
+                        "parallelism", x.shape[0], needed,
+                    )
+                n_stages = 1
+        if n_stages > 1:
+            x = gpipe_apply(
+                stage_fn,
+                blocks,
+                x,
+                mesh,
+                n_microbatches=self.n_microbatches,
+                remat_stage=self.remat,
+            )
+        else:
+            fn = jax.checkpoint(stage_fn) if self.remat else stage_fn
+            x = fn(blocks, x)
+
+        ln_f_scale = self.param(
+            "ln_f_scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (d,),
+            self.param_dtype,
+        )
+        ln_f_bias = self.param(
+            "ln_f_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+            (d,),
+            self.param_dtype,
+        )
+        x = _layernorm(x, ln_f_scale, ln_f_bias)
+
+        if self.tie_embeddings:
+            logits = token_embedding.attend(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size,
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(dense_init, ("embed", "vocab")),
+                name="lm_head",
+            )(x)
+        return nn.with_logical_constraint(logits, ("batch", "length", "act_vocab"))
+
+
+@register_model("gpt_pipeline")
+class PipelineGPTAdapter(ModelAdapter):
+    """Adapter for the pipeline-parallel GPT.
+
+    ``model.extra`` knobs: ``tokenizer`` ("gpt2"/"byte", as for gpt) and
+    ``pipeline_microbatches`` (default 4; per-data-shard batch must divide
+    by it when pipeline > 1).
+    """
+
+    supports_pipeline = True
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        vocab_size = cfg.model.vocab_size
+        if vocab_size is None:
+            tokenizer = self.build_tokenizer(cfg)
+            vocab_size = getattr(tokenizer, "n_vocab", None)
+            if not isinstance(vocab_size, int) or vocab_size <= 0:
+                raise ValueError("tokenizer must expose a positive integer n_vocab")
+        if cfg.model.dropout != 0.0:
+            raise ValueError(
+                "gpt_pipeline does not support dropout (v1); set model.dropout to 0.0"
+            )
+        if cfg.model.attention not in ("dense", "flash"):
+            raise ValueError(
+                f"gpt_pipeline supports attention 'dense' or 'flash', "
+                f"got {cfg.model.attention!r}"
+            )
+        return PipelineGPT(
+            vocab_size=vocab_size,
+            block_size=cfg.model.block_size,
+            d_model=cfg.model.d_model,
+            n_layers=cfg.model.n_layers,
+            n_heads=cfg.model.n_heads,
+            d_ff=cfg.model.d_ff,
+            tie_embeddings=cfg.model.tie_embeddings,
+            dtype=jnp.dtype(cfg.model.dtype),
+            param_dtype=jnp.dtype(cfg.model.param_dtype),
+            attention=cfg.model.attention,
+            n_microbatches=int(cfg.model.extra.get("pipeline_microbatches", 4)),
+            remat=cfg.model.remat,
+        )
+
+    def build_tokenizer(self, cfg: RunConfig) -> Any | None:
+        from ..data.tokenizers import build_tokenizer
+
+        return build_tokenizer(cfg.model.extra.get("tokenizer", "gpt2"))
+
+    def compute_loss_components(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: dict,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, jax.Array]:
+        return lm_loss_components(
+            model, params, batch, rngs=rngs, deterministic=deterministic
+        )
+
+
+__all__ = ["PipelineGPT", "PipelineGPTAdapter"]

@@ -1,0 +1,125 @@
+"""GPipe pipeline parallelism over the mesh ``pipeline`` axis.
+
+New TPU capability beyond the reference (data parallelism is its only
+strategy — reference trainer.py:87-91; SURVEY §2.3 records PP as absent).
+Design is TPU-first, not a port: stages are SPMD programs under
+``shard_map``, activations hop stages over ICI with ``lax.ppermute``, and
+the whole schedule — microbatch rotation, bubble, drain — is ONE
+``lax.scan`` inside the jit-compiled train step. The backward schedule
+falls out of differentiating the forward (ppermute transposes to the
+reverse permutation), so GPipe's backward pass needs no extra code.
+
+Layout contract: every parameter leaf carries its layer dim LEADING and
+sharded over ``pipeline`` (logical axis ``"layers"``); activations are
+batch-sharded over the data axes and replicated over ``pipeline``. With S
+stages and M microbatches the bubble fraction is (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.8
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+BATCH_AXES = ("data", "fsdp", "expert")
+
+
+def pipeline_degree(mesh: jax.sharding.Mesh | None) -> int:
+    return int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+
+
+def gpipe_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    params: Any,
+    x: jax.Array,
+    mesh: jax.sharding.Mesh,
+    *,
+    n_microbatches: int,
+    axis: str = "pipeline",
+    remat_stage: bool = True,
+) -> jax.Array:
+    """Run ``x`` through all layers with GPipe scheduling over ``axis``.
+
+    ``params``: pytree whose every leaf has a leading layer dim divisible by
+    the stage count (sharded over ``axis``); ``stage_fn(stage_params, h)``
+    applies one stage's worth of layers. ``x``: (B, T, D) activations with B
+    sharded over the data axes. Returns (B, T, D) after all layers,
+    replicated over ``axis`` (non-final stages receive the result via psum).
+    """
+    n_stages = pipeline_degree(mesh)
+    if n_stages == 1:
+        return stage_fn(params, x)
+    if n_microbatches < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {n_microbatches}")
+
+    fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+    x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+    p_specs = jax.tree.map(lambda a: P(axis, *([None] * (a.ndim - 1))), params)
+
+    def inner(p: Any, x_local: jax.Array) -> jax.Array:
+        stage = jax.lax.axis_index(axis)
+        batch = x_local.shape[0]
+        if batch % n_microbatches != 0:
+            raise ValueError(
+                f"per-shard batch {batch} not divisible by "
+                f"n_microbatches {n_microbatches}"
+            )
+        mb = batch // n_microbatches
+        xm = x_local.reshape(n_microbatches, mb, *x_local.shape[1:])
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state_in, out_buf = carry
+            # Stage 0 feeds microbatch t (clamped garbage during drain
+            # ticks — it never reaches the output buffer); later stages
+            # consume what the previous stage sent last tick.
+            x_t = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, n_microbatches - 1), keepdims=False
+            )
+            inp = jnp.where(stage == 0, x_t, state_in)
+            out = fn(p, inp)
+            # The final stage finishes microbatch t-(S-1) at tick t.
+            m = t - (n_stages - 1)
+            idx = jnp.clip(m, 0, n_microbatches - 1)
+            write = (stage == n_stages - 1) & (m >= 0)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, idx, keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(write, out, cur), idx, 0
+            )
+            state_out = jax.lax.ppermute(out, axis, perm)
+            return (state_out, out_buf), None
+
+        # The carry varies over `axis` (each stage computes different
+        # values), but the zero init doesn't — declare it varying so the
+        # scan carry types line up under shard_map's vma tracking.
+        if hasattr(jax.lax, "pcast"):
+            mark_varying = lambda a: jax.lax.pcast(a, (axis,), to="varying")  # noqa: E731
+        else:  # older jax spells it pvary
+            mark_varying = lambda a: jax.lax.pvary(a, (axis,))  # noqa: E731
+        init = jax.tree.map(
+            mark_varying, (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+        )
+        (_, out_buf), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_microbatches + n_stages - 1)
+        )
+        # Only the final stage ever wrote its buffer; every other stage
+        # holds zeros, so a psum broadcasts the result to all stages.
+        y = jax.lax.psum(out_buf, axis)
+        return y.reshape(x_local.shape)
+
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(p_specs, x_spec),
+        out_specs=x_spec,
+    )(params, x)
+
+
+__all__ = ["gpipe_apply", "pipeline_degree", "BATCH_AXES"]

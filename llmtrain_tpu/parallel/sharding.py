@@ -47,6 +47,12 @@ DEFAULT_LOGICAL_AXIS_RULES = (
     ("qkv", None),
     ("position", None),
     ("expert", "expert"),
+    # Stacked-layer params (models/gpt_pipeline.py): the leading layer dim
+    # shards over pipeline stages; the per-layer dims stay unsharded (v1:
+    # pipeline composes with data parallelism only).
+    ("layers", "pipeline"),
+    ("unstacked_0", None),
+    ("unstacked_1", None),
 )
 # fmt: on
 

@@ -90,14 +90,11 @@ def test_mesh_rejects_zero_axis():
         MeshConfig(tensor=0)
 
 
-def test_mesh_rejects_unwired_pipeline_axis():
-    """pipeline is reserved: nothing maps onto it, so sizes > 1 (or
-    wildcard) must fail loudly instead of computing misleading layouts.
-    expert is wired (MoE) and accepts any size."""
-    with pytest.raises(Exception, match="reserved"):
-        MeshConfig(pipeline=2)
-    with pytest.raises(Exception, match="reserved"):
-        MeshConfig(data=1, pipeline=-1)  # wildcard doesn't bypass the fence
+def test_mesh_pipeline_and_expert_axes_accepted():
+    """pipeline is wired (gpt_pipeline stacks layers on it; whether the
+    SELECTED model supports it is the Trainer's check, covered by
+    tests/test_pipeline.py). expert is wired by MoE."""
+    assert MeshConfig(pipeline=2).axis_sizes()["pipeline"] == 2
     assert MeshConfig(pipeline=1, expert=2).axis_sizes()["expert"] == 2
 
 

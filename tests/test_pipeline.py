@@ -1,0 +1,224 @@
+"""Pipeline parallelism: GPipe executor + gpt_pipeline model.
+
+New capability beyond the reference (SURVEY §2.3: PP absent there). The
+technique mirrors the rest of the suite: a real 8-virtual-device CPU mesh
+(conftest) exercises the actual shard_map/ppermute schedule in one
+process, with equivalence against the sequential application of the same
+stacked params as the correctness oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.parallel.pipeline import gpipe_apply, pipeline_degree
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.tracking.base import NullTracker
+from llmtrain_tpu.training.trainer import Trainer
+
+
+def _mesh(pipeline=4, data=2):
+    devs = np.array(jax.devices()[: pipeline * data]).reshape(pipeline, data)
+    return Mesh(devs, ("pipeline", "data"))
+
+
+def _stage_fn(p, h):
+    def layer(h, lp):
+        return jnp.tanh(h @ lp[0] + lp[1]), None
+
+    h, _ = jax.lax.scan(layer, h, (p["w"], p["b"]))
+    return h
+
+
+def _stack_params(L=8, D=16, seed=0):
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    return {
+        "w": jax.random.normal(k1, (L, D, D)) * 0.1,
+        "b": jax.random.normal(k2, (L, D)) * 0.1,
+    }
+
+
+class TestGPipeExecutor:
+    def test_forward_matches_sequential(self):
+        params = _stack_params()
+        x = jax.random.normal(jax.random.key(2), (8, 4, 16))
+        ref = _stage_fn(params, x)
+        mesh = _mesh()
+        with mesh:
+            y = jax.jit(
+                lambda p, x: gpipe_apply(_stage_fn, p, x, mesh, n_microbatches=4)
+            )(params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    @pytest.mark.parametrize("n_microbatches", [1, 2, 8])
+    def test_microbatch_counts(self, n_microbatches):
+        params = _stack_params(seed=3)
+        x = jax.random.normal(jax.random.key(4), (16, 4, 16))
+        ref = _stage_fn(params, x)
+        mesh = _mesh()
+        with mesh:
+            y = jax.jit(
+                lambda p, x: gpipe_apply(
+                    _stage_fn, p, x, mesh, n_microbatches=n_microbatches
+                )
+            )(params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    def test_gradients_match_sequential(self):
+        params = _stack_params(seed=5)
+        x = jax.random.normal(jax.random.key(6), (8, 4, 16))
+        mesh = _mesh()
+
+        def loss_pipe(p):
+            return (gpipe_apply(_stage_fn, p, x, mesh, n_microbatches=4) ** 2).sum()
+
+        def loss_ref(p):
+            return (_stage_fn(p, x) ** 2).sum()
+
+        with mesh:
+            g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_degree_one_is_sequential(self):
+        params = _stack_params(seed=7)
+        x = jax.random.normal(jax.random.key(8), (4, 4, 16))
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("pipeline", "data"))
+        with mesh:
+            y = gpipe_apply(_stage_fn, params, x, mesh, n_microbatches=4)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_stage_fn(params, x)), atol=1e-6)
+
+    def test_pipeline_degree_helper(self):
+        assert pipeline_degree(None) == 1
+        assert pipeline_degree(_mesh()) == 4
+
+
+def _pp_cfg(**overrides):
+    model = {
+        "name": "gpt_pipeline",
+        "block_size": 16,
+        "d_model": 32,
+        "n_layers": 4,
+        "n_heads": 4,
+        "d_ff": 64,
+        "dropout": 0.0,
+        "vocab_size": 32,
+        "extra": {"tokenizer": "byte", "pipeline_microbatches": 2},
+    }
+    model.update(overrides.pop("model", {}))
+    raw = {
+        "run": {"name": "pp", "seed": 0, "device": "cpu"},
+        "model": model,
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 20,
+            "micro_batch_size": 8,
+            "grad_accum_steps": 2,
+            "warmup_steps": 5,
+            "log_every_steps": 10,
+            "eval_every_steps": 10,
+            "save_every_steps": 100,
+        },
+        "distributed": {"enabled": False, "mesh": {"pipeline": 4, "data": 2}},
+    }
+    raw.update(overrides)
+    return RunConfig.model_validate(raw)
+
+
+class TestPipelineGPT:
+    def setup_method(self):
+        initialize_registries()
+
+    def _build(self, cfg):
+        from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+        adapter = PipelineGPTAdapter()
+        model = adapter.build_model(cfg)
+        params = adapter.init_params(model, cfg, jax.random.key(0))
+        return adapter, model, params
+
+    def test_pipelined_forward_matches_sequential(self):
+        cfg = _pp_cfg()
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 32)
+        ref = model.apply({"params": params}, tokens)  # no mesh -> sequential
+        mesh = _mesh()
+        with mesh:
+            out = jax.jit(lambda p, t: model.apply({"params": p}, t))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_pipelined_grads_match_sequential(self):
+        cfg = _pp_cfg()
+        adapter, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(2), (8, 16), 0, 32)
+        batch = {
+            "input_ids": tokens,
+            "labels": tokens,
+            "attention_mask": jnp.ones_like(tokens),
+        }
+
+        def loss(p):
+            ls, tk = adapter.compute_loss_components(model, p, batch)
+            return jnp.sum(ls) / jnp.sum(tk)
+
+        g_ref = jax.grad(loss)(params)
+        with _mesh():
+            g_pp = jax.jit(jax.grad(loss))(params)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_indivisible_batch_falls_back(self):
+        """Batch not divisible by shards x microbatches runs sequentially
+        (the init probe depends on this) and still matches."""
+        cfg = _pp_cfg()
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(3), (6, 16), 0, 32)
+        ref = model.apply({"params": params}, tokens)
+        with _mesh():
+            out = model.apply({"params": params}, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_trainer_loss_decreases_on_pipeline_mesh(self):
+        trainer = Trainer(_pp_cfg(), None, NullTracker())
+        result = trainer.fit()
+        assert result.first_step_loss is not None
+        assert result.final_loss < result.first_step_loss
+        assert result.final_val_loss is not None
+
+    def test_layer_params_sharded_over_pipeline(self):
+        """Stacked block params must actually shard their leading dim."""
+        trainer = Trainer(_pp_cfg(), None, NullTracker())
+        from flax.core import meta as nn_meta
+
+        params = nn_meta.unbox(trainer.state.params)
+        qkv = params["qkv_kernel"]
+        spec = qkv.sharding.spec
+        assert spec and spec[0] == "pipeline", spec
+
+    def test_plain_gpt_rejects_pipeline_mesh(self):
+        cfg = _pp_cfg(model={"name": "gpt", "extra": {"tokenizer": "byte"}})
+        with pytest.raises(ValueError, match="does not stack its layers"):
+            Trainer(cfg, None, NullTracker())
+
+    def test_layers_must_divide_stages(self):
+        cfg = _pp_cfg(model={"n_layers": 3})
+        with pytest.raises(ValueError, match="pipeline stages"):
+            Trainer(cfg, None, NullTracker())
+
+    def test_rejects_dropout(self):
+        from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+        cfg = _pp_cfg(model={"dropout": 0.1})
+        with pytest.raises(ValueError, match="dropout"):
+            PipelineGPTAdapter().build_model(cfg)
+
+    def test_rejects_tensor_sharding(self):
+        cfg = _pp_cfg(
+            distributed={"enabled": False, "mesh": {"pipeline": 4, "tensor": 2}}
+        )
+        with pytest.raises(ValueError, match="tensor"):
+            Trainer(cfg, None, NullTracker()).fit()
