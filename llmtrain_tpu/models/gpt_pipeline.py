@@ -94,13 +94,6 @@ def make_stage_fn(*, n_heads: int, attention: str, dtype: Any):
     return stage_fn
 
 
-def _ambient_mesh() -> jax.sharding.Mesh | None:
-    from jax._src import mesh as mesh_lib
-
-    physical = mesh_lib.thread_resources.env.physical_mesh
-    return None if physical.empty else physical
-
-
 class PipelineGPT(nn.Module):
     """Decoder-only GPT with a stacked, pipeline-shardable block stack."""
 
@@ -137,6 +130,11 @@ class PipelineGPT(nn.Module):
         deterministic: bool = True,
     ) -> jax.Array:
         del deterministic  # no dropout inside pipelined blocks (v1)
+        # Packed-sequence contract (same as the gpt flash path): the mask
+        # applies to the LOSS only (models/base.py lm_loss_components);
+        # attention is purely causal and never key-masks. Padded batches
+        # need the 'gpt' model with attention='dense'.
+        del attention_mask
         _, seqlen = input_ids.shape
         if seqlen > self.block_size:
             raise ValueError(
@@ -189,8 +187,11 @@ class PipelineGPT(nn.Module):
         stage_fn = make_stage_fn(
             n_heads=self.n_heads, attention=self.attention, dtype=self.dtype
         )
-        mesh = _ambient_mesh()
-        n_stages = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+        from ..parallel.pipeline import pipeline_degree
+        from ..parallel.sharding import ambient_mesh
+
+        mesh = ambient_mesh()
+        n_stages = pipeline_degree(mesh)
         if n_stages > 1:
             from ..parallel.pipeline import BATCH_AXES, gpipe_apply
 

@@ -170,7 +170,6 @@ def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool 
 
 def _ambient_mesh() -> jax.sharding.Mesh | None:
     """The mesh from an enclosing ``with mesh:`` block, if any."""
-    from jax._src import mesh as mesh_lib
+    from ..parallel.sharding import ambient_mesh
 
-    physical = mesh_lib.thread_resources.env.physical_mesh
-    return None if physical.empty else physical
+    return ambient_mesh()

@@ -57,6 +57,19 @@ DEFAULT_LOGICAL_AXIS_RULES = (
 # fmt: on
 
 
+def ambient_mesh() -> Mesh | None:
+    """The mesh from an enclosing ``with mesh:`` block, if any.
+
+    Single home for the private-API access (jax._src churns; one site to
+    fix) — used by ring attention and the pipeline model to decide whether
+    a parallel axis is available at trace time.
+    """
+    from jax._src import mesh as mesh_lib
+
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    return None if physical.empty else physical
+
+
 def data_parallel_degree(mesh: Mesh) -> int:
     """Number of batch shards = product of the axes 'batch' maps onto.
 

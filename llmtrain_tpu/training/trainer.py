@@ -97,7 +97,9 @@ class Trainer:
 
         devices = jax.devices() if cfg.run.device == "tpu" else jax.devices("cpu")
         self._mesh = build_mesh(cfg.distributed.mesh, devices)
-        if self._mesh.shape.get("pipeline", 1) > 1 and not getattr(
+        from ..parallel.pipeline import pipeline_degree
+
+        if pipeline_degree(self._mesh) > 1 and not getattr(
             self._adapter, "supports_pipeline", False
         ):
             raise ValueError(

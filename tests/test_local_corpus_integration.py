@@ -1,0 +1,78 @@
+"""Offline real-corpus integration: full CLI train on actual text.
+
+The egress-dependent counterpart lives in tests/test_real_data.py
+(WikiText-2 + tiktoken; skipped when the hub doesn't resolve). This one
+exercises the same end-to-end contract — CLI subprocess, real text through
+the tokenize→window pipeline, decreasing loss, artifacts on disk — with
+the offline stack (byte tokenizer + local_text over this repo's own
+source files), so the slow tier always has a real-text run regardless of
+network. Marked slow for runtime, not for downloads."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_local_corpus_cli_train_improves(tmp_path):
+    cfg = {
+        "schema_version": 1,
+        "run": {"name": "pycorpus-it", "seed": 7, "device": "cpu"},
+        "model": {
+            "name": "gpt",
+            "block_size": 64,
+            "d_model": 64,
+            "n_layers": 2,
+            "n_heads": 4,
+            "d_ff": 128,
+            "dropout": 0.0,
+            "extra": {"tokenizer": "byte"},
+        },
+        "data": {
+            "name": "local_text",
+            "cache_dir": str(tmp_path / "cache"),
+            "extra": {
+                "globs": [os.path.join(REPO_ROOT, "llmtrain_tpu", "**", "*.py")],
+                "val_fraction": 0.05,
+            },
+        },
+        "trainer": {
+            "max_steps": 30,
+            "micro_batch_size": 4,
+            "grad_accum_steps": 1,
+            "lr": 0.001,
+            "warmup_steps": 5,
+            "log_every_steps": 10,
+            "eval_every_steps": 30,
+            "save_every_steps": 30,
+        },
+        "mlflow": {"enabled": False},
+        "output": {"root_dir": "runs"},
+    }
+    (tmp_path / "config.yaml").write_text(yaml.safe_dump(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", "train", "--config", "config.yaml", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+        env=env,
+        timeout=1800,
+    )
+    assert proc.returncode == 0, proc.stderr
+    tr = json.loads(proc.stdout)["train_result"]
+    assert tr["final_step"] == 30
+    assert tr["final_loss"] < tr["first_step_loss"]
+    assert tr["final_val_loss"] is not None
+    run_dirs = list((tmp_path / "runs").iterdir())
+    assert len(run_dirs) == 1
+    assert (run_dirs[0] / "checkpoints").exists()
+    assert (run_dirs[0] / "config.yaml").exists()

@@ -6,13 +6,28 @@ the tiktoken gpt2 encoding, so they only run with network access
 
 import json
 import os
+import socket
 import subprocess
 import sys
 
 import pytest
 import yaml
 
+
 pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _require_egress():
+    """Skip when the HF hub is unreachable — these tests need downloads.
+
+    A fixture (not module-level skipif) so the probe runs only when a test
+    here is actually selected, with a bounded timeout, and tests actual
+    connectability rather than DNS alone."""
+    try:
+        socket.create_connection(("huggingface.co", 443), timeout=5).close()
+    except OSError:
+        pytest.skip("no network egress (downloads required)")
 
 CFG = {
     "schema_version": 1,
