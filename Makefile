@@ -42,6 +42,11 @@ train-pipeline:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m llmtrain_tpu train --config configs/presets/gpt_pipeline_smoke.yaml
 
+# Mixture-of-Experts with a 4-way expert-parallel mesh axis.
+train-moe:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m llmtrain_tpu train --config configs/presets/gpt_moe_smoke.yaml
+
 bench:
 	python bench.py
 

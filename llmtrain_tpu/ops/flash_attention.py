@@ -28,10 +28,21 @@ import jax
 from .blockwise_attention import blockwise_attention
 
 
+def _auto_block(t: int) -> int | None:
+    """Largest legal tile for sequence length ``t``.
+
+    512 measured fastest on v5e at GPT-2-small shapes (fwd 9.67 ms vs
+    10.10 at 256, bwd 11.93 vs 13.19 — RESULTS.md); smaller tiles keep odd
+    lengths like 384 or 768 on the Pallas path instead of falling back.
+    """
+    for block in (512, 256, 128):
+        if t >= block and t % block == 0:
+            return block
+    return None
+
+
 def _use_pallas(t: int) -> bool:
-    # The Pallas kernels tile with block_q=block_k=256 (min'd with T), so T
-    # must divide evenly by the actual block size or the kernel raises.
-    return jax.default_backend() == "tpu" and t >= 128 and t % min(256, t) == 0
+    return jax.default_backend() == "tpu" and _auto_block(t) is not None
 
 
 def _pallas_bwd_enabled() -> bool:
@@ -40,10 +51,13 @@ def _pallas_bwd_enabled() -> bool:
 
 @jax.custom_vjp
 def _flash(q, k, v):
-    if _use_pallas(q.shape[1]):
+    block = _auto_block(q.shape[1])
+    if jax.default_backend() == "tpu" and block is not None:
         from .pallas_attention import pallas_flash_attention
 
-        return pallas_flash_attention(q, k, v, causal=True)
+        return pallas_flash_attention(
+            q, k, v, causal=True, block_q=block, block_k=block
+        )
     return blockwise_attention(q, k, v, causal=True)
 
 
@@ -51,7 +65,10 @@ def _flash_fwd(q, k, v):
     if _use_pallas(q.shape[1]) and _pallas_bwd_enabled():
         from .pallas_attention import pallas_flash_attention_fwd
 
-        out, lse = pallas_flash_attention_fwd(q, k, v, causal=True)
+        block = _auto_block(q.shape[1])
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, causal=True, block_q=block, block_k=block
+        )
         return out, (q, k, v, out, lse)
     return _flash(q, k, v), (q, k, v, None, None)
 
@@ -61,7 +78,10 @@ def _flash_bwd(residuals, g):
     if out is not None:
         from .pallas_attention import pallas_flash_attention_bwd
 
-        return pallas_flash_attention_bwd(q, k, v, out, lse, g, causal=True)
+        block = _auto_block(q.shape[1])
+        return pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, causal=True, block_q=block, block_k=block
+        )
     _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=True), q, k, v)
     return vjp(g)
 
