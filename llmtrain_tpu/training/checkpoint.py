@@ -236,15 +236,20 @@ def warn_on_config_mismatch(
 def resolve_resume_path(resume_spec: str, output_root: str | Path) -> Path:
     """Resolve a ``--resume`` spec (reference trainer.py:215-241).
 
-    file → itself; dir → latest inside; bare ``*.ckpt``/``*.pt`` string →
-    FileNotFoundError; anything else → treated as a run id under
-    ``{output_root}/{run_id}/checkpoints``.
+    file → itself; dir → latest inside (falling back to the dir's
+    ``checkpoints/`` subdir, so a run DIRECTORY path works like its run
+    id); bare ``*.ckpt``/``*.pt`` string → FileNotFoundError; anything
+    else → treated as a run id under ``{output_root}/{run_id}/checkpoints``.
     """
     candidate = Path(resume_spec)
     if candidate.is_file():
         return candidate
     if candidate.is_dir():
         latest = CheckpointManager(candidate).latest_checkpoint()
+        if latest is None and (candidate / "checkpoints").is_dir():
+            # A run DIRECTORY (not just a run id): descend into its
+            # checkpoints/ subdir, same shape as the run-id branch below.
+            latest = CheckpointManager(candidate / "checkpoints").latest_checkpoint()
         if latest is None:
             raise FileNotFoundError(f"No checkpoints found in directory: {candidate}")
         return latest

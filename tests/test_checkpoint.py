@@ -196,6 +196,15 @@ class TestResumeResolution:
         (d / "step_000003.ckpt").write_bytes(b"x")
         assert resolve_resume_path("my_run", tmp_path).name == "step_000003.ckpt"
 
+    def test_run_directory_descends_into_checkpoints(self, tmp_path):
+        """A run DIRECTORY path (not just its id) also resolves — it holds
+        no .ckpt files itself but has a checkpoints/ subdir."""
+        d = tmp_path / "my_run" / "checkpoints"
+        d.mkdir(parents=True)
+        (d / "step_000007.ckpt").write_bytes(b"x")
+        got = resolve_resume_path(str(tmp_path / "my_run"), tmp_path)
+        assert got.name == "step_000007.ckpt"
+
     def test_unknown_run_id_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError, match="neither"):
             resolve_resume_path("ghost_run", tmp_path)
