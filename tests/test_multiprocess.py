@@ -186,3 +186,50 @@ def test_two_process_fsdp_sharded_checkpoint_resume(tmp_path):
     assert result["resumed_from_step"] == 2
     assert result["final_step"] == 4
     assert result["final_loss"] == pytest.approx(full_loss, abs=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_parallel_train(tmp_path):
+    """2-process gpt_pipeline run with the pipeline axis SPANNING the
+    process boundary: {pipeline: 2, data: 4} over 8 global devices, one
+    pipeline stage's devices owned by each process — the GPipe ppermute
+    handoff crosses processes. Asserts clean completion, a finite
+    decreasing loss, and rank-0-only artifacts."""
+    pp_cfg = {
+        **CFG,
+        "run": {"name": "mp-pp", "seed": 31, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt_pipeline",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 2,
+            "n_heads": 2,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+            "extra": {"tokenizer": "byte", "pipeline_microbatches": 2},
+        },
+        "trainer": {
+            **CFG["trainer"],
+            # per-shard batch = micro*dp/dp_shards: global 8 over data=4
+            # shards -> 2/shard... keep global batch divisible by
+            # dp(4) x microbatches(2) = 8.
+            "micro_batch_size": 2,
+        },
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 60,
+            "mesh": {"pipeline": 2, "data": -1, "fsdp": 1, "tensor": 1, "sequence": 1},
+        },
+    }
+    (tmp_path / "pp.yaml").write_text(yaml.safe_dump(pp_cfg))
+
+    outs = _launch_two_process(tmp_path, "pp.yaml", "mp_pp")
+    for rc, _, err in outs:
+        assert rc == 0, f"pipeline rank failed: {err[-2000:]}"
+    result = _summary(outs)["train_result"]
+    assert result["final_step"] == 4
+    assert result["final_loss"] > 0
+    assert result["final_loss"] < result["first_step_loss"]
+    runs = list((tmp_path / "runs").iterdir())
+    assert [p.name for p in runs] == ["mp_pp"]
