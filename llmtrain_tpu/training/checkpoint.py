@@ -46,7 +46,7 @@ def _to_host(tree: Any) -> Any:
 
     # Phase 1: start every addressable leaf's device→host DMA up front so
     # the transfers pipeline instead of serializing leaf-by-leaf inside
-    # np.asarray (measured 3.7x on a tunneled v5e: 104s → 28s for the
+    # np.asarray (measured ~4x on a tunneled v5e: 104s → 24s for the
     # 1.5 GB GPT-2-small train state).
     for x in jax.tree.leaves(unboxed):
         if isinstance(x, jax.Array) and (
