@@ -109,6 +109,10 @@ class PipelineGPT(nn.Module):
     attention: str = "dense"
     n_microbatches: int = 4
     remat: bool = True
+    # >1 selects the interleaved (Megatron-style) schedule: each stage
+    # holds this many non-contiguous layer chunks and microbatches make
+    # that many passes around the stage ring — bubble (S-1)/(v*M+S-1).
+    n_virtual_chunks: int = 1
 
     def _stacked(self, name: str, shape: tuple[int, ...], init) -> jax.Array:
         """A per-layer-stacked parameter: leading dim n_layers on logical
@@ -202,10 +206,11 @@ class PipelineGPT(nn.Module):
                         f"only; mesh axis {banned!r} must be 1, got "
                         f"{mesh.shape[banned]}"
                     )
-            if self.n_layers % n_stages != 0:
+            if self.n_layers % (n_stages * self.n_virtual_chunks) != 0:
                 raise ValueError(
                     f"n_layers {self.n_layers} must divide evenly into "
-                    f"{n_stages} pipeline stages"
+                    f"{n_stages} pipeline stages x {self.n_virtual_chunks} "
+                    "virtual chunks"
                 )
             dp = math.prod(int(mesh.shape.get(a, 1)) for a in BATCH_AXES)
             needed = dp * self.n_microbatches
@@ -230,6 +235,7 @@ class PipelineGPT(nn.Module):
                 mesh,
                 n_microbatches=self.n_microbatches,
                 remat_stage=self.remat,
+                virtual_chunks=self.n_virtual_chunks,
             )
         else:
             fn = jax.checkpoint(stage_fn) if self.remat else stage_fn
@@ -301,9 +307,17 @@ class PipelineGPTAdapter(ModelAdapter):
             dtype=jnp.dtype(cfg.model.dtype),
             param_dtype=jnp.dtype(cfg.model.param_dtype),
             attention=cfg.model.attention,
-            n_microbatches=int(cfg.model.extra.get("pipeline_microbatches", 4)),
+            n_microbatches=self._positive_extra(cfg, "pipeline_microbatches", 4),
             remat=cfg.model.remat,
+            n_virtual_chunks=self._positive_extra(cfg, "pipeline_virtual_chunks", 1),
         )
+
+    @staticmethod
+    def _positive_extra(cfg: RunConfig, key: str, default: int) -> int:
+        value = int(cfg.model.extra.get(key, default))
+        if value < 1:
+            raise ValueError(f"model.extra.{key} must be >= 1, got {value}")
+        return value
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
         from ..data.tokenizers import build_tokenizer
