@@ -76,3 +76,106 @@ def test_local_corpus_cli_train_improves(tmp_path):
     assert len(run_dirs) == 1
     assert (run_dirs[0] / "checkpoints").exists()
     assert (run_dirs[0] / "config.yaml").exists()
+
+
+def test_preemption_kill_and_auto_resume(tmp_path):
+    """Fault injection for the elastic-recovery story: SIGKILL a training
+    process mid-run, relaunch the identical command with --auto-resume,
+    and the run completes from the last durable checkpoint. (The
+    reference's only recovery is manual --resume — SURVEY §5.)"""
+    import signal
+    import time
+
+    cfg = {
+        "schema_version": 1,
+        "run": {"name": "preempt-it", "seed": 3, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "dummy_gpt",
+            "block_size": 8,
+            "d_model": 48,
+            "n_layers": 1,
+            "n_heads": 2,
+            "d_ff": 96,
+            "dropout": 0.0,
+            "vocab_size": 32,
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            # Effectively unfinishable: the run must still be mid-flight
+            # when the kill lands, however fast the machine is.
+            "max_steps": 1_000_000,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 1,
+            "lr": 0.003,
+            "warmup_steps": 0,
+            "log_every_steps": 50,
+            "eval_every_steps": 4_000_000,
+            "save_every_steps": 50,
+        },
+        "mlflow": {"enabled": False},
+        "output": {"root_dir": "runs"},
+    }
+    (tmp_path / "config.yaml").write_text(yaml.safe_dump(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    argv = [
+        sys.executable, "-m", "llmtrain_tpu", "train",
+        "--config", "config.yaml", "--json",
+        "--run-id", "preempt_run", "--auto-resume",
+    ]
+
+    # Launch, wait until at least one checkpoint is durable, then SIGKILL.
+    # Output goes to files, not PIPEs: an undrained pipe can block a chatty
+    # child before its first checkpoint and mask the real error.
+    out_path = tmp_path / "first.out"
+    err_path = tmp_path / "first.err"
+    with out_path.open("w") as out_f, err_path.open("w") as err_f:
+        proc = subprocess.Popen(
+            argv, cwd=tmp_path, env=env, stdout=out_f, stderr=err_f, text=True
+        )
+        ckpt_dir = tmp_path / "runs" / "preempt_run" / "checkpoints"
+        deadline = time.time() + 240
+        try:
+            while time.time() < deadline:
+                if ckpt_dir.is_dir() and any(ckpt_dir.glob("step_*.ckpt")):
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "run ended before first checkpoint: "
+                        + err_path.read_text()[-2000:]
+                    )
+                time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    "no checkpoint appeared within 240s: "
+                    + err_path.read_text()[-2000:]
+                )
+            proc.send_signal(signal.SIGKILL)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+    steps = [
+        int(p.name[len("step_") : -len(".ckpt")])
+        for p in ckpt_dir.glob("step_*.ckpt")
+    ]
+    assert steps, "kill happened before any checkpoint"
+    last_durable = max(steps)
+
+    # Same command with a horizon RELATIVE to the durable checkpoint: the
+    # relaunch must resume there and train real post-resume steps (no
+    # resume-past-end escape hatch). Config beats the snapshot on resume.
+    cfg["trainer"]["max_steps"] = last_durable + 100
+    (tmp_path / "config.yaml").write_text(yaml.safe_dump(cfg))
+    second = subprocess.run(
+        argv, cwd=tmp_path, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    summary = json.loads(
+        [ln for ln in second.stdout.splitlines() if ln.startswith("{")][-1]
+    )
+    tr = summary["train_result"]
+    assert tr["resumed_from_step"] == last_durable
+    assert tr["final_step"] == last_durable + 100
+    assert tr["final_loss"] > 0
