@@ -241,6 +241,7 @@ class TransformerBlock(nn.Module):
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(
@@ -280,6 +281,7 @@ class TransformerBlock(nn.Module):
                 n_layers=self.n_layers,
                 capacity_factor=self.capacity_factor,
                 aux_loss_weight=self.moe_aux_weight,
+                router_top_k=self.router_top_k,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="moe_mlp",
@@ -329,6 +331,7 @@ class GPT(nn.Module):
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    router_top_k: int = 1
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -410,6 +413,7 @@ class GPT(nn.Module):
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
+                router_top_k=self.router_top_k,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 

@@ -14,6 +14,7 @@ mechanism, reference config/schemas.py:37):
         n_experts: 8           # required, >= 2
         capacity_factor: 1.25  # optional
         moe_aux_weight: 0.01   # optional; load-balance loss scale
+        router_top_k: 1        # optional; 2 = GShard second-choice routing
 
 The training objective is CE + load-balance aux (sown by each MoE layer);
 the aux term is folded into the per-example loss sums proportionally to
@@ -48,6 +49,7 @@ class GPTMoEAdapter(GPTAdapter):
             n_experts=n_experts,
             capacity_factor=float(extra.get("capacity_factor", 1.25)),
             moe_aux_weight=float(extra.get("moe_aux_weight", 0.01)),
+            router_top_k=int(extra.get("router_top_k", 1)),
         )
 
     def compute_loss_components(

@@ -1,4 +1,4 @@
-"""Mixture-of-Experts MLP (Switch-style top-1 routing), mesh-first.
+"""Mixture-of-Experts MLP (top-1 Switch or top-2 GShard routing), mesh-first.
 
 New capability beyond the reference (dense MLP only, reference
 models/gpt.py:94-97), designed the TPU/XLA way (GShard/Switch pattern):
@@ -11,15 +11,21 @@ hand-written collectives.
 
 Semantics:
 
-* top-1 routing (Switch Transformer): each token goes to its argmax expert,
-  output scaled by the router probability.
-* fixed expert capacity ``ceil(capacity_factor * T / n_experts)`` per
+* ``router_top_k=1`` (Switch Transformer): each token goes to its argmax
+  expert, output scaled by the raw router probability.
+* ``router_top_k=2`` (GShard): each token also goes to its second-choice
+  expert; the two RAW router probabilities are renormalized to sum to 1
+  (before any capacity drop — a dropped choice contributes zero without
+  inflating the survivor), and second choices queue BEHIND all first
+  choices for capacity (first-choice priority).
+* fixed expert capacity ``ceil(capacity_factor * k * T / n_experts)`` per
   sequence; tokens over capacity are dropped — they pass through the
-  residual connection unchanged (output 0 from the MoE layer).
+  residual connection unchanged (output 0 from the MoE layer for that
+  choice).
 * load-balance auxiliary loss ``aux_weight * E^2 * mean_e(f_e * P_e)``
-  sown into the ``losses`` collection; the gpt_moe adapter folds it into
-  the training objective. ``sow`` is a no-op when the collection isn't
-  mutable, so eval/generation paths need no changes.
+  (f from first choices) sown into the ``losses`` collection; the gpt_moe
+  adapter folds it into the training objective. ``sow`` is a no-op when
+  the collection isn't mutable, so eval/generation paths need no changes.
 """
 
 from __future__ import annotations
@@ -47,6 +53,7 @@ class MoEMLP(nn.Module):
     n_layers: int
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    router_top_k: int = 1
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -54,7 +61,12 @@ class MoEMLP(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         batch, seqlen, d_model = x.shape
         n_exp = self.n_experts
-        capacity = max(1, int(math.ceil(self.capacity_factor * seqlen / n_exp)))
+        k = self.router_top_k
+        if k not in (1, 2):
+            raise ValueError(f"router_top_k must be 1 or 2, got {k}")
+        if k > n_exp:
+            raise ValueError(f"router_top_k {k} exceeds n_experts {n_exp}")
+        capacity = max(1, int(math.ceil(self.capacity_factor * k * seqlen / n_exp)))
 
         # Router in float32: softmax over tiny expert dim must not run bf16.
         router_logits = nn.Dense(
@@ -70,25 +82,53 @@ class MoEMLP(nn.Module):
         expert_index = jnp.argmax(gates, axis=-1)  # (B, T)
         expert_mask = jax.nn.one_hot(expert_index, n_exp, dtype=jnp.float32)
 
-        # Switch load-balance loss: E * sum_e f_e * P_e per sequence
-        # (fraction of tokens routed to e times mean router prob of e),
-        # scaled so a perfectly uniform router gives aux_weight * 1.0.
+        # Load-balance loss from FIRST choices: E * sum_e f_e * P_e per
+        # sequence (fraction of tokens routed to e times mean router prob
+        # of e), scaled so a perfectly uniform router gives aux_weight*1.0.
         density = expert_mask.mean(axis=1)  # (B, E)
         density_proxy = gates.mean(axis=1)  # (B, E)
         aux = self.aux_loss_weight * n_exp * n_exp * jnp.mean(density * density_proxy)
         self.sow("losses", "moe_aux", aux)
 
-        # Position of each token in its expert's queue (1-based), capacity cut.
-        position_in_expert = jnp.cumsum(expert_mask, axis=1) * expert_mask
-        expert_mask = expert_mask * (position_in_expert <= capacity)
-        gate = jnp.sum(gates * expert_mask, axis=-1)  # (B, T); 0 when dropped
+        # Per-choice dispatch with first-choice capacity priority: choice c
+        # tokens queue behind every earlier choice's (post-cut) enqueues.
+        remaining = gates
+        queued = jnp.zeros((batch, n_exp), jnp.float32)  # tokens enqueued per expert
+        choices = []  # (mask_post_cut, raw_prob, kept, position) per choice
+        for _ in range(k):
+            mask_pre = jax.nn.one_hot(
+                jnp.argmax(remaining, axis=-1), n_exp, dtype=jnp.float32
+            )
+            pos = (jnp.cumsum(mask_pre, axis=1) + queued[:, None, :]) * mask_pre
+            mask_post = mask_pre * (pos <= capacity)
+            raw_prob = jnp.sum(remaining * mask_pre, axis=-1)  # (B, T) pre-drop
+            kept = jnp.sum(mask_post, axis=-1)  # (B, T) 1.0 unless dropped
+            position = jnp.sum(pos * mask_post, axis=-1) - 1.0
+            choices.append((mask_post, raw_prob, kept, position))
+            queued = queued + mask_post.sum(axis=1)
+            remaining = remaining * (1.0 - mask_pre)
+
+        # Combine weights: k=1 keeps the raw Switch probability; k>1
+        # renormalizes the RAW router probabilities to sum to 1 (GShard) —
+        # BEFORE capacity drops, so a congested neighbor zeroes a dropped
+        # choice's contribution without inflating the surviving one.
+        if k == 1:
+            weights = [p * kp for _, p, kp, _ in choices]
+        else:
+            denom = jnp.maximum(sum(p for _, p, _, _ in choices), 1e-9)
+            weights = [p / denom * kp for _, p, kp, _ in choices]
 
         # One-hot over capacity slots; dropped tokens (position 0 -> -1) map
         # to all-zero rows.
-        position = jnp.sum(position_in_expert * expert_mask, axis=-1) - 1.0
-        position_oh = jax.nn.one_hot(position.astype(jnp.int32), capacity, dtype=jnp.float32)
-        dispatch = expert_mask[..., None] * position_oh[:, :, None, :]  # (B,T,E,C)
-        combine = dispatch * gate[:, :, None, None]
+        dispatch = jnp.zeros((batch, seqlen, n_exp, capacity), jnp.float32)
+        combine = jnp.zeros((batch, seqlen, n_exp, capacity), jnp.float32)
+        for (mask_i, _, _, position_i), weight_i in zip(choices, weights):
+            position_oh = jax.nn.one_hot(
+                position_i.astype(jnp.int32), capacity, dtype=jnp.float32
+            )
+            dispatch_i = mask_i[..., None] * position_oh[:, :, None, :]  # (B,T,E,C)
+            dispatch = dispatch + dispatch_i
+            combine = combine + dispatch_i * weight_i[:, :, None, None]
 
         # Dispatch tokens: (B,T,E,C) x (B,T,D) -> (E,B,C,D). The E dim is
         # expert-sharded, B stays data-sharded (act_expert_group) — the

@@ -206,3 +206,130 @@ class TestGPTMoEAdapter:
             batch = {"input_ids": tokens, "labels": tokens}
             new_state, metrics = step_fn(state, batch, jax.random.key(1))
             assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+class TestTop2Routing:
+    """router_top_k=2: GShard-style second-choice routing."""
+
+    def _mlp(self, **kw):
+        from llmtrain_tpu.models.moe import MoEMLP
+
+        defaults = dict(
+            d_model=16, d_ff=32, n_experts=4, n_layers=2, router_top_k=2
+        )
+        defaults.update(kw)
+        return MoEMLP(**defaults)
+
+    def test_two_experts_ample_capacity_is_exact_soft_mixture(self):
+        """With E=2 and k=2 and capacity >= T, every token reaches BOTH
+        experts and the renormalized gates sum to 1 — the layer must equal
+        the dense mixture p0*expert0(x) + p1*expert1(x) computed by hand."""
+        mlp = self._mlp(n_experts=2, capacity_factor=4.0)
+        x = jax.random.normal(jax.random.key(0), (2, 6, 16))
+        boxed = mlp.init({"params": jax.random.key(1)}, x)["params"]
+        out = mlp.apply({"params": boxed}, x)
+
+        import numpy as np
+        from flax.linen import meta as nn_meta
+
+        params = nn_meta.unbox(boxed)
+
+        logits = x.astype(jnp.float32) @ params["router"]["kernel"]
+        gates = jax.nn.softmax(logits, axis=-1)  # (B,T,2), sums to 1
+
+        def expert(e, xin):
+            h = jnp.einsum("btd,df->btf", xin, params["wi"][e]) + params["bi"][e]
+            h = jax.nn.gelu(h, approximate=False)
+            return jnp.einsum("btf,fd->btd", h, params["wo"][e]) + params["bo"][e]
+
+        ref = gates[..., 0:1] * expert(0, x) + gates[..., 1:2] * expert(1, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5
+        )
+
+    def test_top2_blends_two_experts_top1_uses_one(self):
+        """Behavioral check with constant-output experts: surgically set
+        expert e to output (e+1)*ones regardless of input, so the layer
+        output reveals exactly which experts each token reached and with
+        what weights. k=1 must equal raw_prob(first)*(first+1); k=2 must
+        equal the renormalized two-expert blend."""
+        import numpy as np
+        from flax.linen import meta as nn_meta
+
+        x = jax.random.normal(jax.random.key(2), (2, 8, 16))
+        outs = {}
+        for k in (1, 2):
+            mlp = self._mlp(router_top_k=k, capacity_factor=8.0)
+            params = nn_meta.unbox(
+                mlp.init({"params": jax.random.key(3)}, x)["params"]
+            )
+            # Constant experts: wi=0, bi=0 -> gelu(0)=0; wo=0; bo[e]=(e+1).
+            n_exp = params["wi"].shape[0]
+            params["wi"] = np.zeros_like(params["wi"])
+            params["bi"] = np.zeros_like(params["bi"])
+            params["wo"] = np.zeros_like(params["wo"])
+            params["bo"] = np.tile(
+                np.arange(1, n_exp + 1, dtype=np.float32)[:, None],
+                (1, params["bo"].shape[1]),
+            )
+            outs[k] = np.asarray(mlp.apply({"params": params}, x))
+
+            logits = np.asarray(x, np.float32) @ np.asarray(params["router"]["kernel"])
+            gates = np.asarray(jax.nn.softmax(logits, axis=-1))
+            order = np.argsort(-gates, axis=-1)
+            e1, e2 = order[..., 0], order[..., 1]
+            g1 = np.take_along_axis(gates, e1[..., None], -1)[..., 0]
+            g2 = np.take_along_axis(gates, e2[..., None], -1)[..., 0]
+            if k == 1:
+                expect = g1 * (e1 + 1)  # raw Switch probability
+            else:
+                expect = (g1 * (e1 + 1) + g2 * (e2 + 1)) / (g1 + g2)
+            np.testing.assert_allclose(outs[k][..., 0], expect, atol=1e-5)
+        assert not np.allclose(outs[1], outs[2])
+
+    def test_invalid_top_k_raises(self):
+        x = jax.random.normal(jax.random.key(4), (1, 4, 16))
+        for bad in (0, 3):
+            mlp = self._mlp(router_top_k=bad)
+            with pytest.raises(ValueError, match="router_top_k"):
+                mlp.init({"params": jax.random.key(5)}, x)
+        mlp = self._mlp(n_experts=1, router_top_k=2)
+        with pytest.raises(ValueError, match="exceeds"):
+            mlp.init({"params": jax.random.key(6)}, x)
+
+    def test_adapter_knob_and_training(self, tmp_path):
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.registry import get_model_adapter, initialize_registries
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        initialize_registries()
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "moe2", "seed": 5, "device": "cpu"},
+                "model": {
+                    "name": "gpt_moe",
+                    "block_size": 8,
+                    "d_model": 32,
+                    "n_layers": 1,
+                    "n_heads": 2,
+                    "d_ff": 64,
+                    "dropout": 0.0,
+                    "vocab_size": 32,
+                    "extra": {"n_experts": 2, "router_top_k": 2},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": 20,
+                    "micro_batch_size": 4,
+                    "warmup_steps": 0,
+                    "log_every_steps": 10,
+                    "eval_every_steps": 100,
+                    "save_every_steps": 100,
+                },
+            }
+        )
+        model = get_model_adapter("gpt_moe")().build_model(cfg)
+        assert model.router_top_k == 2
+        result = Trainer(cfg, None, NullTracker()).fit()
+        assert result.final_loss < result.first_step_loss
