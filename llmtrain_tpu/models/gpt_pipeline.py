@@ -10,12 +10,13 @@ stage execution: every block parameter carries a LEADING layer dim
 stack runs through ``parallel/pipeline.gpipe_apply`` — microbatches
 rotating across stages over ICI.
 
-Scope (v1, validated loudly): causal packed sequences only (padding masks
+Scope (validated loudly): causal packed sequences only (padding masks
 apply to the loss, not inside attention — same contract as the flash
 path), no dropout inside pipelined blocks, and ``pipeline`` composes with
-``data`` only (``tensor``/``fsdp``/``sequence`` must be 1: stage params
-are replicated across those axes by the shard_map specs, so sharding them
-would silently all-gather).
+``data`` AND ``tensor`` (Megatron column/row splits inside each stage:
+qkv/fc shard their output heads/width, out/proj their input, with the two
+row-parallel psums written explicitly in the stage — shard_map is manual).
+``fsdp``/``sequence`` must be 1.
 """
 
 from __future__ import annotations
@@ -44,45 +45,54 @@ def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def make_block_apply(*, n_heads: int, attention: str, dtype: Any):
+def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
     """Functional pre-norm transformer block over stacked params.
 
     ``p`` leaves are ONE layer's slice (no leading layer dim); ``h`` is
     (B, T, D). Mirrors TransformerBlock (models/gpt.py:245-308) without
-    module machinery so it can run under shard_map/scan.
+    module machinery so it can run under shard_map/scan. Shapes are read
+    from the params, so the same code runs full-width or on a tensor-
+    parallel shard (H/tp heads, F/tp mlp width): with ``tp_axis`` set the
+    block inserts the two Megatron row-parallel psums (after out-proj and
+    after mlp-proj; biases added once, after the psum).
     """
 
     def block_apply(p: dict[str, jax.Array], h: jax.Array) -> jax.Array:
-        b, t, d = h.shape
-        head_dim = d // n_heads
-
         hn = _layernorm(h, p["ln1_scale"], p["ln1_bias"])
-        qkv = hn.astype(dtype) @ p["qkv_kernel"].astype(dtype) + p["qkv_bias"].astype(dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, n_heads, head_dim)
-        k = k.reshape(b, t, n_heads, head_dim)
-        v = v.reshape(b, t, n_heads, head_dim)
+        # qkv kernel is head-major (D, 3, H, Dh) so tensor parallelism can
+        # shard whole heads; local H may be a tp-shard of the global count.
+        qkv = jnp.einsum(
+            "btd,dkhe->btkhe", hn.astype(dtype), p["qkv_kernel"].astype(dtype)
+        ) + p["qkv_bias"].astype(dtype)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, T, Hl, Dh)
         if attention == "flash":
             from ..ops.flash_attention import flash_attention
 
             att = flash_attention(q, k, v, causal=True)
         else:
             att = dense_attention(q, k, v, attention_mask=None)
-        att = att.reshape(b, t, d)
-        h = h + (att.astype(dtype) @ p["out_kernel"].astype(dtype) + p["out_bias"].astype(dtype))
+        proj = jnp.einsum(
+            "bthe,hed->btd", att.astype(dtype), p["out_kernel"].astype(dtype)
+        )
+        if tp_axis is not None:
+            proj = jax.lax.psum(proj, tp_axis)
+        h = h + proj + p["out_bias"].astype(dtype)
 
         hn = _layernorm(h, p["ln2_scale"], p["ln2_bias"])
         m = hn.astype(dtype) @ p["fc_kernel"].astype(dtype) + p["fc_bias"].astype(dtype)
         m = nn.gelu(m, approximate=False)
-        h = h + (m @ p["proj_kernel"].astype(dtype) + p["proj_bias"].astype(dtype))
+        mlp = m @ p["proj_kernel"].astype(dtype)
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
+        h = h + mlp + p["proj_bias"].astype(dtype)
         return h
 
     return block_apply
 
 
-def make_stage_fn(*, n_heads: int, attention: str, dtype: Any):
+def make_stage_fn(*, attention: str, dtype: Any, tp_axis: str | None = None):
     """Stage program: scan ``block_apply`` over this stage's layer slice."""
-    block_apply = make_block_apply(n_heads=n_heads, attention=attention, dtype=dtype)
+    block_apply = make_block_apply(attention=attention, dtype=dtype, tp_axis=tp_axis)
 
     def stage_fn(stage_params: dict[str, jax.Array], h: jax.Array) -> jax.Array:
         def body(h, layer_params):
@@ -114,13 +124,16 @@ class PipelineGPT(nn.Module):
     # that many passes around the stage ring — bubble (S-1)/(v*M+S-1).
     n_virtual_chunks: int = 1
 
-    def _stacked(self, name: str, shape: tuple[int, ...], init) -> jax.Array:
+    def _stacked(
+        self, name: str, shape: tuple[int, ...], init, axes: tuple[str, ...]
+    ) -> jax.Array:
         """A per-layer-stacked parameter: leading dim n_layers on logical
-        axis "layers" (→ mesh ``pipeline``)."""
-        axes = ("layers",) + tuple(f"unstacked_{i}" for i in range(len(shape)))
+        axis "layers" (→ mesh ``pipeline``); ``axes`` names the per-layer
+        dims with the same logical vocabulary as models/gpt.py (so heads/
+        mlp dims shard over ``tensor`` in the train state)."""
         return self.param(
             name,
-            nn.with_logical_partitioning(init, axes),
+            nn.with_logical_partitioning(init, ("layers", *axes)),
             (self.n_layers, *shape),
             self.param_dtype,
         )
@@ -172,40 +185,52 @@ class PipelineGPT(nn.Module):
         )
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
-        d, f = self.d_model, self.d_ff
+        d, f, nh = self.d_model, self.d_ff, self.n_heads
+        hd = d // nh
+        ones, zeros = nn.initializers.ones_init(), nn.initializers.zeros_init()
         blocks = {
-            "ln1_scale": self._stacked("ln1_scale", (d,), nn.initializers.ones_init()),
-            "ln1_bias": self._stacked("ln1_bias", (d,), nn.initializers.zeros_init()),
-            "qkv_kernel": self._stacked("qkv_kernel", (d, 3 * d), dense_init),
-            "qkv_bias": self._stacked("qkv_bias", (3 * d,), nn.initializers.zeros_init()),
-            "out_kernel": self._stacked("out_kernel", (d, d), scaled_init),
-            "out_bias": self._stacked("out_bias", (d,), nn.initializers.zeros_init()),
-            "ln2_scale": self._stacked("ln2_scale", (d,), nn.initializers.ones_init()),
-            "ln2_bias": self._stacked("ln2_bias", (d,), nn.initializers.zeros_init()),
-            "fc_kernel": self._stacked("fc_kernel", (d, f), dense_init),
-            "fc_bias": self._stacked("fc_bias", (f,), nn.initializers.zeros_init()),
-            "proj_kernel": self._stacked("proj_kernel", (f, d), scaled_init),
-            "proj_bias": self._stacked("proj_bias", (d,), nn.initializers.zeros_init()),
+            "ln1_scale": self._stacked("ln1_scale", (d,), ones, ("embed",)),
+            "ln1_bias": self._stacked("ln1_bias", (d,), zeros, ("embed",)),
+            # Head-major qkv so tensor parallelism shards whole heads.
+            "qkv_kernel": self._stacked(
+                "qkv_kernel", (d, 3, nh, hd), dense_init, ("embed", "qkv", "heads", "kv")
+            ),
+            "qkv_bias": self._stacked(
+                "qkv_bias", (3, nh, hd), zeros, ("qkv", "heads", "kv")
+            ),
+            "out_kernel": self._stacked(
+                "out_kernel", (nh, hd, d), scaled_init, ("heads", "kv", "embed")
+            ),
+            "out_bias": self._stacked("out_bias", (d,), zeros, ("embed",)),
+            "ln2_scale": self._stacked("ln2_scale", (d,), ones, ("embed",)),
+            "ln2_bias": self._stacked("ln2_bias", (d,), zeros, ("embed",)),
+            "fc_kernel": self._stacked("fc_kernel", (d, f), dense_init, ("embed", "mlp")),
+            "fc_bias": self._stacked("fc_bias", (f,), zeros, ("mlp",)),
+            "proj_kernel": self._stacked("proj_kernel", (f, d), scaled_init, ("mlp", "embed")),
+            "proj_bias": self._stacked("proj_bias", (d,), zeros, ("embed",)),
         }
 
-        stage_fn = make_stage_fn(
-            n_heads=self.n_heads, attention=self.attention, dtype=self.dtype
-        )
         from ..parallel.pipeline import pipeline_degree
         from ..parallel.sharding import ambient_mesh
 
         mesh = ambient_mesh()
         n_stages = pipeline_degree(mesh)
+        tp = int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
         if n_stages > 1:
             from ..parallel.pipeline import BATCH_AXES, gpipe_apply
 
-            for banned in ("tensor", "fsdp", "sequence"):
+            for banned in ("fsdp", "sequence"):
                 if int(mesh.shape.get(banned, 1)) != 1:
                     raise ValueError(
-                        f"gpt_pipeline composes pipeline with data parallelism "
-                        f"only; mesh axis {banned!r} must be 1, got "
+                        f"gpt_pipeline composes pipeline with data and tensor "
+                        f"parallelism; mesh axis {banned!r} must be 1, got "
                         f"{mesh.shape[banned]}"
                     )
+            if nh % tp != 0 or f % tp != 0:
+                raise ValueError(
+                    f"tensor parallelism needs n_heads ({nh}) and d_ff ({f}) "
+                    f"divisible by the tensor axis size ({tp})"
+                )
             if self.n_layers % (n_stages * self.n_virtual_chunks) != 0:
                 raise ValueError(
                     f"n_layers {self.n_layers} must divide evenly into "
@@ -228,6 +253,36 @@ class PipelineGPT(nn.Module):
                     )
                 n_stages = 1
         if n_stages > 1:
+            from jax.sharding import PartitionSpec as P
+
+            tp_axis = "tensor" if tp > 1 else None
+            stage_fn = make_stage_fn(
+                attention=self.attention, dtype=self.dtype, tp_axis=tp_axis
+            )
+
+            def _pspec(*tail):
+                return P("pipeline", *tail)
+
+            # Mirrors the logical axes above with "tensor" where heads/mlp
+            # shard — shard_map is manual, so the specs must say it again.
+            # Only when tp > 1: a size-1 (or absent) tensor axis must not
+            # appear, or params become tensor-varying with no psum to
+            # cancel it and the layer-scan carry types mismatch.
+            tens = "tensor" if tp > 1 else None
+            param_specs = {
+                "ln1_scale": _pspec(None),
+                "ln1_bias": _pspec(None),
+                "qkv_kernel": _pspec(None, None, tens, None),
+                "qkv_bias": _pspec(None, tens, None),
+                "out_kernel": _pspec(tens, None, None),
+                "out_bias": _pspec(None),
+                "ln2_scale": _pspec(None),
+                "ln2_bias": _pspec(None),
+                "fc_kernel": _pspec(None, tens),
+                "fc_bias": _pspec(tens),
+                "proj_kernel": _pspec(tens, None),
+                "proj_bias": _pspec(None),
+            }
             x = gpipe_apply(
                 stage_fn,
                 blocks,
@@ -236,8 +291,10 @@ class PipelineGPT(nn.Module):
                 n_microbatches=self.n_microbatches,
                 remat_stage=self.remat,
                 virtual_chunks=self.n_virtual_chunks,
+                param_specs=param_specs,
             )
         else:
+            stage_fn = make_stage_fn(attention=self.attention, dtype=self.dtype)
             fn = jax.checkpoint(stage_fn) if self.remat else stage_fn
             x = fn(blocks, x)
 

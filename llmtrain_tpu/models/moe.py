@@ -79,26 +79,18 @@ class MoEMLP(nn.Module):
         )(x.astype(jnp.float32))
         gates = jax.nn.softmax(router_logits, axis=-1)  # (B, T, E) f32
 
-        expert_index = jnp.argmax(gates, axis=-1)  # (B, T)
-        expert_mask = jax.nn.one_hot(expert_index, n_exp, dtype=jnp.float32)
-
-        # Load-balance loss from FIRST choices: E * sum_e f_e * P_e per
-        # sequence (fraction of tokens routed to e times mean router prob
-        # of e), scaled so a perfectly uniform router gives aux_weight*1.0.
-        density = expert_mask.mean(axis=1)  # (B, E)
-        density_proxy = gates.mean(axis=1)  # (B, E)
-        aux = self.aux_loss_weight * n_exp * n_exp * jnp.mean(density * density_proxy)
-        self.sow("losses", "moe_aux", aux)
-
         # Per-choice dispatch with first-choice capacity priority: choice c
         # tokens queue behind every earlier choice's (post-cut) enqueues.
         remaining = gates
         queued = jnp.zeros((batch, n_exp), jnp.float32)  # tokens enqueued per expert
         choices = []  # (mask_post_cut, raw_prob, kept, position) per choice
+        first_choice_mask = None  # pre-cut first-choice one-hot, for the aux loss
         for _ in range(k):
             mask_pre = jax.nn.one_hot(
                 jnp.argmax(remaining, axis=-1), n_exp, dtype=jnp.float32
             )
+            if first_choice_mask is None:
+                first_choice_mask = mask_pre
             pos = (jnp.cumsum(mask_pre, axis=1) + queued[:, None, :]) * mask_pre
             mask_post = mask_pre * (pos <= capacity)
             raw_prob = jnp.sum(remaining * mask_pre, axis=-1)  # (B, T) pre-drop
@@ -107,6 +99,14 @@ class MoEMLP(nn.Module):
             choices.append((mask_post, raw_prob, kept, position))
             queued = queued + mask_post.sum(axis=1)
             remaining = remaining * (1.0 - mask_pre)
+
+        # Load-balance loss from FIRST choices: E * sum_e f_e * P_e per
+        # sequence (fraction of tokens routed to e times mean router prob
+        # of e), scaled so a perfectly uniform router gives aux_weight*1.0.
+        density = first_choice_mask.mean(axis=1)  # (B, E)
+        density_proxy = gates.mean(axis=1)  # (B, E)
+        aux = self.aux_loss_weight * n_exp * n_exp * jnp.mean(density * density_proxy)
+        self.sow("losses", "moe_aux", aux)
 
         # Combine weights: k=1 keeps the raw Switch probability; k>1
         # renormalizes the RAW router probabilities to sum to 1 (GShard) —

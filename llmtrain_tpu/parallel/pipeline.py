@@ -75,6 +75,7 @@ def gpipe_apply(
     axis: str = "pipeline",
     remat_stage: bool = True,
     virtual_chunks: int = 1,
+    param_specs: Any | None = None,
 ) -> jax.Array:
     """Run ``x`` through all layers with pipeline scheduling over ``axis``.
 
@@ -84,6 +85,13 @@ def gpipe_apply(
     ``x``: (B, T, D) activations with B sharded over the data axes. Returns
     (B, T, D) after all layers, replicated over ``axis`` (non-final stages
     receive the result via psum).
+
+    ``param_specs``: optional pytree of PartitionSpecs (matching ``params``)
+    for the NON-layer dims — e.g. tensor-parallel sharding of head/mlp dims;
+    every spec's dim 0 must be the ``axis`` entry. Default: non-layer dims
+    replicated. When a leaf is tensor-sharded, ``stage_fn`` is responsible
+    for the matching collectives (it runs inside shard_map — nothing is
+    automatic).
     """
     n_stages = pipeline_degree(mesh)
     if n_stages == 1:
@@ -125,7 +133,15 @@ def gpipe_apply(
     fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
     batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
-    p_specs = jax.tree.map(lambda a: P(axis, *([None] * (a.ndim - 1))), params)
+    if param_specs is not None:
+        p_specs = param_specs
+        for spec in jax.tree.leaves(p_specs, is_leaf=lambda s: isinstance(s, P)):
+            if not spec or spec[0] != axis:
+                raise ValueError(
+                    f"param_specs must shard dim 0 over {axis!r}, got {spec}"
+                )
+    else:
+        p_specs = jax.tree.map(lambda a: P(axis, *([None] * (a.ndim - 1))), params)
 
     def inner(p: Any, x_local: jax.Array) -> jax.Array:
         stage = jax.lax.axis_index(axis)

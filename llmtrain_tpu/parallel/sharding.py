@@ -48,11 +48,9 @@ DEFAULT_LOGICAL_AXIS_RULES = (
     ("position", None),
     ("expert", "expert"),
     # Stacked-layer params (models/gpt_pipeline.py): the leading layer dim
-    # shards over pipeline stages; the per-layer dims stay unsharded (v1:
-    # pipeline composes with data parallelism only).
+    # shards over pipeline stages; the per-layer dims reuse the standard
+    # names above (heads/mlp -> tensor), so DP x PP x TP composes.
     ("layers", "pipeline"),
-    ("unstacked_0", None),
-    ("unstacked_1", None),
 )
 # fmt: on
 
