@@ -186,6 +186,11 @@ class Trainer:
         return self._state
 
     @property
+    def model(self):
+        """The built (uninitialized) Flax module — for generation/eval."""
+        return self._model
+
+    @property
     def mesh(self):
         return self._mesh
 
