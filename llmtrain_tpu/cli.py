@@ -110,6 +110,27 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--seed", type=int, default=1234)
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
+    traintok = sub.add_parser(
+        "train-tokenizer",
+        help="train an offline byte-level BPE vocabulary on local text",
+    )
+    traintok.add_argument(
+        "--input",
+        required=True,
+        action="append",
+        help="text file or directory (repeatable); directories are read "
+        "recursively for *.txt/*.md/*.py files",
+    )
+    traintok.add_argument("--vocab-size", type=int, default=8192)
+    traintok.add_argument("--output", required=True, help="vocabulary JSON path")
+    traintok.add_argument(
+        "--max-bytes",
+        type=int,
+        default=64_000_000,
+        help="cap on corpus bytes read for training",
+    )
+    traintok.add_argument("--json", action="store_true", help="emit stats as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -155,6 +176,80 @@ def _handle_print_config(args: argparse.Namespace) -> int:
         import yaml
 
         print(yaml.safe_dump(resolved, sort_keys=False), end="")
+    return EXIT_OK
+
+
+def _handle_train_tokenizer(args: argparse.Namespace) -> int:
+    """Train an offline BPE vocabulary (data/bpe.py) on local text.
+
+    New capability over the reference, whose only tokenizer is the
+    downloaded tiktoken gpt2 (reference models/gpt.py:210-212); pairs with
+    ``model.extra.tokenizer: "bpe:<output>"``.
+    """
+    from pathlib import Path
+
+    from .data.bpe import train_bpe
+
+    seen: set[Path] = set()
+    files: list[Path] = []
+
+    def _add(q: Path) -> None:
+        r = q.resolve()
+        if r not in seen:
+            seen.add(r)
+            files.append(q)
+
+    for spec in args.input:
+        p = Path(spec)
+        if p.is_dir():
+            for q in sorted(
+                q for suf in ("*.txt", "*.md", "*.py") for q in p.rglob(suf)
+            ):
+                _add(q)
+        elif p.is_file():
+            _add(p)
+        else:
+            _emit_error(f"input path not found: {spec}")
+            return EXIT_CONFIG_ERROR
+    if not files:
+        _emit_error("no input files found (looked for *.txt, *.md, *.py in dirs)")
+        return EXIT_CONFIG_ERROR
+
+    budget = args.max_bytes  # enforced on UTF-8 bytes read, not characters
+    pieces: list[str] = []
+    for f in files:
+        if budget <= 0:
+            break
+        raw = f.open("rb").read(budget)
+        budget -= len(raw)
+        pieces.append(raw.decode("utf-8", errors="ignore"))
+    corpus = "\n\n".join(pieces)
+
+    import time
+
+    start = time.perf_counter()
+    tok = train_bpe(corpus, args.vocab_size)
+    elapsed = time.perf_counter() - start
+    tok.save(args.output)
+
+    n_tokens = len(tok.encode(corpus[:1_000_000]))
+    n_bytes = len(corpus[:1_000_000].encode("utf-8"))
+    stats = {
+        "output": args.output,
+        "vocab_size": tok.n_vocab,
+        "corpus_bytes": len(corpus.encode("utf-8")),
+        "files": len(files),
+        "train_seconds": round(elapsed, 2),
+        "bytes_per_token": round(n_bytes / max(n_tokens, 1), 3),
+    }
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        print(
+            f"trained {stats['vocab_size']}-token BPE on {stats['corpus_bytes']} bytes "
+            f"({stats['files']} files) in {stats['train_seconds']}s -> {args.output} "
+            f"[{stats['bytes_per_token']} bytes/token]"
+        )
     return EXIT_OK
 
 
@@ -475,6 +570,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_train(args)
     if args.command == "generate":
         return _handle_generate(args)
+    if args.command == "train-tokenizer":
+        return _handle_train_tokenizer(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

@@ -33,14 +33,25 @@ class ByteTokenizer:
 
 
 def build_tokenizer(name: str):
-    """Resolve a tokenizer by config name: "gpt2" (tiktoken) or "byte"."""
+    """Resolve a tokenizer by config name.
+
+    "gpt2" (tiktoken, needs network), "byte" (offline fallback), or
+    "bpe:<path>" — a vocabulary trained offline with the
+    ``train-tokenizer`` CLI subcommand (data/bpe.py).
+    """
     if name == "byte":
         return ByteTokenizer()
     if name == "gpt2":
         import tiktoken
 
         return tiktoken.get_encoding("gpt2")
-    raise ValueError(f"unknown tokenizer {name!r}; expected 'gpt2' or 'byte'")
+    if name.startswith("bpe:"):
+        from .bpe import BPETokenizer
+
+        return BPETokenizer.load(name[len("bpe:") :])
+    raise ValueError(
+        f"unknown tokenizer {name!r}; expected 'gpt2', 'byte', or 'bpe:<path>'"
+    )
 
 
 __all__ = ["ByteTokenizer", "build_tokenizer"]

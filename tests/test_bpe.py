@@ -1,0 +1,254 @@
+"""Offline BPE tokenizer (data/bpe.py) + train-tokenizer CLI.
+
+New capability over the reference (its only tokenizer is the downloaded
+tiktoken gpt2, reference models/gpt.py:210-212); tested in the reference's
+style: unit behavior, determinism, persistence, CLI subprocess, and an
+end-to-end train through the real data path.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.data.bpe import BPETokenizer, train_bpe
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "the quicker brown foxes jump over lazier dogs!\n"
+) * 50 + "def quick_fn(arg1, arg2):\n    return arg1 + arg2\n" * 30
+
+
+class TestTraining:
+    def test_vocab_size_and_interface(self):
+        tok = train_bpe(CORPUS, 512)
+        assert tok.n_vocab <= 512
+        assert tok.n_vocab > 256  # learned at least some merges
+        assert tok.eot_token == tok.n_vocab - 1
+
+    def test_deterministic(self):
+        a = train_bpe(CORPUS, 400)
+        b = train_bpe(CORPUS, 400)
+        assert a.fingerprint == b.fingerprint
+        assert a.encode(CORPUS[:500]) == b.encode(CORPUS[:500])
+
+    def test_compresses_repeated_text(self):
+        tok = train_bpe(CORPUS, 512)
+        ids = tok.encode("the quick brown fox")
+        assert len(ids) < len("the quick brown fox".encode())
+
+    def test_too_small_vocab_raises(self):
+        with pytest.raises(ValueError, match="vocab_size"):
+            train_bpe(CORPUS, 200)
+
+    def test_stops_early_on_tiny_corpus(self):
+        tok = train_bpe("ab", 10_000)
+        assert tok.n_vocab < 300
+
+
+class TestRoundtrip:
+    def test_encode_decode_exact(self):
+        tok = train_bpe(CORPUS, 512)
+        for text in (
+            "the quick brown fox",
+            "unseen words zyxw!",
+            "tabs\tand\nnewlines  spaces",
+            "unicode: café ✓ \U0001f600",
+            "",
+        ):
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_encode_np_matches_encode(self):
+        tok = train_bpe(CORPUS, 400)
+        np.testing.assert_array_equal(
+            tok.encode_np(CORPUS[:300]), np.asarray(tok.encode(CORPUS[:300]), np.int32)
+        )
+
+    def test_decode_rejects_out_of_range(self):
+        tok = train_bpe(CORPUS, 400)
+        with pytest.raises(ValueError, match="out of range"):
+            tok.decode([tok.n_vocab])
+
+    def test_decode_special_token(self):
+        tok = train_bpe(CORPUS, 400)
+        assert tok.decode([tok.eot_token]) == "<|endoftext|>"
+
+
+class TestPersistence:
+    def test_save_load_identical(self, tmp_path):
+        tok = train_bpe(CORPUS, 512)
+        path = tmp_path / "tok.json"
+        tok.save(path)
+        loaded = BPETokenizer.load(path)
+        assert loaded.fingerprint == tok.fingerprint
+        assert loaded.n_vocab == tok.n_vocab
+        assert loaded.encode(CORPUS[:400]) == tok.encode(CORPUS[:400])
+
+    def test_load_rejects_other_json(self, tmp_path):
+        path = tmp_path / "x.json"
+        path.write_text(json.dumps({"hello": 1}))
+        with pytest.raises(ValueError, match="not a llmtrain-bpe"):
+            BPETokenizer.load(path)
+
+    def test_build_tokenizer_bpe_spec(self, tmp_path):
+        from llmtrain_tpu.data.tokenizers import build_tokenizer
+
+        path = tmp_path / "tok.json"
+        train_bpe(CORPUS, 400).save(path)
+        tok = build_tokenizer(f"bpe:{path}")
+        assert isinstance(tok, BPETokenizer)
+
+
+class TestCLI:
+    def test_train_tokenizer_subcommand(self, tmp_path):
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_text(CORPUS)
+        out = tmp_path / "tok.json"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "llmtrain_tpu",
+                "train-tokenizer",
+                "--input",
+                str(corpus),
+                "--vocab-size",
+                "512",
+                "--output",
+                str(out),
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        stats = json.loads(proc.stdout)
+        assert stats["vocab_size"] <= 512
+        assert out.exists()
+        assert BPETokenizer.load(out).n_vocab == stats["vocab_size"]
+
+    def test_missing_input_is_config_error(self, tmp_path):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "llmtrain_tpu",
+                "train-tokenizer",
+                "--input",
+                str(tmp_path / "nope.txt"),
+                "--output",
+                str(tmp_path / "tok.json"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+
+
+def test_end_to_end_train_with_bpe(tmp_path):
+    """Full Trainer run through local_text with a bpe:<path> tokenizer:
+    the vocabulary sizes the model and the loss decreases."""
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.registry import initialize_registries
+    from llmtrain_tpu.tracking.base import NullTracker
+    from llmtrain_tpu.training.trainer import Trainer
+
+    initialize_registries()
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(CORPUS)
+    vocab = tmp_path / "tok.json"
+    train_bpe(CORPUS, 384).save(vocab)
+
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": "bpe-e2e", "seed": 0, "device": "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": 32,
+                "d_model": 32,
+                "n_layers": 1,
+                "n_heads": 4,
+                "d_ff": 64,
+                "dropout": 0.0,
+                "extra": {"tokenizer": f"bpe:{vocab}"},
+            },
+            "data": {
+                "name": "local_text",
+                "cache_dir": str(tmp_path / "cache"),
+                "extra": {"globs": [str(corpus)]},
+            },
+            "trainer": {
+                "max_steps": 12,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "warmup_steps": 2,
+                "log_every_steps": 6,
+                "eval_every_steps": 12,
+                "save_every_steps": 12,
+            },
+            "mlflow": {"enabled": False},
+        }
+    )
+    trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+    # The trained vocabulary sized the model (adapter pulls n_vocab).
+    assert trainer.model.vocab_size == BPETokenizer.load(vocab).n_vocab
+    result = trainer.fit()
+    assert result.final_step == 12
+    assert result.final_loss < result.first_step_loss
+
+
+class TestCLIHardening:
+    """Regression tests for review findings on the train-tokenizer CLI."""
+
+    def test_output_into_missing_directory(self, tmp_path):
+        corpus = tmp_path / "c.txt"
+        corpus.write_text(CORPUS)
+        out = tmp_path / "deep" / "nested" / "tok.json"  # parent doesn't exist
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "train-tokenizer",
+                "--input", str(corpus), "--vocab-size", "384",
+                "--output", str(out),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert out.exists()
+
+    def test_overlapping_inputs_deduplicated(self, tmp_path):
+        corpus = tmp_path / "c.txt"
+        corpus.write_text(CORPUS)
+        out = tmp_path / "tok.json"
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "train-tokenizer",
+                "--input", str(tmp_path), "--input", str(corpus),  # dir + file inside it
+                "--vocab-size", "384", "--output", str(out), "--json",
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        stats = json.loads(proc.stdout)
+        assert stats["files"] == 1  # not double-counted
+
+    def test_max_bytes_is_bytes_not_chars(self, tmp_path):
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("é" * 4096)  # 2 bytes/char UTF-8
+        out = tmp_path / "tok.json"
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "train-tokenizer",
+                "--input", str(corpus), "--vocab-size", "300",
+                "--output", str(out), "--max-bytes", "1000", "--json",
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        stats = json.loads(proc.stdout)
+        assert stats["corpus_bytes"] <= 1000
