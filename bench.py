@@ -169,10 +169,19 @@ def _child_main() -> None:
     # Tuning knobs (used by perf sweeps; defaults above are the contract).
     # Ignored in the watchdog's last-resort CPU child: sweep values are
     # tuned for the chip and would blow the CPU timeout.
+    loss_impl = "dense"
     if os.environ.get("LLMTRAIN_BENCH_FALLBACK") != "1":
         batch = int(os.environ.get("LLMTRAIN_BENCH_BATCH", batch))
         seq = int(os.environ.get("LLMTRAIN_BENCH_SEQ", seq))
         steps = int(os.environ.get("LLMTRAIN_BENCH_STEPS", steps))
+        # "chunked" streams the CE over vocab chunks (ops/chunked_ce.py):
+        # no [B,T,V] in HBM, enabling larger batches on the chip.
+        loss_impl = os.environ.get("LLMTRAIN_BENCH_CE", "dense")
+        loss_impl = {"chunked": "chunked_ce"}.get(loss_impl, loss_impl)
+        if loss_impl not in ("dense", "chunked_ce"):
+            raise SystemExit(
+                f"LLMTRAIN_BENCH_CE={loss_impl!r} invalid: use 'dense' or 'chunked'"
+            )
 
     # Degradation ladder: halve the batch on OOM; on any other flash failure
     # go straight to dense at the SAME batch (a deterministic kernel bug
@@ -181,6 +190,9 @@ def _child_main() -> None:
     # the fallback used is visible in the JSON ``detail`` (attention +
     # batch fields).
     att, b = ("flash" if on_tpu else "dense"), batch
+    run = lambda a, bb: _run(  # noqa: E731
+        on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, bb, steps, a, loss_impl
+    )
     # Each rung costs a full jit compile (~minutes on a tunneled TPU); cap
     # the ladder so a cascade of OOMs can't eat the parent watchdog's whole
     # budget before any JSON line is printed. The final rung is always
@@ -190,7 +202,7 @@ def _child_main() -> None:
     while True:
         attempts_left -= 1
         try:
-            _run(on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, b, steps, att)
+            run(att, b)
             return
         except Exception as exc:
             import traceback
@@ -225,6 +237,7 @@ def _run(
     batch: int,
     steps: int,
     attention: str,
+    loss_impl: str = "dense",
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -259,6 +272,7 @@ def _run(
                 "vocab_size": vocab,
                 "dtype": "bfloat16" if on_tpu else "float32",
                 "attention": attention,
+                "extra": {"loss_impl": loss_impl},
             },
             "data": {"name": "dummy_text"},
             "trainer": {"micro_batch_size": batch, "grad_accum_steps": 1, "warmup_steps": 0},
@@ -317,6 +331,7 @@ def _run(
                     "device_kind": jax.devices()[0].device_kind,
                     "model": f"gpt L{depth} d{d_model} T{seq}",
                     "attention": effective_attention,
+                    "loss_impl": loss_impl,
                     "batch": batch,
                     "params": n_params,
                     "mfu": round(mfu, 4),

@@ -332,6 +332,12 @@ class GPT(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     router_top_k: int = 1
+    # Loss implementation hint consumed by GPTAdapter.compute_loss_components:
+    # "dense" materializes logits; "chunked_ce" streams the CE over vocab
+    # chunks of ce_chunk (ops/chunked_ce.py) — the forward then returns
+    # hidden states via return_hidden and never builds [B,T,V].
+    loss_impl: str = "dense"
+    ce_chunk: int = 8192
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -355,6 +361,7 @@ class GPT(nn.Module):
         attention_mask: jax.Array | None = None,
         *,
         deterministic: bool = True,
+        return_hidden: bool = False,
     ) -> jax.Array:
         _, seqlen = input_ids.shape
         if seqlen > self.block_size:
@@ -425,6 +432,14 @@ class GPT(nn.Module):
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
         )(x)
 
+        if return_hidden:
+            # Chunked-CE path (ops/chunked_ce.py): the loss contracts the
+            # hidden states against the vocab matrix itself; skipping the
+            # lm_head here is what keeps [B,T,V] out of HBM. NOTE: an
+            # untied model must still initialize lm_head params, so init
+            # runs with return_hidden=False (adapter handles this).
+            return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
         if self.tie_embeddings:
             logits = token_embedding.attend(x)
         else:
@@ -451,6 +466,12 @@ class GPTAdapter(ModelAdapter):
             if not isinstance(tokenizer_vocab_size, int) or tokenizer_vocab_size <= 0:
                 raise ValueError("GPT tokenizer must expose a positive integer n_vocab.")
             vocab_size = tokenizer_vocab_size
+        loss_impl = cfg.model.extra.get("loss_impl", "dense")
+        if loss_impl not in ("dense", "chunked_ce"):
+            raise ValueError(
+                f"model.extra.loss_impl {loss_impl!r} unknown; "
+                "expected 'dense' or 'chunked_ce'"
+            )
         if cfg.model.attention in ("flash", "ring") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
@@ -470,6 +491,8 @@ class GPTAdapter(ModelAdapter):
             param_dtype=jnp.dtype(cfg.model.param_dtype),
             remat=cfg.model.remat,
             attention=cfg.model.attention,
+            loss_impl=loss_impl,
+            ce_chunk=int(cfg.model.extra.get("ce_chunk", 8192)),
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
@@ -489,8 +512,49 @@ class GPTAdapter(ModelAdapter):
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
     ) -> tuple[jax.Array, jax.Array]:
+        if getattr(model, "loss_impl", "dense") == "chunked_ce":
+            return self._chunked_loss_components(
+                model, params, batch, rngs=rngs, deterministic=deterministic
+            )
         return lm_loss_components(
             model, params, batch, rngs=rngs, deterministic=deterministic
+        )
+
+    @staticmethod
+    def _chunked_loss_components(
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None,
+        deterministic: bool,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Same loss as the dense path, streamed over vocab chunks
+        (ops/chunked_ce.py) so [B,T,V] never materializes."""
+        from ..models.base import validate_lm_batch
+        from ..ops.chunked_ce import chunked_ce_components
+
+        input_ids, labels, attention_mask = validate_lm_batch(batch)
+        hidden = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask=attention_mask,
+            deterministic=deterministic,
+            rngs=rngs,
+            return_hidden=True,
+        )
+        if model.tie_embeddings:
+            w_vocab = params["token_embedding"]["embedding"]
+        else:
+            w_vocab = params["lm_head"]["kernel"]
+        # Trainer-held params are boxed with partitioning metadata
+        # (nn.with_logical_partitioning); model.apply unboxes internally but
+        # direct access must do it explicitly. No-op on plain arrays.
+        w_vocab = nn.meta.unbox(w_vocab)
+        if not model.tie_embeddings:
+            w_vocab = w_vocab.T  # (d, V) -> (V, d)
+        return chunked_ce_components(
+            hidden, w_vocab, labels, attention_mask, chunk=model.ce_chunk
         )
 
 

@@ -44,6 +44,14 @@ class GPTMoEAdapter(GPTAdapter):
                 "gpt_moe requires model.extra.n_experts >= 2 "
                 f"(got {n_experts}); use model.name 'gpt' for a dense MLP"
             )
+        if extra.get("loss_impl", "dense") != "dense":
+            # This adapter's loss path adds the router aux objective on top
+            # of the dense CE; accepting the knob while running dense would
+            # silently lie about memory behavior.
+            raise ValueError(
+                "gpt_moe does not support model.extra.loss_impl "
+                f"{extra['loss_impl']!r}; only 'dense' is implemented"
+            )
         base = super().build_model(cfg)
         return base.clone(
             n_experts=n_experts,
