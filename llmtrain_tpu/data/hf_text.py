@@ -86,7 +86,9 @@ class HFTextDataModule(DataModule):
         dataset_config = (cfg.data.dataset_config or "default").replace("/", "__")
         # Key the cache by tokenizer identity too: reusing token ids produced
         # by a different tokenizer would silently corrupt training.
-        tok_id = f"{type(tokenizer).__name__}{getattr(tokenizer, 'n_vocab', 'x')}"
+        from .tokenizers import tokenizer_cache_id
+
+        tok_id = tokenizer_cache_id(tokenizer)
         return (
             Path(cfg.data.cache_dir)
             / "processed"

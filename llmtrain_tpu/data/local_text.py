@@ -84,10 +84,9 @@ class LocalTextDataModule(DataModule):
             st = Path(f).stat()
             h.update(f.encode())
             h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
-        tok_id = (
-            f"{type(tokenizer).__name__}{getattr(tokenizer, 'n_vocab', 'x')}"
-            f"{getattr(tokenizer, 'fingerprint', '')}"
-        )
+        from .tokenizers import tokenizer_cache_id
+
+        tok_id = tokenizer_cache_id(tokenizer)
         cache_path = (
             Path(cfg.data.cache_dir) / "processed" / f"local__{h.hexdigest()[:16]}__{tok_id}.npy"
         )

@@ -54,4 +54,17 @@ def build_tokenizer(name: str):
     )
 
 
-__all__ = ["ByteTokenizer", "build_tokenizer"]
+def tokenizer_cache_id(tokenizer) -> str:
+    """Identity string for token-cache keys (hf_text.py, local_text.py).
+
+    Token ids from a different tokenizer would silently corrupt training,
+    so caches key on class + vocab size + content fingerprint (the latter
+    distinguishes same-size trained vocabularies, data/bpe.py).
+    """
+    return (
+        f"{type(tokenizer).__name__}{getattr(tokenizer, 'n_vocab', 'x')}"
+        f"{getattr(tokenizer, 'fingerprint', '')}"
+    )
+
+
+__all__ = ["ByteTokenizer", "build_tokenizer", "tokenizer_cache_id"]

@@ -226,3 +226,68 @@ class TestKnobValidation:
             GPTMoEAdapter().build_model(
                 self._cfg("gpt_moe", {"n_experts": 4, "loss_impl": "chunked_ce"})
             )
+
+
+class TestShardedMesh:
+    """chunked_ce composes with tensor/fsdp/sequence sharding: the vocab
+    reshape inside the scan must not change results under a sharded mesh
+    (verified bit-identical to the dense path on the virtual 8-device
+    mesh)."""
+
+    @pytest.mark.parametrize(
+        "mesh",
+        [
+            {"tensor": 2, "data": 4},
+            {"tensor": 2, "fsdp": 2, "sequence": 2, "data": 1},
+        ],
+        ids=["tp-dp", "tp-fsdp-sp"],
+    )
+    def test_matches_dense_on_mesh(self, mesh):
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        initialize_registries()
+
+        def run(loss_impl):
+            cfg = RunConfig.model_validate(
+                {
+                    "run": {"name": "cce-mesh", "seed": 0, "device": "cpu"},
+                    "model": {
+                        "name": "gpt",
+                        "block_size": 8,
+                        "d_model": 32,
+                        "n_layers": 2,
+                        "n_heads": 4,
+                        "d_ff": 64,
+                        "dropout": 0.0,
+                        "vocab_size": 64,
+                        "extra": {
+                            "tokenizer": "byte",
+                            "loss_impl": loss_impl,
+                            "ce_chunk": 32,
+                        },
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {
+                        "max_steps": 3,
+                        "micro_batch_size": 2,
+                        "grad_accum_steps": 2,
+                        "warmup_steps": 0,
+                        "log_every_steps": 1,
+                        "eval_every_steps": 3,
+                        "save_every_steps": 3,
+                    },
+                    "distributed": {"mesh": mesh},
+                    "mlflow": {"enabled": False},
+                }
+            )
+            trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+            result = trainer.fit()
+            return result.final_loss, result.final_val_loss
+
+        dense = run("dense")
+        chunked = run("chunked_ce")
+        assert abs(dense[0] - chunked[0]) < 1e-5
+        assert abs(dense[1] - chunked[1]) < 1e-5
