@@ -112,12 +112,18 @@ def _watchdog_main() -> None:
         result = _last_json_line(stdout)
         if result is not None:
             if rc != 0:
-                if rc is None and _SWEEP_MARKER in stderr:
+                if _SWEEP_MARKER in stderr:
                     # The main measurement completed and printed its line;
-                    # only the OPTIONAL auto-sweep outlived the budget. Not
-                    # a failure of the captured number.
-                    note = f"{label}: optional auto-sweep cut short by timeout"
-                    print(note, file=sys.stderr, flush=True)
+                    # only the OPTIONAL auto-sweep timed out or crashed the
+                    # process (e.g. libtpu SIGABRT on OOM bypasses Python
+                    # exception handling). Not a failure of the captured
+                    # number.
+                    how = "timed out" if rc is None else f"died rc={rc}"
+                    print(
+                        f"{label}: optional auto-sweep {how}; main result stands",
+                        file=sys.stderr,
+                        flush=True,
+                    )
                 else:
                     failures.append(
                         f"{label}: result captured but child "
@@ -172,6 +178,12 @@ def _child_main() -> None:
         jax.config.update("jax_platforms", "cpu")
         backend = jax.default_backend()
     on_tpu = backend == "tpu"
+
+    # Persistent compile cache: watchdog retries, the auto-sweep, and
+    # future rounds reuse each ~20-40s TPU compile instead of repaying it.
+    from llmtrain_tpu.distributed import configure_compilation_cache
+
+    configure_compilation_cache()
 
     if on_tpu:
         depth, d_model, n_heads, d_ff = 12, 768, 12, 3072

@@ -23,6 +23,7 @@ from . import __version__
 from .config import ConfigLoadError, load_and_validate_config
 from .distributed import (
     DistState,
+    configure_compilation_cache,
     configure_platform,
     setup_distributed,
     teardown_distributed,
@@ -318,6 +319,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
+    configure_compilation_cache()
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
     try:
@@ -428,6 +430,7 @@ def _handle_train(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
+    configure_compilation_cache()
     dist_state: DistState | None = None
     if cfg.distributed.enabled:
         dist_state = setup_distributed(cfg.distributed)

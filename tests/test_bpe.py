@@ -252,3 +252,28 @@ class TestCLIHardening:
         assert proc.returncode == 0, proc.stderr
         stats = json.loads(proc.stdout)
         assert stats["corpus_bytes"] <= 1000
+
+
+def test_roundtrip_fuzz_random_unicode():
+    """Property: decode(encode(x)) == x for arbitrary unicode, including
+    codepoints and byte sequences never seen during training."""
+    import random
+
+    tok = train_bpe(CORPUS, 384)
+    rng = random.Random(1234)
+    alphabets = [
+        (0x20, 0x7E),      # ASCII
+        (0xA0, 0x2FF),     # Latin supplements
+        (0x400, 0x4FF),    # Cyrillic
+        (0x4E00, 0x4FFF),  # CJK slice
+        (0x1F300, 0x1F64F),  # emoji
+    ]
+    for _ in range(100):
+        lo, hi = rng.choice(alphabets)
+        text = "".join(chr(rng.randint(lo, hi)) for _ in range(rng.randint(0, 64)))
+        assert tok.decode(tok.encode(text)) == text
+    # Mixed-alphabet long string
+    mixed = "".join(
+        chr(rng.randint(*rng.choice(alphabets))) for _ in range(2000)
+    )
+    assert tok.decode(tok.encode(mixed)) == mixed
