@@ -111,6 +111,20 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--seed", type=int, default=1234)
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
+    evalp = sub.add_parser(
+        "eval", help="run the validation loop on a checkpoint, no training"
+    )
+    evalp.add_argument("--config", required=True, help="path to the YAML run config")
+    evalp.add_argument(
+        "--from",
+        dest="from_spec",
+        default=None,
+        help="checkpoint file, checkpoint dir, or run id to evaluate "
+        "(default: the freshly initialized model)",
+    )
+    evalp.add_argument("--json", action="store_true", help="emit metrics as JSON")
+    evalp.add_argument("-v", "--verbose", action="store_true", help="DEBUG logging")
+
     traintok = sub.add_parser(
         "train-tokenizer",
         help="train an offline byte-level BPE vocabulary on local text",
@@ -302,6 +316,44 @@ def _agree_flag(local_ok: bool, dist_state: DistState | None) -> bool:
 
     agreed = multihost_utils.broadcast_one_to_all(np.uint8(1 if local_ok else 0))
     return bool(np.asarray(agreed))
+
+
+def _handle_eval(args: argparse.Namespace) -> int:
+    """Eval-only: restore a checkpoint and run the validation loop once.
+
+    New capability over the reference (its eval exists only inside the
+    train loop, reference trainer.py:243-289); pairs with the loss-parity
+    story — evaluate any checkpoint against any config's val split.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_compilation_cache()
+    level = "DEBUG" if args.verbose else cfg.logging.level
+    configure_logging(level=level, json_output=cfg.logging.json_output)
+    try:
+        from .tracking.base import NullTracker
+        from .training.trainer import Trainer
+
+        initialize_registries()
+        trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+        metrics = trainer.evaluate(resume_from=args.from_spec)
+        if metrics is None:
+            _emit_error("data module has no validation split to evaluate")
+            return EXIT_TRAIN_FAILURE
+        if args.json:
+            print(json.dumps({"checkpoint": args.from_spec, "metrics": metrics}))
+        else:
+            rendered = "  ".join(f"{k}={v:.6f}" for k, v in sorted(metrics.items()))
+            print(rendered)
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"evaluation failed: {exc}")
+        return EXIT_TRAIN_FAILURE
 
 
 def _handle_generate(args: argparse.Namespace) -> int:
@@ -573,6 +625,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_train(args)
     if args.command == "generate":
         return _handle_generate(args)
+    if args.command == "eval":
+        return _handle_eval(args)
     if args.command == "train-tokenizer":
         return _handle_train_tokenizer(args)
     if args.command == "validate":

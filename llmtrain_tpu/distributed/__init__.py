@@ -134,7 +134,7 @@ def configure_platform(device: str) -> None:
         get_logger().warning("could not pin jax platform to cpu: %s", exc)
 
 
-def configure_compilation_cache(cache_dir: str | None = None) -> None:
+def configure_compilation_cache() -> None:
     """Enable JAX's persistent compilation cache (new capability; the
     reference has no compiled artifacts to cache).
 
@@ -150,15 +150,18 @@ def configure_compilation_cache(cache_dir: str | None = None) -> None:
         return
     if low in ("on", "1", "true", "yes"):
         env = ""  # boolean-ish enable: use the default dir, not a dir named "true"
-    path = cache_dir or env or os.path.join(
-        os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax"
-    )
+    path = env or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
+    try:
+        # Cache everything that took noticeable compile time; tiny programs
+        # aren't worth the disk round-trip. Set BEFORE the dir: the cache
+        # activates on the dir update, so a jax version missing this tuning
+        # knob degrades to its default threshold instead of no cache.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # unknown config on this jax version
+        get_logger().warning("compilation cache tuning unavailable: %s", exc)
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
-        # Cache everything that took noticeable compile time; tiny programs
-        # aren't worth the disk round-trip.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as exc:  # unknown config on old jax, unwritable dir, ...
         get_logger().warning("compilation cache disabled: %s", exc)
 

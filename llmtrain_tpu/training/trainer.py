@@ -297,6 +297,23 @@ class Trainer:
             self._dataset_specs[id(dataset)] = cached
         return cached
 
+    def evaluate(self, resume_from: str | None = None) -> dict[str, float] | None:
+        """Eval-only pass: restore ``resume_from`` (if given) and run the
+        full validation loop once, without training.
+
+        New capability over the reference (eval there only happens inside
+        the train loop, reference trainer.py:243-289). Returns the same
+        metric dict the in-loop eval logs (``val/loss`` + per-shard keys),
+        or None when the data module has no validation split. The step
+        reported in logs is the restored checkpoint's step (0 for a fresh
+        init).
+        """
+        step = 0
+        if resume_from is not None:
+            step = self._restore(resume_from)
+        with self._mesh, nn.logical_axis_rules(self._rules):
+            return self._evaluate(step, step)
+
     # ------------------------------------------------------------------ fit
 
     def fit(

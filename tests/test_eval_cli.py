@@ -1,0 +1,144 @@
+"""Eval-only path: Trainer.evaluate + the ``eval`` CLI subcommand.
+
+New capability over the reference (eval there exists only inside the
+train loop, reference trainer.py:243-289). The key invariant: evaluating
+a saved checkpoint standalone reproduces the val loss the training run
+reported at that step.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from llmtrain_tpu.config.schemas import RunConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.tracking.base import NullTracker
+from llmtrain_tpu.training.trainer import Trainer
+
+
+def _cfg(tmp_path, **overrides):
+    base = {
+        "run": {"name": "eval-cli", "seed": 0, "device": "cpu"},
+        "model": {
+            "name": "gpt",
+            "block_size": 8,
+            "d_model": 16,
+            "n_layers": 1,
+            "n_heads": 4,
+            "d_ff": 32,
+            "dropout": 0.0,
+            "vocab_size": 64,
+            "extra": {"tokenizer": "byte"},
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 6,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 1,
+            "warmup_steps": 0,
+            "log_every_steps": 3,
+            "eval_every_steps": 6,
+            "save_every_steps": 6,
+        },
+        "mlflow": {"enabled": False},
+        "output": {"root_dir": str(tmp_path / "runs")},
+    }
+    base.update(overrides)
+    return RunConfig.model_validate(base)
+
+
+class TestTrainerEvaluate:
+    def test_standalone_eval_matches_training_eval(self, tmp_path):
+        """fit() saves at step 6 and reports final_val_loss; a fresh Trainer
+        restoring that checkpoint must reproduce it exactly."""
+        initialize_registries()
+        cfg = _cfg(tmp_path)
+        run_dir = tmp_path / "runs" / "r1"
+        (run_dir / "checkpoints").mkdir(parents=True)
+        trainer = Trainer(cfg, run_dir=run_dir, tracker=NullTracker())
+        result = trainer.fit()
+        assert result.final_val_loss is not None
+
+        fresh = Trainer(cfg, run_dir=None, tracker=NullTracker())
+        metrics = fresh.evaluate(resume_from=str(run_dir / "checkpoints"))
+        assert metrics is not None
+        assert abs(metrics["val/loss"] - result.final_val_loss) < 1e-6
+
+    def test_fresh_init_eval_runs(self, tmp_path):
+        initialize_registries()
+        trainer = Trainer(_cfg(tmp_path), run_dir=None, tracker=NullTracker())
+        metrics = trainer.evaluate()
+        assert metrics is not None and metrics["val/loss"] > 0
+
+
+class TestEvalCLI:
+    def _write_cfg(self, tmp_path) -> str:
+        import yaml
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(_cfg(tmp_path).model_dump(mode="json"), sort_keys=False)
+        )
+        return str(cfg_path)
+
+    def _run(self, *argv, timeout=300):
+        return subprocess.run(
+            [sys.executable, "-m", "llmtrain_tpu", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+
+    def test_eval_checkpoint_roundtrip(self, tmp_path):
+        cfg_path = self._write_cfg(tmp_path)
+        train = self._run(
+            "train", "--config", cfg_path, "--run-id", "evalrun", "--json"
+        )
+        assert train.returncode == 0, train.stderr
+        trained_val = json.loads(train.stdout)["train_result"]["final_val_loss"]
+
+        ev = self._run(
+            "eval", "--config", cfg_path, "--from", "evalrun", "--json"
+        )
+        assert ev.returncode == 0, ev.stderr
+        payload = json.loads(ev.stdout)
+        assert abs(payload["metrics"]["val/loss"] - trained_val) < 1e-6
+
+    def test_eval_without_checkpoint(self, tmp_path):
+        cfg_path = self._write_cfg(tmp_path)
+        ev = self._run("eval", "--config", cfg_path, "--json")
+        assert ev.returncode == 0, ev.stderr
+        assert json.loads(ev.stdout)["metrics"]["val/loss"] > 0
+
+    def test_bad_config_exit_2(self, tmp_path):
+        missing = tmp_path / "nope.yaml"
+        ev = self._run("eval", "--config", str(missing))
+        assert ev.returncode == 2
+
+    def test_bad_checkpoint_exit_1(self, tmp_path):
+        cfg_path = self._write_cfg(tmp_path)
+        ev = self._run("eval", "--config", cfg_path, "--from", "no-such-run")
+        assert ev.returncode == 1
+
+
+@pytest.mark.parametrize("data_name", ["local_text"])
+def test_eval_no_val_split_errors(tmp_path, data_name):
+    """A data module configured without a validation split is a loud error,
+    not a silent success."""
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("hello world " * 500)
+    cfg = _cfg(
+        tmp_path,
+        data={
+            "name": data_name,
+            "cache_dir": str(tmp_path / "cache"),
+            "extra": {"globs": [str(corpus)], "val_fraction": 0.0},
+        },
+    )
+    initialize_registries()
+    trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+    assert trainer.evaluate() is None
