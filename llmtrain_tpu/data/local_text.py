@@ -13,6 +13,8 @@ Config::
       extra:
         globs: ["/usr/local/lib/python3.12/**/*.py"]
         val_fraction: 0.01   # tail of the token stream held out for eval
+        format: "text"       # or "jsonl": one JSON object per line,
+        text_key: "text"     #   text under this key (jsonl only)
 
 Train/val are a deterministic head/tail split of the single token stream
 (files sorted lexicographically), so the split is stable across runs and
@@ -54,13 +56,16 @@ class LocalTextDataModule(DataModule):
         val_fraction = float(cfg.data.extra.get("val_fraction", _DEFAULT_VAL_FRACTION))
         if not 0.0 <= val_fraction < 1.0:
             raise ValueError(f"val_fraction must be in [0, 1), got {val_fraction}")
+        fmt = cfg.data.extra.get("format", "text")
+        if fmt not in ("text", "jsonl"):
+            raise ValueError(f"local_text format must be 'text' or 'jsonl', got {fmt!r}")
 
         files = sorted({f for pattern in globs for f in glob.glob(pattern, recursive=True)})
         files = [f for f in files if Path(f).is_file()]
         if not files:
             raise ValueError(f"local_text globs matched no files: {globs}")
 
-        tokens = self._load_or_build_cache(cfg, files, tokenizer)
+        tokens = self._load_or_build_cache(cfg, files, tokenizer, fmt=fmt)
         n_val = int(len(tokens) * val_fraction)
         train_tokens, val_tokens = tokens[: len(tokens) - n_val], tokens[len(tokens) - n_val :]
 
@@ -74,12 +79,17 @@ class LocalTextDataModule(DataModule):
         self._val = val_ds if len(val_ds) > 0 else None
 
     def _load_or_build_cache(
-        self, cfg: RunConfig, files: list[str], tokenizer: Any
+        self, cfg: RunConfig, files: list[str], tokenizer: Any, *, fmt: str = "text"
     ) -> np.ndarray:
+        text_key = str(cfg.data.extra.get("text_key", "text"))
         # Key by file list + size + mtime (size alone misses equal-length
-        # edits) + tokenizer identity — token ids from a different
-        # tokenizer would silently corrupt training (hf_text's cache rule).
+        # edits) + parse mode + tokenizer identity — token ids from a
+        # different tokenizer would silently corrupt training (hf_text's
+        # cache rule).
         h = hashlib.sha256()
+        # text_key only matters in jsonl mode; hashing it in text mode would
+        # invalidate the cache on an irrelevant config change.
+        h.update(f"{fmt}:{text_key if fmt == 'jsonl' else ''};".encode())
         for f in files:
             st = Path(f).stat()
             h.update(f.encode())
@@ -96,7 +106,8 @@ class LocalTextDataModule(DataModule):
         encode_np = getattr(tokenizer, "encode_np", None)
         pieces: list[np.ndarray] = []
         for f in files:
-            text = Path(f).read_text(encoding="utf-8", errors="ignore")
+            raw = Path(f).read_text(encoding="utf-8", errors="ignore")
+            text = self._extract_text(f, raw, fmt, text_key)
             if not text:
                 continue
             if encode_np is not None:
@@ -121,6 +132,36 @@ class LocalTextDataModule(DataModule):
         np.save(tmp, tokens)
         tmp.replace(cache_path)
         return tokens
+
+    @staticmethod
+    def _extract_text(path: str, raw: str, fmt: str, text_key: str) -> str:
+        """Raw file content → training text. "jsonl" parses one JSON object
+        per line and concatenates the ``text_key`` field of each, separated
+        by blank lines (same document-boundary convention as text mode)."""
+        if fmt == "text":
+            return raw
+        import json
+
+        docs: list[str] = []
+        # split("\n"), not splitlines(): the latter also splits on U+2028/
+        # U+2029/U+0085, which are legal unescaped inside JSON strings
+        # (ensure_ascii=False corpora), and would shear valid objects apart.
+        for lineno, line in enumerate(raw.split("\n"), start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: invalid JSON line: {exc}") from exc
+            val = obj.get(text_key) if isinstance(obj, dict) else None
+            if not isinstance(val, str):
+                raise ValueError(
+                    f"{path}:{lineno}: expected a string field {text_key!r} "
+                    f"in each JSONL object"
+                )
+            docs.append(val)
+        return "\n\n".join(docs)
 
     def train_dataset(self) -> IndexedDataset:
         if self._train is None:

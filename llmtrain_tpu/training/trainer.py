@@ -302,11 +302,11 @@ class Trainer:
         full validation loop once, without training.
 
         New capability over the reference (eval there only happens inside
-        the train loop, reference trainer.py:243-289). Returns the same
-        metric dict the in-loop eval logs (``val/loss`` + per-shard keys),
-        or None when the data module has no validation split. The step
-        reported in logs is the restored checkpoint's step (0 for a fresh
-        init).
+        the train loop, reference trainer.py:243-289). Returns
+        ``{"val/loss": ...}`` (per-shard ``*_rank_{r}`` values go to the
+        tracker, as in the train loop), or None when the data module has
+        no validation split. The step reported in logs is the restored
+        checkpoint's step (0 for a fresh init).
         """
         step = 0
         if resume_from is not None:
