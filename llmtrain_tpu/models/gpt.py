@@ -521,28 +521,9 @@ class GPTAdapter(ModelAdapter):
         )
 
     @staticmethod
-    def _chunked_loss_components(
-        model: nn.Module,
-        params: Params,
-        batch: Batch,
-        *,
-        rngs: dict[str, jax.Array] | None,
-        deterministic: bool,
-    ) -> tuple[jax.Array, jax.Array]:
-        """Same loss as the dense path, streamed over vocab chunks
-        (ops/chunked_ce.py) so [B,T,V] never materializes."""
-        from ..models.base import validate_lm_batch
-        from ..ops.chunked_ce import chunked_ce_components
-
-        input_ids, labels, attention_mask = validate_lm_batch(batch)
-        hidden = model.apply(
-            {"params": params},
-            input_ids,
-            attention_mask=attention_mask,
-            deterministic=deterministic,
-            rngs=rngs,
-            return_hidden=True,
-        )
+    def vocab_matrix(model: nn.Module, params: Params) -> jax.Array:
+        """The (V, d) output-projection matrix, for losses that contract
+        hidden states against it directly (ops/chunked_ce.py)."""
         if model.tie_embeddings:
             w_vocab = params["token_embedding"]["embedding"]
         else:
@@ -553,8 +534,55 @@ class GPTAdapter(ModelAdapter):
         w_vocab = nn.meta.unbox(w_vocab)
         if not model.tie_embeddings:
             w_vocab = w_vocab.T  # (d, V) -> (V, d)
+        return w_vocab
+
+    @classmethod
+    def chunked_components_from_hidden(
+        cls,
+        model: nn.Module,
+        params: Params,
+        hidden: jax.Array,
+        labels: jax.Array,
+        attention_mask: jax.Array | None,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Streamed-CE components from already-computed hidden states —
+        the single wiring point for every adapter's chunked path (gpt_moe
+        reuses it after its mutable-collection apply)."""
+        from ..ops.chunked_ce import chunked_ce_components
+
         return chunked_ce_components(
-            hidden, w_vocab, labels, attention_mask, chunk=model.ce_chunk
+            hidden,
+            cls.vocab_matrix(model, params),
+            labels,
+            attention_mask,
+            chunk=model.ce_chunk,
+        )
+
+    @classmethod
+    def _chunked_loss_components(
+        cls,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None,
+        deterministic: bool,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Same loss as the dense path, streamed over vocab chunks
+        (ops/chunked_ce.py) so [B,T,V] never materializes."""
+        from ..models.base import validate_lm_batch
+
+        input_ids, labels, attention_mask = validate_lm_batch(batch)
+        hidden = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask=attention_mask,
+            deterministic=deterministic,
+            rngs=rngs,
+            return_hidden=True,
+        )
+        return cls.chunked_components_from_hidden(
+            model, params, hidden, labels, attention_mask
         )
 
 

@@ -44,14 +44,6 @@ class GPTMoEAdapter(GPTAdapter):
                 "gpt_moe requires model.extra.n_experts >= 2 "
                 f"(got {n_experts}); use model.name 'gpt' for a dense MLP"
             )
-        if extra.get("loss_impl", "dense") != "dense":
-            # This adapter's loss path adds the router aux objective on top
-            # of the dense CE; accepting the knob while running dense would
-            # silently lie about memory behavior.
-            raise ValueError(
-                "gpt_moe does not support model.extra.loss_impl "
-                f"{extra['loss_impl']!r}; only 'dense' is implemented"
-            )
         base = super().build_model(cfg)
         return base.clone(
             n_experts=n_experts,
@@ -70,15 +62,24 @@ class GPTMoEAdapter(GPTAdapter):
         deterministic: bool = True,
     ) -> tuple[jax.Array, jax.Array]:
         input_ids, labels, attention_mask = validate_lm_batch(batch)
-        logits, mutated = model.apply(
+        chunked = getattr(model, "loss_impl", "dense") == "chunked_ce"
+        out, mutated = model.apply(
             {"params": params},
             input_ids,
             attention_mask=attention_mask,
             deterministic=deterministic,
             rngs=rngs,
             mutable=["losses"],
+            return_hidden=chunked,
         )
-        loss_sum, tokens = masked_ce_components(logits, labels, attention_mask)
+        if chunked:
+            # Streamed CE over vocab chunks (ops/chunked_ce.py): `out` is
+            # the post-ln_f hidden states, never [B,T,V].
+            loss_sum, tokens = GPTAdapter.chunked_components_from_hidden(
+                model, params, out, labels, attention_mask
+            )
+        else:
+            loss_sum, tokens = masked_ce_components(out, labels, attention_mask)
         aux = sum(jax.tree.leaves(mutated.get("losses", {})))
         # Fold aux in proportionally to tokens: the trainer's
         # sum(loss_sum)/sum(tokens) then equals CE + aux exactly.

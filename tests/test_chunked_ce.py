@@ -219,12 +219,53 @@ class TestKnobValidation:
         with pytest.raises(ValueError, match="loss_impl"):
             GPTAdapter().build_model(self._cfg("gpt", {"loss_impl": "chunked"}))
 
-    def test_gpt_moe_rejects_chunked_ce(self):
+    def test_gpt_moe_chunked_matches_dense(self):
+        """MoE composes with chunked CE: same CE + router-aux loss and
+        gradients as the dense path."""
         from llmtrain_tpu.models.gpt_moe import GPTMoEAdapter
 
-        with pytest.raises(ValueError, match="gpt_moe does not support"):
-            GPTMoEAdapter().build_model(
-                self._cfg("gpt_moe", {"n_experts": 4, "loss_impl": "chunked_ce"})
+        adapter = GPTMoEAdapter()
+        rng = np.random.default_rng(23)
+        batch = {
+            "input_ids": jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32),
+            "labels": jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32),
+            "attention_mask": jnp.ones((2, 8), jnp.int32),
+        }
+
+        def build(loss_impl):
+            cfg = self._cfg(
+                "gpt_moe",
+                {"n_experts": 4, "capacity_factor": 2.0, "loss_impl": loss_impl,
+                 "ce_chunk": 32},
+            )
+            model = adapter.build_model(cfg)
+            params = nn_meta.unbox(
+                model.init(jax.random.key(0), batch["input_ids"], deterministic=True)
+            )["params"]
+            return model, params
+
+        dense_model, params = build("dense")
+        chunk_model, _ = build("chunked_ce")
+
+        def loss_with(model):
+            def f(p):
+                s, t = adapter.compute_loss_components(model, p, batch)
+                return jnp.sum(s) / jnp.sum(t)
+
+            return f
+
+        ld, gd = jax.value_and_grad(loss_with(dense_model))(params)
+        lc, gc = jax.value_and_grad(loss_with(chunk_model))(params)
+        np.testing.assert_allclose(float(lc), float(ld), atol=1e-5, rtol=1e-5)
+        for (pd, vd), (pc, vc) in zip(
+            jax.tree_util.tree_leaves_with_path(gd),
+            jax.tree_util.tree_leaves_with_path(gc),
+            strict=True,
+        ):
+            assert pd == pc
+            np.testing.assert_allclose(
+                np.asarray(vd), np.asarray(vc), atol=2e-5, rtol=1e-3,
+                err_msg=jax.tree_util.keystr(pd),
             )
 
 

@@ -308,7 +308,7 @@ class TestLocalTextJsonl:
     """local_text format: jsonl — one JSON object per line, text under
     data.extra.text_key (new capability; text mode is the default)."""
 
-    def _cfg(self, tmp_path, corpus, **extra):
+    def _cfg(self, tmp_path, corpus, block_size=8, **extra):
         from llmtrain_tpu.config.schemas import RunConfig
 
         return RunConfig.model_validate(
@@ -316,7 +316,7 @@ class TestLocalTextJsonl:
                 "run": {"name": "jsonl", "seed": 0, "device": "cpu"},
                 "model": {
                     "name": "gpt",
-                    "block_size": 8,
+                    "block_size": block_size,
                     "d_model": 16,
                     "n_layers": 1,
                     "n_heads": 4,
@@ -351,20 +351,23 @@ class TestLocalTextJsonl:
         import json as _json
 
         corpus = tmp_path / "c.jsonl"
-        docs = ["first document " * 4, "second one " * 6, "third " * 9]
+        docs = ["first document " * 14, "second one " * 20, "third " * 35]
         corpus.write_text(
             "\n".join(_json.dumps({"text": d, "meta": 1}) for d in docs) + "\n"
         )
-        dm = self._setup(self._cfg(tmp_path, corpus))
+        # block_size 256 makes window 0 span the doc0/doc1 boundary, so the
+        # comparison pins the blank-line join convention, not just doc0.
+        dm = self._setup(self._cfg(tmp_path, corpus, block_size=256))
         ds = dm.train_dataset()
-        assert len(ds) > 0
+        assert len(ds) >= 2
         # The stream must be exactly the byte-encoding of the
         # blank-line-joined field values (JSON braces/quotes/meta stripped).
         expected = np.frombuffer(
             "\n\n".join(docs).encode("utf-8"), dtype=np.uint8
         ).astype(np.int32)
-        got = ds.get_examples(np.arange(1))["input_ids"][0]
-        np.testing.assert_array_equal(got, expected[: got.shape[0]])
+        for w in range(len(ds)):
+            got = ds.get_examples(np.asarray([w]))["input_ids"][0]
+            np.testing.assert_array_equal(got, expected[w * 257 : w * 257 + 256])
 
     def test_text_key_override(self, tmp_path):
         import json as _json
