@@ -75,7 +75,7 @@ class GPTMoEAdapter(GPTAdapter):
         if chunked:
             # Streamed CE over vocab chunks (ops/chunked_ce.py): `out` is
             # the post-ln_f hidden states, never [B,T,V].
-            loss_sum, tokens = GPTAdapter.chunked_components_from_hidden(
+            loss_sum, tokens = self.chunked_components_from_hidden(
                 model, params, out, labels, attention_mask
             )
         else:

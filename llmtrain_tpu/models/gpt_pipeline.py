@@ -353,6 +353,14 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"gpt_pipeline supports attention 'dense' or 'flash', "
                 f"got {cfg.model.attention!r}"
             )
+        if cfg.model.extra.get("loss_impl", "dense") != "dense":
+            # Accepting the knob while running dense would silently lie
+            # about memory behavior (the chunked path needs the hidden
+            # states outside the stage shard_map; not wired for v1).
+            raise ValueError(
+                "gpt_pipeline does not support model.extra.loss_impl "
+                f"{cfg.model.extra['loss_impl']!r}; only 'dense' is implemented"
+            )
         return PipelineGPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,

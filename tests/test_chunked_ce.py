@@ -332,3 +332,12 @@ class TestShardedMesh:
         chunked = run("chunked_ce")
         assert abs(dense[0] - chunked[0]) < 1e-5
         assert abs(dense[1] - chunked[1]) < 1e-5
+
+
+def test_gpt_pipeline_rejects_chunked_ce():
+    """Unsupported family must fail loudly, not silently run dense."""
+    from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+    cfg = TestKnobValidation()._cfg("gpt_pipeline", {"loss_impl": "chunked_ce"})
+    with pytest.raises(ValueError, match="gpt_pipeline does not support"):
+        PipelineGPTAdapter().build_model(cfg)
