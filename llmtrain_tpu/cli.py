@@ -97,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="comma-separated token ids, bypassing the tokenizer",
     )
+    prompt_group.add_argument(
+        "--prompts-file",
+        default=None,
+        help="file with one prompt per line (blank lines skipped); prompts "
+        "are batched per token length for the compiled decode loop",
+    )
     gen.add_argument("--max-new-tokens", type=int, default=48)
     gen.add_argument(
         "--temperature", type=float, default=0.8, help="0 decodes greedily"
@@ -374,6 +380,21 @@ def _handle_generate(args: argparse.Namespace) -> int:
     configure_compilation_cache()
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
+
+    # Fail fast on a bad prompts file — before the expensive registry/
+    # tokenizer/model build, and with a clean error instead of a traceback.
+    file_prompts: list[str] | None = None
+    if args.prompts_file is not None:
+        try:
+            lines = Path(args.prompts_file).read_text(encoding="utf-8").splitlines()
+        except OSError as exc:
+            _emit_error(f"cannot read --prompts-file: {exc}")
+            return EXIT_TRAIN_FAILURE
+        file_prompts = [ln for ln in lines if ln.strip()]
+        if not file_prompts:
+            _emit_error(f"{args.prompts_file}: no non-empty prompt lines")
+            return EXIT_TRAIN_FAILURE
+
     try:
         import jax
         import numpy as np
@@ -405,20 +426,27 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 return EXIT_TRAIN_FAILURE
             raise
 
+        prompts: list[str] | None = None  # text prompts (file mode keeps all)
         if args.prompt_ids is not None:
-            prompt_ids = np.asarray(
-                [int(t) for t in args.prompt_ids.split(",") if t.strip()],
-                dtype=np.int32,
-            )
+            prompt_batches = [
+                np.asarray(
+                    [int(t) for t in args.prompt_ids.split(",") if t.strip()],
+                    dtype=np.int32,
+                )
+            ]
         else:
             if tokenizer is None:
                 _emit_error(
-                    "no tokenizer available for --prompt; pass --prompt-ids instead"
+                    "no tokenizer available for --prompt/--prompts-file; "
+                    "pass --prompt-ids instead"
                 )
                 return EXIT_TRAIN_FAILURE
-            prompt_ids = np.asarray(tokenizer.encode(args.prompt), dtype=np.int32)
-        if prompt_ids.size == 0:
-            _emit_error("prompt must contain at least one token")
+            prompts = file_prompts if file_prompts is not None else [args.prompt]
+            prompt_batches = [
+                np.asarray(tokenizer.encode(p), dtype=np.int32) for p in prompts
+            ]
+        if any(ids.size == 0 for ids in prompt_batches):
+            _emit_error("every prompt must contain at least one token")
             return EXIT_TRAIN_FAILURE
 
         ckpt_path = resolve_resume_path(args.from_spec, cfg.output.root_dir)
@@ -438,35 +466,55 @@ def _handle_generate(args: argparse.Namespace) -> int:
         if eos_token_id is None and tokenizer is not None:
             # tiktoken encodings expose the end-of-text id as eot_token.
             eos_token_id = getattr(tokenizer, "eot_token", None)
-        out = generate(
-            model,
-            params,
-            prompt_ids,
-            max_new_tokens=args.max_new_tokens,
-            rng=jax.random.key(args.seed),
-            temperature=args.temperature,
-            top_k=args.top_k,  # generate() maps <=0 to "disabled"
-            eos_token_id=eos_token_id,
-        )
-        output_ids = [int(t) for t in out[0]]
-        completion_ids = output_ids[len(prompt_ids) :]  # newly generated only
-        text = tokenizer.decode(output_ids) if tokenizer is not None else None
+
+        # Batch per prompt length: generate() takes a rectangular (B, Tp)
+        # batch, so equal-length prompts share ONE compiled decode loop.
+        by_len: dict[int, list[int]] = {}
+        for i, ids in enumerate(prompt_batches):
+            by_len.setdefault(len(ids), []).append(i)
+        results: list[dict] = [{} for _ in prompt_batches]
+        for tp, idxs in sorted(by_len.items()):
+            stacked = np.stack([prompt_batches[i] for i in idxs])
+            out = generate(
+                model,
+                params,
+                stacked,
+                max_new_tokens=args.max_new_tokens,
+                rng=jax.random.key(args.seed),
+                temperature=args.temperature,
+                top_k=args.top_k,  # generate() maps <=0 to "disabled"
+                eos_token_id=eos_token_id,
+            )
+            for row, i in enumerate(idxs):
+                output_ids = [int(t) for t in out[row]]
+                results[i] = {
+                    "prompt_ids": [int(t) for t in prompt_batches[i]],
+                    "completion_ids": output_ids[tp:],
+                    "output_ids": output_ids,
+                    "text": (
+                        tokenizer.decode(output_ids) if tokenizer is not None else None
+                    ),
+                }
+                if prompts is not None:
+                    results[i]["prompt"] = prompts[i]
 
         if args.json:
-            print(
-                json.dumps(
-                    {
-                        "checkpoint": str(ckpt_path),
-                        "step": step,
-                        "prompt_ids": [int(t) for t in prompt_ids],
-                        "completion_ids": completion_ids,
-                        "output_ids": output_ids,
-                        "text": text,
-                    }
-                )
-            )
+            payload: dict[str, Any] = {"checkpoint": str(ckpt_path), "step": step}
+            if args.prompts_file is not None:
+                # File mode ALWAYS emits "results" (even for one line) so
+                # consumers get a stable schema per input mode.
+                payload["results"] = results
+            else:
+                payload.update(results[0])  # single-prompt contract unchanged
+            print(json.dumps(payload))
         else:
-            print(text if text is not None else " ".join(str(t) for t in output_ids))
+            rendered = [
+                r["text"]
+                if r["text"] is not None
+                else " ".join(str(t) for t in r["output_ids"])
+                for r in results
+            ]
+            print("\n\n---\n\n".join(rendered))
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         logger.exception("generation failed: %s", exc)
         _emit_error(f"generation failed: {exc}")
