@@ -123,6 +123,11 @@ class PipelineGPT(nn.Module):
     # holds this many non-contiguous layer chunks and microbatches make
     # that many passes around the stage ring — bubble (S-1)/(v*M+S-1).
     n_virtual_chunks: int = 1
+    # "chunked_ce" streams the LM loss over vocab chunks (ops/chunked_ce.py).
+    # Works here because the lm_head applies OUTSIDE the stage shard_map,
+    # on the gathered final hidden states.
+    loss_impl: str = "dense"
+    ce_chunk: int = 8192
 
     def _stacked(
         self, name: str, shape: tuple[int, ...], init, axes: tuple[str, ...]
@@ -145,6 +150,7 @@ class PipelineGPT(nn.Module):
         attention_mask: jax.Array | None = None,
         *,
         deterministic: bool = True,
+        return_hidden: bool = False,
     ) -> jax.Array:
         del deterministic  # no dropout inside pipelined blocks (v1)
         # Packed-sequence contract (same as the gpt flash path): the mask
@@ -312,6 +318,13 @@ class PipelineGPT(nn.Module):
         )
         x = _layernorm(x, ln_f_scale, ln_f_bias)
 
+        if return_hidden:
+            # Chunked-CE path: the loss contracts these against the vocab
+            # matrix itself (GPTAdapter.chunked_components_from_hidden);
+            # skipping the lm_head keeps [B,T,V] out of HBM. Init must run
+            # with return_hidden=False so an untied lm_head still exists.
+            return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
         if self.tie_embeddings:
             logits = token_embedding.attend(x)
         else:
@@ -330,9 +343,11 @@ class PipelineGPT(nn.Module):
 class PipelineGPTAdapter(ModelAdapter):
     """Adapter for the pipeline-parallel GPT.
 
-    ``model.extra`` knobs: ``tokenizer`` ("gpt2"/"byte", as for gpt) and
-    ``pipeline_microbatches`` (default 4; per-data-shard batch must divide
-    by it when pipeline > 1).
+    ``model.extra`` knobs: ``tokenizer`` ("gpt2"/"byte"/"bpe:<path>", as
+    for gpt), ``pipeline_microbatches`` (default 4; per-data-shard batch
+    must divide by it when pipeline > 1), ``pipeline_virtual_chunks``
+    (interleaved schedule), and ``loss_impl``/``ce_chunk`` (chunked
+    cross-entropy, as for gpt).
     """
 
     supports_pipeline = True
@@ -353,13 +368,11 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"gpt_pipeline supports attention 'dense' or 'flash', "
                 f"got {cfg.model.attention!r}"
             )
-        if cfg.model.extra.get("loss_impl", "dense") != "dense":
-            # Accepting the knob while running dense would silently lie
-            # about memory behavior (the chunked path needs the hidden
-            # states outside the stage shard_map; not wired for v1).
+        loss_impl = cfg.model.extra.get("loss_impl", "dense")
+        if loss_impl not in ("dense", "chunked_ce"):
             raise ValueError(
-                "gpt_pipeline does not support model.extra.loss_impl "
-                f"{cfg.model.extra['loss_impl']!r}; only 'dense' is implemented"
+                f"model.extra.loss_impl {loss_impl!r} unknown; "
+                "expected 'dense' or 'chunked_ce'"
             )
         return PipelineGPT(
             vocab_size=vocab_size,
@@ -375,6 +388,8 @@ class PipelineGPTAdapter(ModelAdapter):
             n_microbatches=self._positive_extra(cfg, "pipeline_microbatches", 4),
             remat=cfg.model.remat,
             n_virtual_chunks=self._positive_extra(cfg, "pipeline_virtual_chunks", 1),
+            loss_impl=loss_impl,
+            ce_chunk=self._positive_extra(cfg, "ce_chunk", 8192),
         )
 
     @staticmethod
@@ -398,6 +413,15 @@ class PipelineGPTAdapter(ModelAdapter):
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
     ) -> tuple[jax.Array, jax.Array]:
+        if getattr(model, "loss_impl", "dense") == "chunked_ce":
+            from .gpt import GPTAdapter
+
+            # Shared wiring point: nothing in the chunked path is
+            # GPT-module-specific (apply(return_hidden=True) + contract
+            # against the vocab matrix).
+            return GPTAdapter._chunked_loss_components(
+                model, params, batch, rngs=rngs, deterministic=deterministic
+            )
         return lm_loss_components(
             model, params, batch, rngs=rngs, deterministic=deterministic
         )

@@ -206,6 +206,7 @@ class TestKnobValidation:
                     "n_layers": 1,
                     "n_heads": 4,
                     "d_ff": 32,
+                    "dropout": 0.0,
                     "vocab_size": 64,
                     "extra": {"tokenizer": "byte", **extra},
                 },
@@ -334,10 +335,81 @@ class TestShardedMesh:
         assert abs(dense[1] - chunked[1]) < 1e-5
 
 
-def test_gpt_pipeline_rejects_chunked_ce():
-    """Unsupported family must fail loudly, not silently run dense."""
+def test_gpt_pipeline_rejects_unknown_loss_impl():
+    """Unknown values fail loudly, not silently run dense."""
     from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
 
-    cfg = TestKnobValidation()._cfg("gpt_pipeline", {"loss_impl": "chunked_ce"})
-    with pytest.raises(ValueError, match="gpt_pipeline does not support"):
+    cfg = TestKnobValidation()._cfg("gpt_pipeline", {"loss_impl": "chunked"})
+    with pytest.raises(ValueError, match="loss_impl"):
         PipelineGPTAdapter().build_model(cfg)
+
+
+class TestPipelineChunked:
+    """gpt_pipeline composes with chunked CE: the lm_head applies outside
+    the stage shard_map, so the streamed loss drops in like for gpt."""
+
+    def _run(self, loss_impl, mesh):
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        initialize_registries()
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "pipe-cce", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "gpt_pipeline",
+                    "block_size": 8,
+                    "d_model": 32,
+                    "n_layers": 4,
+                    "n_heads": 4,
+                    "d_ff": 64,
+                    "dropout": 0.0,
+                    "vocab_size": 64,
+                    "extra": {
+                        "tokenizer": "byte",
+                        "pipeline_microbatches": 2,
+                        "loss_impl": loss_impl,
+                        "ce_chunk": 32,
+                    },
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": 3,
+                    "micro_batch_size": 4,
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 0,
+                    "log_every_steps": 1,
+                    "eval_every_steps": 3,
+                    "save_every_steps": 3,
+                },
+                "distributed": {"mesh": mesh},
+                "mlflow": {"enabled": False},
+            }
+        )
+        trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+        result = trainer.fit()
+        return result.final_loss
+
+    def test_matches_dense_data_parallel_mesh(self):
+        mesh = {"data": -1}  # all 8 virtual devices, no pipeline
+        assert abs(self._run("dense", mesh) - self._run("chunked_ce", mesh)) < 1e-5
+
+    def test_matches_dense_on_pipeline_mesh(self):
+        mesh = {"pipeline": 2, "data": -1}  # 2 stages x 4 data shards
+        assert abs(self._run("dense", mesh) - self._run("chunked_ce", mesh)) < 1e-5
+
+
+def test_ce_chunk_must_be_positive():
+    tk = TestKnobValidation()
+    with pytest.raises(ValueError, match="ce_chunk"):
+        GPTAdapter().build_model(
+            tk._cfg("gpt", {"loss_impl": "chunked_ce", "ce_chunk": 0})
+        )
+    from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+    with pytest.raises(ValueError, match="ce_chunk"):
+        PipelineGPTAdapter().build_model(
+            tk._cfg("gpt_pipeline", {"loss_impl": "chunked_ce", "ce_chunk": -8})
+        )
