@@ -152,6 +152,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     traintok.add_argument("--json", action="store_true", help="emit stats as JSON")
 
+    export = sub.add_parser(
+        "export-checkpoint",
+        help="export a checkpoint's GPT weights as a torch state dict",
+    )
+    export.add_argument("--config", required=True, help="path to the YAML run config")
+    export.add_argument(
+        "--from",
+        dest="from_spec",
+        required=True,
+        help="checkpoint file, checkpoint dir, or run id to export",
+    )
+    export.add_argument("--output", required=True, help="output .pt path")
+    export.add_argument("--json", action="store_true", help="emit stats as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -198,6 +212,82 @@ def _handle_print_config(args: argparse.Namespace) -> int:
 
         print(yaml.safe_dump(resolved, sort_keys=False), end="")
     return EXIT_OK
+
+
+def _load_checkpoint_params(cfg, adapter, model, from_spec: str):
+    """Shared inference-checkpoint load (generate / export-checkpoint):
+    resolve the spec, restore params against the abstract shape tree, warn
+    on config mismatch. Returns ``(ckpt_path, params, step)``."""
+    import jax
+    import yaml
+    from flax.linen import meta as nn_meta
+
+    from .training.checkpoint import load_inference_params, resolve_resume_path
+
+    ckpt_path = resolve_resume_path(from_spec, cfg.output.root_dir)
+    abstract = nn_meta.unbox(
+        jax.eval_shape(
+            lambda rng: adapter.init_params(model, cfg, rng), jax.random.key(0)
+        )
+    )
+    params, step = load_inference_params(
+        ckpt_path,
+        abstract,
+        expected_config_yaml=yaml.safe_dump(cfg.model_dump(), sort_keys=False),
+    )
+    return ckpt_path, params, step
+
+
+def _handle_export_checkpoint(args: argparse.Namespace) -> int:
+    """Export GPT weights to a torch-layout state dict (interop/).
+
+    The layout transforms are the parity-proven ones
+    (tests/test_torch_parity.py); output loads into a reference-spec torch
+    GPT with `model.load_state_dict(torch.load(path))`.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    try:
+        import torch
+
+        from .interop import params_to_torch_state_dict
+        from .registry import get_model_adapter
+
+        initialize_registries()
+        adapter = get_model_adapter(cfg.model.name)()
+        model = adapter.build_model(cfg)
+        ckpt_path, params, step = _load_checkpoint_params(
+            cfg, adapter, model, args.from_spec
+        )
+        sd = {k: torch.from_numpy(v) for k, v in params_to_torch_state_dict(params).items()}
+        out = Path(args.output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        torch.save(sd, out)
+        n_params = int(sum(v.numel() for v in sd.values()))
+        stats = {
+            "checkpoint": str(ckpt_path),
+            "step": step,
+            "output": str(out),
+            "tensors": len(sd),
+            "parameters": n_params,
+        }
+        if args.json:
+            print(json.dumps(stats))
+        else:
+            print(
+                f"exported step-{step} checkpoint -> {out} "
+                f"({len(sd)} tensors, {n_params:,} parameters)"
+            )
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"export failed: {exc}")
+        return EXIT_TRAIN_FAILURE
 
 
 def _handle_train_tokenizer(args: argparse.Namespace) -> int:
@@ -398,11 +488,8 @@ def _handle_generate(args: argparse.Namespace) -> int:
     try:
         import jax
         import numpy as np
-        import yaml
-        from flax.linen import meta as nn_meta
 
         from .generation import generate
-        from .training.checkpoint import load_inference_params, resolve_resume_path
 
         initialize_registries()
         adapter = get_model_adapter(cfg.model.name)()
@@ -449,16 +536,8 @@ def _handle_generate(args: argparse.Namespace) -> int:
             _emit_error("every prompt must contain at least one token")
             return EXIT_TRAIN_FAILURE
 
-        ckpt_path = resolve_resume_path(args.from_spec, cfg.output.root_dir)
-        abstract = nn_meta.unbox(
-            jax.eval_shape(
-                lambda rng: adapter.init_params(model, cfg, rng), jax.random.key(0)
-            )
-        )
-        params, step = load_inference_params(
-            ckpt_path,
-            abstract,
-            expected_config_yaml=yaml.safe_dump(cfg.model_dump(), sort_keys=False),
+        ckpt_path, params, step = _load_checkpoint_params(
+            cfg, adapter, model, args.from_spec
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
 
@@ -677,6 +756,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_eval(args)
     if args.command == "train-tokenizer":
         return _handle_train_tokenizer(args)
+    if args.command == "export-checkpoint":
+        return _handle_export_checkpoint(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

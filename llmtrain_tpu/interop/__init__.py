@@ -1,0 +1,8 @@
+"""Cross-framework interop (torch checkpoint export/import)."""
+
+from .torch_interop import (
+    params_from_torch_state_dict,
+    params_to_torch_state_dict,
+)
+
+__all__ = ["params_to_torch_state_dict", "params_from_torch_state_dict"]

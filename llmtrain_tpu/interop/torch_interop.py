@@ -1,0 +1,181 @@
+"""GPT parameter conversion to/from a torch-layout state dict.
+
+The torch mirror architecture and the exact layout transforms are the
+ones proven numerically equivalent in tests/test_torch_parity.py (logits
+2e-5, gradients 1e-4, optimizer trajectory 3e-5 vs the reference-spec
+torch GPT): flax Dense kernels are (in, out) vs torch Linear (out, in);
+the fused qkv DenseGeneral kernel (D, 3, H, hd) flattens C-order so
+torch's row-chunk(3) recovers q/k/v; out_proj (H, hd, D) contracts in
+the same C-order as torch's post-attention reshape.
+
+State-dict naming (the mirror's):
+
+    tok.weight, pos.weight,
+    blocks.{i}.ln_1.{weight,bias}, blocks.{i}.qkv.{weight,bias},
+    blocks.{i}.out_proj.{weight,bias}, blocks.{i}.ln_2.{weight,bias},
+    blocks.{i}.mlp_fc.{weight,bias}, blocks.{i}.mlp_proj.{weight,bias},
+    ln_f.{weight,bias}, lm_head.weight (untied models only)
+
+Conversion is pure numpy — torch is only needed by callers that
+``torch.save``/``torch.load`` the result (the export-checkpoint CLI).
+All tensors are exported in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+Params = Any  # nested dict pytree of arrays
+
+
+def _np(a) -> np.ndarray:
+    return np.array(a, dtype=np.float32)
+
+
+def params_to_torch_state_dict(params: Params) -> dict[str, np.ndarray]:
+    """Flax GPT params (models/gpt.py tree) → torch-layout state dict."""
+    for required in ("token_embedding", "position_embedding", "ln_f"):
+        if required not in params:
+            raise ValueError(
+                f"params have no {required!r}; only the models/gpt.py dense "
+                "GPT tree is supported (model.name 'gpt')"
+            )
+    sd: dict[str, np.ndarray] = {
+        "tok.weight": _np(params["token_embedding"]["embedding"]),
+        "pos.weight": _np(params["position_embedding"]["embedding"]),
+        "ln_f.weight": _np(params["ln_f"]["scale"]),
+        "ln_f.bias": _np(params["ln_f"]["bias"]),
+    }
+    d = sd["tok.weight"].shape[1]
+    i = 0
+    while f"block_{i}" in params:
+        p = params[f"block_{i}"]
+        att = p["attn"]
+        pre = f"blocks.{i}"
+        sd[f"{pre}.ln_1.weight"] = _np(p["ln_1"]["scale"])
+        sd[f"{pre}.ln_1.bias"] = _np(p["ln_1"]["bias"])
+        sd[f"{pre}.ln_2.weight"] = _np(p["ln_2"]["scale"])
+        sd[f"{pre}.ln_2.bias"] = _np(p["ln_2"]["bias"])
+        sd[f"{pre}.qkv.weight"] = _np(att["qkv_proj"]["kernel"]).reshape(d, 3 * d).T
+        sd[f"{pre}.qkv.bias"] = _np(att["qkv_proj"]["bias"]).reshape(3 * d)
+        sd[f"{pre}.out_proj.weight"] = _np(att["out_proj"]["kernel"]).reshape(d, d).T
+        sd[f"{pre}.out_proj.bias"] = _np(att["out_proj"]["bias"])
+        sd[f"{pre}.mlp_fc.weight"] = _np(p["mlp_fc"]["kernel"]).T
+        sd[f"{pre}.mlp_fc.bias"] = _np(p["mlp_fc"]["bias"])
+        sd[f"{pre}.mlp_proj.weight"] = _np(p["mlp_proj"]["kernel"]).T
+        sd[f"{pre}.mlp_proj.bias"] = _np(p["mlp_proj"]["bias"])
+        i += 1
+    if i == 0:
+        raise ValueError("params contain no block_0; not a models/gpt.py GPT tree")
+    if "lm_head" in params:
+        sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    return sd
+
+
+def params_from_torch_state_dict(
+    sd: dict[str, Any], template: Params
+) -> Params:
+    """torch-layout state dict → flax GPT params shaped like ``template``.
+
+    ``template`` (e.g. a fresh ``adapter.init_params`` tree) supplies the
+    tree structure, dtypes, and expected shapes; every template leaf must
+    be present in ``sd`` (missing/mismatched keys raise).
+    """
+    import jax.numpy as jnp
+
+    consumed: set[str] = set()
+
+    def put(key: str, like, transform=lambda a: a) -> Any:
+        if key not in sd:
+            raise ValueError(f"state dict is missing {key!r}")
+        consumed.add(key)
+        a = transform(np.asarray(sd[key], dtype=np.float32))
+        want = tuple(np.shape(like))
+        if tuple(a.shape) != want:
+            raise ValueError(
+                f"{key!r}: converted shape {tuple(a.shape)} != expected {want}"
+            )
+        return jnp.asarray(a, dtype=like.dtype)
+
+    d = np.shape(template["token_embedding"]["embedding"])[1]
+    out: dict[str, Any] = {
+        "token_embedding": {"embedding": put("tok.weight", template["token_embedding"]["embedding"])},
+        "position_embedding": {"embedding": put("pos.weight", template["position_embedding"]["embedding"])},
+        "ln_f": {
+            "scale": put("ln_f.weight", template["ln_f"]["scale"]),
+            "bias": put("ln_f.bias", template["ln_f"]["bias"]),
+        },
+    }
+    i = 0
+    while f"block_{i}" in template:
+        t = template[f"block_{i}"]
+        pre = f"blocks.{i}"
+        att_t = t["attn"]
+        h, hd = np.shape(att_t["qkv_proj"]["kernel"])[2:4]
+        out[f"block_{i}"] = {
+            "ln_1": {
+                "scale": put(f"{pre}.ln_1.weight", t["ln_1"]["scale"]),
+                "bias": put(f"{pre}.ln_1.bias", t["ln_1"]["bias"]),
+            },
+            "ln_2": {
+                "scale": put(f"{pre}.ln_2.weight", t["ln_2"]["scale"]),
+                "bias": put(f"{pre}.ln_2.bias", t["ln_2"]["bias"]),
+            },
+            "attn": {
+                "qkv_proj": {
+                    "kernel": put(
+                        f"{pre}.qkv.weight",
+                        att_t["qkv_proj"]["kernel"],
+                        lambda a: a.T.reshape(d, 3, h, hd),
+                    ),
+                    "bias": put(
+                        f"{pre}.qkv.bias",
+                        att_t["qkv_proj"]["bias"],
+                        lambda a: a.reshape(3, h, hd),
+                    ),
+                },
+                "out_proj": {
+                    "kernel": put(
+                        f"{pre}.out_proj.weight",
+                        att_t["out_proj"]["kernel"],
+                        lambda a: a.T.reshape(h, hd, d),
+                    ),
+                    "bias": put(f"{pre}.out_proj.bias", att_t["out_proj"]["bias"]),
+                },
+            },
+            "mlp_fc": {
+                "kernel": put(f"{pre}.mlp_fc.weight", t["mlp_fc"]["kernel"], lambda a: a.T),
+                "bias": put(f"{pre}.mlp_fc.bias", t["mlp_fc"]["bias"]),
+            },
+            "mlp_proj": {
+                "kernel": put(f"{pre}.mlp_proj.weight", t["mlp_proj"]["kernel"], lambda a: a.T),
+                "bias": put(f"{pre}.mlp_proj.bias", t["mlp_proj"]["bias"]),
+            },
+        }
+        i += 1
+    if "lm_head" in template:
+        out["lm_head"] = {
+            "kernel": put("lm_head.weight", template["lm_head"]["kernel"], lambda a: a.T)
+        }
+    extra = set(template) - set(out)
+    if extra:
+        raise ValueError(
+            f"template has params the converter does not map: {sorted(extra)} "
+            "(only the models/gpt.py dense GPT tree is supported)"
+        )
+    unconsumed = set(sd) - consumed
+    if unconsumed:
+        # Silently dropping weights (deeper torch model, untied head into a
+        # tied template, ...) would import "successfully" and then produce
+        # different logits than the source model.
+        raise ValueError(
+            f"state dict has weights the template cannot hold: "
+            f"{sorted(unconsumed)[:8]}{'...' if len(unconsumed) > 8 else ''} "
+            "(layer count / weight tying mismatch?)"
+        )
+    return out
+
+
+__all__ = ["params_to_torch_state_dict", "params_from_torch_state_dict"]

@@ -43,6 +43,14 @@ class ModelAdapter(ABC):
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
         """Construct the tokenizer, or None for models that need none."""
 
+    @staticmethod
+    def _positive_extra(cfg: RunConfig, key: str, default: int) -> int:
+        """Validated ``model.extra`` integer knob (>= 1), shared by adapters."""
+        value = int(cfg.model.extra.get(key, default))
+        if value < 1:
+            raise ValueError(f"model.extra.{key} must be >= 1, got {value}")
+        return value
+
     def init_params(self, model: nn.Module, cfg: RunConfig, rng: jax.Array) -> Params:
         """Initialize the parameter PyTree.
 

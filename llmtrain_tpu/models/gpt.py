@@ -472,9 +472,7 @@ class GPTAdapter(ModelAdapter):
                 f"model.extra.loss_impl {loss_impl!r} unknown; "
                 "expected 'dense' or 'chunked_ce'"
             )
-        ce_chunk = int(cfg.model.extra.get("ce_chunk", 8192))
-        if ce_chunk < 1:
-            raise ValueError(f"model.extra.ce_chunk must be >= 1, got {ce_chunk}")
+        ce_chunk = self._positive_extra(cfg, "ce_chunk", 8192)
         if cfg.model.attention in ("flash", "ring") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "

@@ -392,13 +392,6 @@ class PipelineGPTAdapter(ModelAdapter):
             ce_chunk=self._positive_extra(cfg, "ce_chunk", 8192),
         )
 
-    @staticmethod
-    def _positive_extra(cfg: RunConfig, key: str, default: int) -> int:
-        value = int(cfg.model.extra.get(key, default))
-        if value < 1:
-            raise ValueError(f"model.extra.{key} must be >= 1, got {value}")
-        return value
-
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
         from ..data.tokenizers import build_tokenizer
 

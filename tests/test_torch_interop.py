@@ -1,0 +1,181 @@
+"""Torch checkpoint interop (llmtrain_tpu/interop/torch_interop.py).
+
+The migration path in BOTH directions: export our GPT weights to a
+torch-layout state dict, and rebuild our params from one. Correctness is
+anchored to the parity-proven transforms of tests/test_torch_parity.py —
+the exported dict must drive the torch mirror to the flax model's exact
+logits, and import(export(params)) must be the identity.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from llmtrain_tpu.interop import (  # noqa: E402
+    params_from_torch_state_dict,
+    params_to_torch_state_dict,
+)
+
+# The parity-test mirror and helpers double as the reference
+# implementation here (pytest puts tests/ on sys.path).
+from test_torch_parity import T, V, _flax_gpt, _TorchGPT  # noqa: E402
+
+
+@pytest.mark.parametrize("tie", [True, False], ids=["tied", "untied"])
+def test_roundtrip_is_identity(tie):
+    _, params = _flax_gpt(tie)
+    sd = params_to_torch_state_dict(params)
+    back = params_from_torch_state_dict(sd, params)
+    for (pa, va), (pb, vb) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(back),
+        strict=True,
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+@pytest.mark.parametrize("tie", [True, False], ids=["tied", "untied"])
+def test_exported_state_dict_drives_torch_mirror(tie):
+    """load_state_dict(exported) on the torch mirror reproduces the flax
+    logits — the export really is the parity transplant."""
+    model, params = _flax_gpt(tie)
+    sd = {k: torch.from_numpy(v) for k, v in params_to_torch_state_dict(params).items()}
+    mirror = _TorchGPT(tie)
+    missing, unexpected = mirror.load_state_dict(sd, strict=True)
+    assert not missing and not unexpected
+    ids = np.random.default_rng(3).integers(0, V, size=(2, T), dtype=np.int64)
+    import jax.numpy as jnp
+
+    flax_logits = np.asarray(
+        model.apply({"params": params}, jnp.asarray(ids, jnp.int32), deterministic=True)
+    )
+    with torch.no_grad():
+        torch_logits = mirror(torch.from_numpy(ids)).numpy()
+    np.testing.assert_allclose(flax_logits, torch_logits, atol=2e-5, rtol=2e-5)
+
+
+def test_import_rejects_missing_and_misshaped_keys():
+    _, params = _flax_gpt(True)
+    sd = params_to_torch_state_dict(params)
+    incomplete = {k: v for k, v in sd.items() if k != "blocks.1.qkv.weight"}
+    with pytest.raises(ValueError, match="missing 'blocks.1.qkv.weight'"):
+        params_from_torch_state_dict(incomplete, params)
+    bad = dict(sd)
+    bad["ln_f.weight"] = np.zeros(7, np.float32)
+    with pytest.raises(ValueError, match="ln_f.weight"):
+        params_from_torch_state_dict(bad, params)
+
+
+def test_export_rejects_non_gpt_tree():
+    with pytest.raises(ValueError, match="block_0"):
+        params_to_torch_state_dict({"token_embedding": {"embedding": np.zeros((4, 2))},
+                                    "position_embedding": {"embedding": np.zeros((4, 2))},
+                                    "ln_f": {"scale": np.ones(2), "bias": np.zeros(2)}})
+
+
+class TestExportCLI:
+    def test_train_then_export(self, tmp_path):
+        import yaml
+
+        cfg = {
+            "run": {"name": "export", "seed": 0, "device": "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": 8,
+                "d_model": 16,
+                "n_layers": 1,
+                "n_heads": 4,
+                "d_ff": 32,
+                "dropout": 0.0,
+                "vocab_size": 64,
+                "extra": {"tokenizer": "byte"},
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": 2,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+                "log_every_steps": 1,
+                "eval_every_steps": 2,
+                "save_every_steps": 2,
+            },
+            "mlflow": {"enabled": False},
+            "output": {"root_dir": str(tmp_path / "runs")},
+        }
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg, sort_keys=False))
+
+        def run(argv):
+            return subprocess.run(
+                [sys.executable, "-m", "llmtrain_tpu", *argv],
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+
+        train = run(["train", "--config", str(cfg_path), "--run-id", "x", "--json"])
+        assert train.returncode == 0, train.stderr
+        out_pt = tmp_path / "export" / "model.pt"
+        proc = run(
+            [
+                "export-checkpoint", "--config", str(cfg_path),
+                "--from", "x", "--output", str(out_pt), "--json",
+            ]
+        )
+        assert proc.returncode == 0, proc.stderr
+        stats = json.loads(proc.stdout)
+        sd = torch.load(out_pt, weights_only=True)
+        assert stats["tensors"] == len(sd)
+        assert "tok.weight" in sd and sd["tok.weight"].shape == (64, 16)
+        assert stats["step"] == 2
+
+    def test_bad_checkpoint_exit_1(self, tmp_path):
+        import yaml
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "run": {"name": "x", "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 8, "d_model": 16,
+                        "n_layers": 1, "n_heads": 4, "d_ff": 32,
+                        "vocab_size": 64, "extra": {"tokenizer": "byte"},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                },
+                sort_keys=False,
+            )
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "export-checkpoint",
+                "--config", str(cfg_path), "--from", "no-such-run",
+                "--output", str(tmp_path / "m.pt"),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "export failed" in proc.stderr
+
+
+def test_import_rejects_unconsumed_state_dict_keys():
+    """An sd with weights the template cannot hold (deeper model, untied
+    head into a tied template) must fail, not silently drop them."""
+    _, params = _flax_gpt(True)  # tied: no lm_head in template
+    sd = params_to_torch_state_dict(params)
+    sd["lm_head.weight"] = np.zeros((V, 16), np.float32)
+    with pytest.raises(ValueError, match="cannot hold"):
+        params_from_torch_state_dict(sd, params)
