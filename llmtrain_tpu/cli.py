@@ -166,6 +166,20 @@ def build_parser() -> argparse.ArgumentParser:
     export.add_argument("--output", required=True, help="output .pt path")
     export.add_argument("--json", action="store_true", help="emit stats as JSON")
 
+    imp = sub.add_parser(
+        "import-checkpoint",
+        help="build a resumable checkpoint from a torch state dict",
+    )
+    imp.add_argument("--config", required=True, help="path to the YAML run config")
+    imp.add_argument("--input", required=True, help="torch .pt state-dict path")
+    imp.add_argument(
+        "--output",
+        required=True,
+        help="checkpoint directory to write step_000000.ckpt into "
+        "(use with train --resume <dir>)",
+    )
+    imp.add_argument("--json", action="store_true", help="emit stats as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -214,22 +228,28 @@ def _handle_print_config(args: argparse.Namespace) -> int:
     return EXIT_OK
 
 
-def _load_checkpoint_params(cfg, adapter, model, from_spec: str):
-    """Shared inference-checkpoint load (generate / export-checkpoint):
-    resolve the spec, restore params against the abstract shape tree, warn
-    on config mismatch. Returns ``(ckpt_path, params, step)``."""
+def _abstract_params(cfg, adapter, model):
+    """Unboxed abstract (shape/dtype) param tree for checkpoint restore."""
     import jax
-    import yaml
     from flax.linen import meta as nn_meta
 
-    from .training.checkpoint import load_inference_params, resolve_resume_path
-
-    ckpt_path = resolve_resume_path(from_spec, cfg.output.root_dir)
-    abstract = nn_meta.unbox(
+    return nn_meta.unbox(
         jax.eval_shape(
             lambda rng: adapter.init_params(model, cfg, rng), jax.random.key(0)
         )
     )
+
+
+def _load_checkpoint_params(cfg, adapter, model, from_spec: str):
+    """Shared inference-checkpoint load (generate / export-checkpoint):
+    resolve the spec, restore params against the abstract shape tree, warn
+    on config mismatch. Returns ``(ckpt_path, params, step)``."""
+    import yaml
+
+    from .training.checkpoint import load_inference_params, resolve_resume_path
+
+    ckpt_path = resolve_resume_path(from_spec, cfg.output.root_dir)
+    abstract = _abstract_params(cfg, adapter, model)
     params, step = load_inference_params(
         ckpt_path,
         abstract,
@@ -287,6 +307,76 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
         return EXIT_OK
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         _emit_error(f"export failed: {exc}")
+        return EXIT_TRAIN_FAILURE
+
+
+def _handle_import_checkpoint(args: argparse.Namespace) -> int:
+    """torch state dict → a step-0 checkpoint this framework can resume.
+
+    Inverse of export-checkpoint (interop/torch_interop.py): reference-
+    trained GPT weights become ``step_000000.ckpt`` with a fresh optimizer
+    state; continue with ``train --resume <output dir>``.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    try:
+        import jax
+        import numpy as np
+        import torch
+
+        from .interop import params_from_torch_state_dict
+        from .registry import get_model_adapter
+        from .training.checkpoint import CheckpointManager, state_to_host
+        from .training.optimizer import build_optimizer
+        from .training.train_step import create_train_state
+
+        initialize_registries()
+        out_dir = Path(args.output)
+        existing = sorted(out_dir.glob("step_*.ckpt")) if out_dir.exists() else []
+        if existing:
+            # keep-last-k pruning would otherwise silently delete the
+            # imported step-0 file (or the user's own checkpoints).
+            _emit_error(
+                f"output dir {out_dir} already holds checkpoints "
+                f"({existing[0].name}, ...); pass an empty directory"
+            )
+            return EXIT_TRAIN_FAILURE
+        adapter = get_model_adapter(cfg.model.name)()
+        model = adapter.build_model(cfg)
+        template = _abstract_params(cfg, adapter, model)
+        raw = torch.load(args.input, weights_only=True)
+        # .float() first: torch bf16 tensors cannot .numpy() directly, and
+        # the converter works in float32 anyway.
+        sd = {
+            k: (v.float().numpy() if hasattr(v, "numpy") else v)
+            for k, v in raw.items()
+        }
+        params = params_from_torch_state_dict(sd, template)
+
+        state = create_train_state(params, build_optimizer(cfg.trainer))
+        target = CheckpointManager(out_dir).save_host(
+            0, state_to_host(state), cfg.model_dump()
+        )
+        n_params = int(
+            sum(np.prod(np.shape(x)) for x in jax.tree.leaves(params))
+        )
+        stats = {"input": args.input, "checkpoint": str(target), "parameters": n_params}
+        if args.json:
+            print(json.dumps(stats))
+        else:
+            print(
+                f"imported {args.input} -> {target} ({n_params:,} parameters); "
+                f"continue with: train --config {args.config} --resume {args.output}"
+            )
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"import failed: {exc}")
         return EXIT_TRAIN_FAILURE
 
 
@@ -758,6 +848,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_train_tokenizer(args)
     if args.command == "export-checkpoint":
         return _handle_export_checkpoint(args)
+    if args.command == "import-checkpoint":
+        return _handle_import_checkpoint(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

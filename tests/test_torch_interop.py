@@ -179,3 +179,196 @@ def test_import_rejects_unconsumed_state_dict_keys():
     sd["lm_head.weight"] = np.zeros((V, 16), np.float32)
     with pytest.raises(ValueError, match="cannot hold"):
         params_from_torch_state_dict(sd, params)
+
+
+class TestImportCLI:
+    def test_full_migration_loop(self, tmp_path):
+        """train -> export-checkpoint -> import-checkpoint -> eval: the
+        re-imported checkpoint evaluates to the original's exact val loss,
+        and training can resume from it."""
+        import yaml
+
+        cfg = {
+            "run": {"name": "migrate", "seed": 0, "device": "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": 8,
+                "d_model": 16,
+                "n_layers": 1,
+                "n_heads": 4,
+                "d_ff": 32,
+                "dropout": 0.0,
+                "vocab_size": 64,
+                "extra": {"tokenizer": "byte"},
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": 3,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+                "log_every_steps": 1,
+                "eval_every_steps": 3,
+                "save_every_steps": 3,
+            },
+            "mlflow": {"enabled": False},
+            "output": {"root_dir": str(tmp_path / "runs")},
+        }
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg, sort_keys=False))
+
+        def run(argv):
+            return subprocess.run(
+                [sys.executable, "-m", "llmtrain_tpu", *argv],
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+
+        train = run(["train", "--config", str(cfg_path), "--run-id", "src", "--json"])
+        assert train.returncode == 0, train.stderr
+
+        pt = tmp_path / "model.pt"
+        exp = run(
+            ["export-checkpoint", "--config", str(cfg_path), "--from", "src",
+             "--output", str(pt), "--json"]
+        )
+        assert exp.returncode == 0, exp.stderr
+
+        ckpt_dir = tmp_path / "imported"
+        imp = run(
+            ["import-checkpoint", "--config", str(cfg_path), "--input", str(pt),
+             "--output", str(ckpt_dir), "--json"]
+        )
+        assert imp.returncode == 0, imp.stderr
+        assert (ckpt_dir / "step_000000.ckpt").exists()
+
+        ev_src = run(["eval", "--config", str(cfg_path), "--from", "src", "--json"])
+        ev_imp = run(
+            ["eval", "--config", str(cfg_path), "--from", str(ckpt_dir), "--json"]
+        )
+        assert ev_src.returncode == 0 and ev_imp.returncode == 0, ev_imp.stderr
+        src_loss = json.loads(ev_src.stdout)["metrics"]["val/loss"]
+        imp_loss = json.loads(ev_imp.stdout)["metrics"]["val/loss"]
+        assert abs(src_loss - imp_loss) < 1e-6
+
+        # And training resumes from the imported step-0 checkpoint.
+        cont = run(
+            ["train", "--config", str(cfg_path), "--run-id", "cont", "--json",
+             "--resume", str(ckpt_dir)]
+        )
+        assert cont.returncode == 0, cont.stderr
+        result = json.loads(cont.stdout)["train_result"]
+        assert result["final_step"] == 3
+        assert result["resumed_from_step"] == 0
+
+    def test_bad_input_exit_1(self, tmp_path):
+        import yaml
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "run": {"name": "x", "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 8, "d_model": 16,
+                        "n_layers": 1, "n_heads": 4, "d_ff": 32,
+                        "vocab_size": 64, "extra": {"tokenizer": "byte"},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                },
+                sort_keys=False,
+            )
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "import-checkpoint",
+                "--config", str(cfg_path), "--input", str(tmp_path / "nope.pt"),
+                "--output", str(tmp_path / "out"),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "import failed" in proc.stderr
+
+    def test_refuses_nonempty_output_dir(self, tmp_path):
+        import yaml
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "run": {"name": "x", "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 8, "d_model": 16,
+                        "n_layers": 1, "n_heads": 4, "d_ff": 32,
+                        "vocab_size": 64, "extra": {"tokenizer": "byte"},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                },
+                sort_keys=False,
+            )
+        )
+        out = tmp_path / "ckpts"
+        out.mkdir()
+        (out / "step_000300.ckpt").write_bytes(b"x")
+        pt = tmp_path / "m.pt"
+        _, params = _flax_gpt(True)
+        torch.save(
+            {k: torch.from_numpy(v) for k, v in params_to_torch_state_dict(params).items()},
+            pt,
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "import-checkpoint",
+                "--config", str(cfg_path), "--input", str(pt), "--output", str(out),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "already holds checkpoints" in proc.stderr
+        assert (out / "step_000300.ckpt").exists()  # untouched
+
+    def test_bf16_state_dict_imports(self, tmp_path):
+        """torch bf16 tensors can't .numpy() directly; the importer must
+        still accept bf16-saved reference checkpoints."""
+        import yaml
+
+        _, params = _flax_gpt(True)
+        sd = {
+            k: torch.from_numpy(v).to(torch.bfloat16)
+            for k, v in params_to_torch_state_dict(params).items()
+        }
+        pt = tmp_path / "bf16.pt"
+        torch.save(sd, pt)
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "run": {"name": "x", "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": T, "d_model": 32,
+                        "n_layers": 2, "n_heads": 4, "d_ff": 64,
+                        "vocab_size": V, "extra": {"tokenizer": "byte"},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                },
+                sort_keys=False,
+            )
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "llmtrain_tpu", "import-checkpoint",
+                "--config", str(cfg_path), "--input", str(pt),
+                "--output", str(tmp_path / "out"), "--json",
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert (tmp_path / "out" / "step_000000.ckpt").exists()
