@@ -161,7 +161,10 @@ def lm_loss_components(
     rngs: dict[str, jax.Array] | None = None,
     deterministic: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """Shared LM forward → per-example (loss_sum, token_count)."""
+    """Shared LM forward → per-example (loss_sum, token_count).
+
+    Honors the model's ``z_loss`` field when present (models/gpt.py).
+    """
     input_ids, labels, attention_mask = validate_lm_batch(batch)
     logits = model.apply(
         {"params": params},
@@ -170,7 +173,9 @@ def lm_loss_components(
         deterministic=deterministic,
         rngs=rngs,
     )
-    return masked_ce_components(logits, labels, attention_mask)
+    return masked_ce_components(
+        logits, labels, attention_mask, z_loss=getattr(model, "z_loss", 0.0)
+    )
 
 
 def masked_cross_entropy(
@@ -186,11 +191,27 @@ def masked_cross_entropy(
 
 
 def masked_ce_components(
-    logits: jax.Array, labels: jax.Array, attention_mask: jax.Array | None
+    logits: jax.Array,
+    labels: jax.Array,
+    attention_mask: jax.Array | None,
+    *,
+    z_loss: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
-    """Per-example ``(loss_sum, token_count)`` of shape (B,), CE in float32."""
-    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    per_token = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    """Per-example ``(loss_sum, token_count)`` of shape (B,), CE in float32.
+
+    ``z_loss > 0`` adds PaLM's softmax-normalizer regularizer
+    ``z_loss * log(Z)^2`` per token (Z = sum exp(logits)) — keeps bf16
+    logits from drifting large and the softmax well-conditioned. New
+    capability over the reference (its loss is plain CE, gpt.py:256-269).
+    """
+    logits32 = logits.astype(jnp.float32)
+    # One reduction serves both terms: CE = lse - logit[label], and the
+    # z-loss reuses the same lse (mirrors ops/chunked_ce.py).
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+    label_logit = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    per_token = lse - label_logit
+    if z_loss > 0.0:
+        per_token = per_token + z_loss * jnp.square(lse)
     if attention_mask is None:
         mask = jnp.ones_like(per_token)
     else:

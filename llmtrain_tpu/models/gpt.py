@@ -338,6 +338,9 @@ class GPT(nn.Module):
     # hidden states via return_hidden and never builds [B,T,V].
     loss_impl: str = "dense"
     ce_chunk: int = 8192
+    # PaLM z-loss coefficient: adds z_loss * log(Z)^2 per token to the LM
+    # objective (both loss paths). 0 = off (reference behavior).
+    z_loss: float = 0.0
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -473,6 +476,9 @@ class GPTAdapter(ModelAdapter):
                 "expected 'dense' or 'chunked_ce'"
             )
         ce_chunk = self._positive_extra(cfg, "ce_chunk", 8192)
+        z_loss = float(cfg.model.extra.get("z_loss", 0.0))
+        if z_loss < 0.0:
+            raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
         if cfg.model.attention in ("flash", "ring") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
@@ -494,6 +500,7 @@ class GPTAdapter(ModelAdapter):
             attention=cfg.model.attention,
             loss_impl=loss_impl,
             ce_chunk=ce_chunk,
+            z_loss=z_loss,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
@@ -557,6 +564,7 @@ class GPTAdapter(ModelAdapter):
             labels,
             attention_mask,
             chunk=model.ce_chunk,
+            z_loss=getattr(model, "z_loss", 0.0),
         )
 
     @classmethod

@@ -79,7 +79,9 @@ class GPTMoEAdapter(GPTAdapter):
                 model, params, out, labels, attention_mask
             )
         else:
-            loss_sum, tokens = masked_ce_components(out, labels, attention_mask)
+            loss_sum, tokens = masked_ce_components(
+                out, labels, attention_mask, z_loss=getattr(model, "z_loss", 0.0)
+            )
         aux = sum(jax.tree.leaves(mutated.get("losses", {})))
         # Fold aux in proportionally to tokens: the trainer's
         # sum(loss_sum)/sum(tokens) then equals CE + aux exactly.

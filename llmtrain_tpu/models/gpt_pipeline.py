@@ -128,6 +128,8 @@ class PipelineGPT(nn.Module):
     # on the gathered final hidden states.
     loss_impl: str = "dense"
     ce_chunk: int = 8192
+    # PaLM z-loss coefficient (see models/gpt.py); 0 = off.
+    z_loss: float = 0.0
 
     def _stacked(
         self, name: str, shape: tuple[int, ...], init, axes: tuple[str, ...]
@@ -374,6 +376,9 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"model.extra.loss_impl {loss_impl!r} unknown; "
                 "expected 'dense' or 'chunked_ce'"
             )
+        z_loss = float(cfg.model.extra.get("z_loss", 0.0))
+        if z_loss < 0.0:
+            raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
         return PipelineGPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -390,6 +395,7 @@ class PipelineGPTAdapter(ModelAdapter):
             n_virtual_chunks=self._positive_extra(cfg, "pipeline_virtual_chunks", 1),
             loss_impl=loss_impl,
             ce_chunk=self._positive_extra(cfg, "ce_chunk", 8192),
+            z_loss=z_loss,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

@@ -42,25 +42,28 @@ def _pad_vocab(w: jax.Array, chunk: int) -> tuple[jax.Array, int]:
     return w, n_chunks
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def chunked_ce_per_token(
     hidden: jax.Array,
     w_vocab: jax.Array,
     labels: jax.Array,
     chunk: int = DEFAULT_CHUNK,
     compute_dtype: jnp.dtype | None = None,
+    z_loss: float = 0.0,
 ) -> jax.Array:
     """Per-token CE loss, f32, shape (B, T).
 
     hidden: (B, T, d) post-final-norm activations. w_vocab: (V, d) in
     embedding layout (tied ``token_embedding.embedding`` directly; untied
-    ``lm_head.kernel`` transposed). labels: (B, T) int ids.
+    ``lm_head.kernel`` transposed). labels: (B, T) int ids. ``z_loss``
+    adds PaLM's ``z_loss * log(Z)^2`` per token — free here, the
+    streaming logsumexp is already computed.
     """
-    loss, _ = _forward(hidden, w_vocab, labels, chunk, compute_dtype)
+    loss, _ = _forward(hidden, w_vocab, labels, chunk, compute_dtype, z_loss)
     return loss
 
 
-def _forward(hidden, w_vocab, labels, chunk, compute_dtype):
+def _forward(hidden, w_vocab, labels, chunk, compute_dtype, z_loss):
     v = w_vocab.shape[0]
     dt = compute_dtype or hidden.dtype
     w_pad, n_chunks = _pad_vocab(w_vocab, chunk)
@@ -97,15 +100,18 @@ def _forward(hidden, w_vocab, labels, chunk, compute_dtype):
     label_logit = jnp.einsum(
         "btd,btd->bt", h, label_emb, preferred_element_type=jnp.float32
     )
-    return lse - label_logit, lse
+    per_token = lse - label_logit
+    if z_loss > 0.0:
+        per_token = per_token + z_loss * jnp.square(lse)
+    return per_token, lse
 
 
-def _fwd(hidden, w_vocab, labels, chunk, compute_dtype):
-    loss, lse = _forward(hidden, w_vocab, labels, chunk, compute_dtype)
+def _fwd(hidden, w_vocab, labels, chunk, compute_dtype, z_loss):
+    loss, lse = _forward(hidden, w_vocab, labels, chunk, compute_dtype, z_loss)
     return loss, (hidden, w_vocab, labels, lse)
 
 
-def _bwd(chunk, compute_dtype, res, g):
+def _bwd(chunk, compute_dtype, z_loss, res, g):
     hidden, w_vocab, labels, lse = res
     v, d = w_vocab.shape
     dt = compute_dtype or hidden.dtype
@@ -114,6 +120,9 @@ def _bwd(chunk, compute_dtype, res, g):
 
     h = hidden.astype(dt)
     gf = g.astype(jnp.float32)  # (B, T)
+    # d(per_token)/d(lse) = 1 (CE) + 2*z*lse (z-loss); both flow through
+    # the softmax. The -label_logit term keeps coefficient -1.
+    g_lse = gf * (1.0 + 2.0 * z_loss * lse) if z_loss > 0.0 else gf
 
     def scan_chunk(dh, xs):
         w_c, base = xs
@@ -123,7 +132,7 @@ def _bwd(chunk, compute_dtype, res, g):
         col_ok = (base + jnp.arange(chunk)) < v
         logits = jnp.where(col_ok[None, None, :], logits, -jnp.inf)
         # d(lse)/d(logit) = softmax; weight by the incoming cotangent.
-        gp = jnp.exp(logits - lse[..., None]) * gf[..., None]  # (B, T, chunk)
+        gp = jnp.exp(logits - lse[..., None]) * g_lse[..., None]  # (B, T, chunk)
         dh = dh + jnp.einsum(
             "btv,vd->btd", gp, w_c.astype(dt), preferred_element_type=jnp.float32
         )
@@ -157,11 +166,12 @@ def chunked_ce_components(
     attention_mask: jax.Array | None,
     *,
     chunk: int = DEFAULT_CHUNK,
+    z_loss: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """Per-example ``(loss_sum, token_count)`` of shape (B,) — the drop-in
     counterpart of models/base.py:masked_ce_components, same mask-aware
     semantics (reference gpt.py:256-269), computed without full logits."""
-    per_token = chunked_ce_per_token(hidden, w_vocab, labels, chunk)
+    per_token = chunked_ce_per_token(hidden, w_vocab, labels, chunk, None, z_loss)
     if attention_mask is None:
         mask = jnp.ones_like(per_token)
     else:

@@ -413,3 +413,84 @@ def test_ce_chunk_must_be_positive():
         PipelineGPTAdapter().build_model(
             tk._cfg("gpt_pipeline", {"loss_impl": "chunked_ce", "ce_chunk": -8})
         )
+
+
+class TestZLoss:
+    """PaLM z-loss (z * log(Z)^2 per token) in both loss paths."""
+
+    def test_analytic_value(self):
+        """For a hand-checkable 1-token case the z-loss term is exactly
+        z * logsumexp(logits)^2."""
+        hidden = jnp.ones((1, 2, 2), jnp.float32)
+        w = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [0.5, 0.5]], jnp.float32)
+        labels = jnp.zeros((1, 2), jnp.int32)
+        logits = np.asarray(hidden @ w.T)
+        lse = np.log(np.exp(logits).sum(-1))
+        base = np.asarray(chunked_ce_per_token(hidden, w, labels, 2, None, 0.0))
+        with_z = np.asarray(chunked_ce_per_token(hidden, w, labels, 2, None, 0.1))
+        np.testing.assert_allclose(with_z - base, 0.1 * lse**2, atol=1e-6)
+
+    def test_chunked_matches_dense_value_and_grads(self):
+        hidden, w, labels = _data(31)
+        mask = jnp.ones((B, T), jnp.float32)
+        z = 1e-2
+
+        def loss_chunked(h, w_):
+            s, t = chunked_ce_components(h, w_, labels, mask, chunk=64, z_loss=z)
+            return jnp.sum(s) / jnp.sum(t)
+
+        def loss_dense(h, w_):
+            logits = jnp.einsum("btd,vd->btv", h, w_)
+            s, t = masked_ce_components(logits, labels, mask, z_loss=z)
+            return jnp.sum(s) / jnp.sum(t)
+
+        lc, (gch, gcw) = jax.value_and_grad(loss_chunked, argnums=(0, 1))(hidden, w)
+        ld, (gdh, gdw) = jax.value_and_grad(loss_dense, argnums=(0, 1))(hidden, w)
+        np.testing.assert_allclose(float(lc), float(ld), atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(gch), np.asarray(gdh), atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gcw), np.asarray(gdw), atol=1e-5, rtol=1e-4)
+
+    def test_adapter_paths_agree_with_z(self):
+        """gpt with z_loss: dense and chunked loss paths still match."""
+        rng = np.random.default_rng(37)
+        batch = {
+            "input_ids": jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32),
+            "labels": jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32),
+            "attention_mask": jnp.ones((B, T), jnp.int32),
+        }
+        adapter = GPTAdapter()
+
+        def build(loss_impl):
+            model = GPT(
+                vocab_size=V, block_size=T, d_model=D, n_layers=1, n_heads=4,
+                d_ff=32, dropout=0.0, loss_impl=loss_impl, ce_chunk=64,
+                z_loss=1e-3,
+            )
+            ids = jnp.zeros((1, T), jnp.int32)
+            params = nn_meta.unbox(
+                model.init(jax.random.key(0), ids, deterministic=True)
+            )["params"]
+            return model, params
+
+        dense_model, params = build("dense")
+        chunk_model, _ = build("chunked_ce")
+        sd, td = adapter.compute_loss_components(dense_model, params, batch)
+        sc, tc = adapter.compute_loss_components(chunk_model, params, batch)
+        np.testing.assert_allclose(np.asarray(sc), np.asarray(sd), atol=1e-5, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(tc), np.asarray(td))
+
+    def test_negative_z_rejected(self):
+        from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+        tk = TestKnobValidation()
+        with pytest.raises(ValueError, match="z_loss"):
+            GPTAdapter().build_model(tk._cfg("gpt", {"z_loss": -0.1}))
+        with pytest.raises(ValueError, match="z_loss"):
+            PipelineGPTAdapter().build_model(tk._cfg("gpt_pipeline", {"z_loss": -0.1}))
+
+    def test_z_zero_is_reference_behavior(self):
+        """Default z=0 leaves the loss bit-identical to plain CE."""
+        hidden, w, labels = _data(41)
+        a = chunked_ce_per_token(hidden, w, labels, 64, None, 0.0)
+        b = chunked_ce_per_token(hidden, w, labels, 64)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
