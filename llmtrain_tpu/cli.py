@@ -109,6 +109,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     gen.add_argument("--top-k", type=int, default=40, help="0 disables top-k filtering")
     gen.add_argument(
+        "--top-p",
+        type=float,
+        default=None,
+        help="nucleus sampling: keep the smallest token set with this "
+        "probability mass, 0 < p < 1 (0 or 1 disables, like --top-k 0)",
+    )
+    gen.add_argument(
         "--eos-token-id",
         type=int,
         default=None,
@@ -652,6 +659,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 rng=jax.random.key(args.seed),
                 temperature=args.temperature,
                 top_k=args.top_k,  # generate() maps <=0 to "disabled"
+                top_p=args.top_p,
                 eos_token_id=eos_token_id,
             )
             for row, i in enumerate(idxs):

@@ -33,6 +33,7 @@ def _sample_next(
     *,
     temperature: float,
     top_k: int | None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """One sampling decision, shared by both decode paths."""
     if temperature == 0.0:
@@ -42,12 +43,26 @@ def _sample_next(
         k = min(top_k, scaled.shape[-1])
         kth = jax.lax.top_k(scaled, k)[0][:, -1, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None and top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the descending-prob order
+        # whose EXCLUSIVE cumulative mass is < top_p (always keeps the
+        # argmax). Composes after top-k (already -inf-masked there).
+        sorted_logits = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive < top_p
+        thr = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
     return jax.random.categorical(jax.random.fold_in(rng, i), scaled, axis=-1)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_token_id"),
+    static_argnames=(
+        "model", "max_new_tokens", "temperature", "top_k", "top_p", "eos_token_id"
+    ),
 )
 def _generate_cached_jit(
     model: Any,  # decode-mode module (cache variables enabled)
@@ -59,6 +74,7 @@ def _generate_cached_jit(
     max_new_tokens: int,
     temperature: float,
     top_k: int | None,
+    top_p: float | None,
     eos_token_id: int | None,
 ) -> jax.Array:
     def apply(cache, tokens):
@@ -73,7 +89,7 @@ def _generate_cached_jit(
     # Prefill: one forward over the whole prompt fills every layer's cache.
     cache, logits = apply(cache, prompt)
     tok0 = _sample_next(
-        logits[:, -1], rng, 0, temperature=temperature, top_k=top_k
+        logits[:, -1], rng, 0, temperature=temperature, top_k=top_k, top_p=top_p
     ).astype(prompt.dtype)
     done0 = jnp.zeros((prompt.shape[0],), jnp.bool_)
     if eos_token_id is not None:
@@ -83,7 +99,7 @@ def _generate_cached_jit(
         cache, tok, done = carry
         cache, logits = apply(cache, tok[:, None])
         nxt = _sample_next(
-            logits[:, 0], rng, i, temperature=temperature, top_k=top_k
+            logits[:, 0], rng, i, temperature=temperature, top_k=top_k, top_p=top_p
         ).astype(tok.dtype)
         if eos_token_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
@@ -99,7 +115,9 @@ def _generate_cached_jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "window_len", "temperature", "top_k"),
+    static_argnames=(
+        "model", "max_new_tokens", "window_len", "temperature", "top_k", "top_p"
+    ),
 )
 def _generate_jit(
     model: Any,
@@ -112,6 +130,7 @@ def _generate_jit(
     window_len: int,
     temperature: float,
     top_k: int | None,
+    top_p: float | None = None,
     eos_token_id: int | None = None,
 ) -> jax.Array:
     total_len = buffer.shape[1]
@@ -141,7 +160,7 @@ def _generate_jit(
         )[:, 0, :].astype(jnp.float32)
 
         next_tok = _sample_next(
-            next_logits, rng, i, temperature=temperature, top_k=top_k
+            next_logits, rng, i, temperature=temperature, top_k=top_k, top_p=top_p
         ).astype(buf.dtype)
 
         if eos_token_id is not None:
@@ -167,13 +186,16 @@ def generate(
     rng: jax.Array | None = None,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_token_id: int | None = None,
     use_cache: bool | None = None,
 ) -> np.ndarray:
     """Sample ``max_new_tokens`` continuations; returns (B, Tp+max_new_tokens).
 
     ``temperature=0`` decodes greedily; otherwise categorical sampling with
-    optional top-k filtering. ``use_cache=None`` auto-selects KV-cache decode
+    optional top-k and/or top-p (nucleus) filtering — top-p keeps the
+    smallest set of tokens whose probability mass reaches ``top_p``.
+    ``use_cache=None`` auto-selects KV-cache decode
     when the model supports it (``for_decoding()``) and the whole output fits
     in ``block_size``; ``False`` forces the sliding-window re-forward path
     (which also handles outputs longer than ``block_size``).
@@ -194,6 +216,11 @@ def generate(
         )
     if top_k is not None and top_k <= 0:
         top_k = None  # CLI convention: 0 disables top-k filtering
+    if top_p is not None:
+        if top_p <= 0.0 or top_p >= 1.0:
+            # CLI convention mirrors --top-k: out-of-band values (0 and 1
+            # included) disable the filter rather than erroring.
+            top_p = None
     total = tp + max_new_tokens
 
     block_size = int(getattr(model, "block_size", total))
@@ -238,6 +265,7 @@ def generate(
             max_new_tokens=max_new_tokens,
             temperature=float(temperature),
             top_k=top_k,
+            top_p=top_p,
             eos_token_id=eos_token_id,
         )
         return np.asarray(jax.device_get(out))
@@ -256,6 +284,7 @@ def generate(
         window_len=window_len,
         temperature=float(temperature),
         top_k=top_k,
+        top_p=top_p,
         eos_token_id=eos_token_id,
     )
     return np.asarray(jax.device_get(out))

@@ -1,6 +1,7 @@
 """Sampling-loop behavior: greedy determinism, shapes, window sliding, eos."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -396,3 +397,79 @@ class TestPromptsFileCLI:
         assert proc.returncode == 0, proc.stderr
         payload = _json.loads(proc.stdout)
         assert len(payload["results"]) == 1  # stable schema per input mode
+
+
+class TestTopP:
+    """Nucleus (top-p) sampling in the shared sampler."""
+
+    def _logits(self):
+        # probs ~ [0.5, 0.3, 0.1, 0.06, 0.04]: the 0.75-nucleus (exclusive
+        # cumulative < 0.75) is exactly tokens {0, 1}.
+        p = np.array([0.5, 0.3, 0.1, 0.06, 0.04])
+        return jnp.asarray(np.log(p)[None, :], jnp.float32)
+
+    def test_samples_stay_in_nucleus(self):
+        from llmtrain_tpu.generation import _sample_next
+
+        logits = self._logits()
+        seen = set()
+        for i in range(200):
+            tok = int(
+                _sample_next(
+                    logits, jax.random.key(3), i, temperature=1.0, top_k=None,
+                    top_p=0.75,
+                )[0]
+            )
+            seen.add(tok)
+        assert seen <= {0, 1}
+        assert seen == {0, 1}  # both nucleus members actually drawn
+
+    def test_top_p_one_is_unfiltered(self):
+        from llmtrain_tpu.generation import _sample_next
+
+        logits = self._logits()
+        a = [
+            int(_sample_next(logits, jax.random.key(5), i, temperature=1.0,
+                             top_k=None, top_p=None)[0])
+            for i in range(50)
+        ]
+        b = [
+            int(_sample_next(logits, jax.random.key(5), i, temperature=1.0,
+                             top_k=None, top_p=1.0)[0])
+            for i in range(50)
+        ]
+        assert a == b
+
+    def test_always_keeps_argmax(self):
+        """A tiny top_p still keeps the most likely token (never all -inf)."""
+        from llmtrain_tpu.generation import _sample_next
+
+        logits = self._logits()
+        for i in range(20):
+            assert int(
+                _sample_next(logits, jax.random.key(7), i, temperature=1.0,
+                             top_k=None, top_p=1e-6)[0]
+            ) == 0
+
+    def test_generate_accepts_top_p(self, tiny_model):
+        from llmtrain_tpu.generation import generate
+
+        model, params = tiny_model
+        out = generate(
+            model, params, np.asarray([1, 2, 3], np.int32), max_new_tokens=4,
+            temperature=0.9, top_p=0.9, rng=jax.random.key(0),
+        )
+        assert out.shape == (1, 7)
+
+    def test_out_of_band_top_p_disables(self, tiny_model):
+        """0 and >=1 disable the filter (mirrors the --top-k 0 convention)."""
+        from llmtrain_tpu.generation import generate
+
+        model, params = tiny_model
+        prompt = np.asarray([[1, 2]], np.int32)
+        kw = dict(max_new_tokens=4, temperature=0.8, rng=jax.random.key(2))
+        base = generate(model, params, prompt, top_p=None, **kw)
+        for p in (0.0, 1.0, 1.5):
+            np.testing.assert_array_equal(
+                generate(model, params, prompt, top_p=p, **kw), base
+            )
