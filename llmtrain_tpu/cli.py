@@ -207,12 +207,31 @@ def _emit_error(message: str, *, details: Any = None, errors: Any = None) -> Non
     print(json.dumps(payload), file=sys.stderr)
 
 
+def _warn_unknown_extras(cfg) -> None:
+    """Typos in the ``extra`` escape hatches are warnings, never errors
+    (config/extras.py): the knobs are real but plugins may take keys the
+    framework cannot know about."""
+    try:
+        from .config.extras import unknown_extra_keys
+
+        for section, keys in unknown_extra_keys(cfg).items():
+            print(
+                f"warning: {section} keys not recognized by "
+                f"'{cfg.model.name if section == 'model.extra' else cfg.data.name if section == 'data.extra' else 'trainer'}': "
+                f"{', '.join(keys)} (typo? they will be ignored)",
+                file=sys.stderr,
+            )
+    except Exception:  # the check must never break a run
+        pass
+
+
 def _handle_validate(args: argparse.Namespace) -> int:
     try:
         cfg, _, _ = load_and_validate_config(args.config)
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    _warn_unknown_extras(cfg)
     if args.json:
         print(json.dumps({"valid": True, "config": args.config}))
     else:
@@ -791,6 +810,7 @@ def _handle_train(args: argparse.Namespace) -> int:
                 write_meta_json(run_dir, meta)
 
         initialize_registries()
+        _warn_unknown_extras(cfg)
         try:
             get_model_adapter(cfg.model.name)
             get_data_module(cfg.data.name)

@@ -1,0 +1,63 @@
+"""Typo detection for the ``extra`` escape hatches.
+
+The reference keeps ``extra: dict`` sections deliberately free-form
+(reference schemas.py:37,65,87) — but this framework hangs real knobs off
+them (loss_impl, z_loss, n_experts, globs, keep_last_k, ...), so a typo
+like ``los_impl`` silently no-ops. ``unknown_extra_keys`` compares each
+section's keys against what the resolved adapter / data module / trainer
+declares via ``known_extra_keys``; the CLI logs WARNINGS (never errors:
+user plugins may take keys we cannot know about).
+"""
+
+from __future__ import annotations
+
+from ..config.schemas import RunConfig
+
+# Knobs read from trainer.extra (training/trainer.py, training/checkpoint.py).
+TRAINER_EXTRA_KEYS = frozenset(
+    {"keep_last_k", "profile_start_step", "profile_num_steps"}
+)
+
+
+def unknown_extra_keys(cfg: RunConfig) -> dict[str, list[str]]:
+    """Best-effort ``{section: sorted unknown keys}`` for warning output.
+
+    Resolves the model adapter and data module from the registries; a
+    plugin that does not declare ``known_extra_keys`` (or an unknown
+    name) contributes nothing — this must never break validation.
+    """
+    out: dict[str, list[str]] = {}
+
+    def check(section: str, keys, known) -> None:
+        if known is None:
+            return
+        unknown = sorted(set(keys) - set(known))
+        if unknown:
+            out[section] = unknown
+
+    try:
+        from ..registry import get_model_adapter, initialize_registries
+
+        initialize_registries()
+        adapter_cls = get_model_adapter(cfg.model.name)
+        check(
+            "model.extra",
+            cfg.model.extra,
+            getattr(adapter_cls, "known_extra_keys", None),
+        )
+    except Exception:  # unknown plugin name etc. — other checks will report
+        pass
+    try:
+        from ..registry import get_data_module
+
+        data_cls = get_data_module(cfg.data.name)
+        check(
+            "data.extra", cfg.data.extra, getattr(data_cls, "known_extra_keys", None)
+        )
+    except Exception:
+        pass
+    check("trainer.extra", cfg.trainer.extra, TRAINER_EXTRA_KEYS)
+    return out
+
+
+__all__ = ["unknown_extra_keys", "TRAINER_EXTRA_KEYS"]

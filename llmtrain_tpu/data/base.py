@@ -35,6 +35,10 @@ class IndexedDataset(Protocol):
 class DataModule(ABC):
     """Prepares train/val datasets for a run."""
 
+    # Extra-dict keys this module understands (config/extras.py warns on
+    # others); None disables the check.
+    known_extra_keys: frozenset[str] | None = None
+
     @abstractmethod
     def setup(self, cfg: RunConfig, tokenizer: Any | None) -> None:
         """Load/tokenize/cache data. Called once before training."""

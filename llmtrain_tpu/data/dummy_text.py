@@ -54,6 +54,8 @@ class _DummyTextDataset:
 class DummyTextDataModule(DataModule):
     """Synthetic text data for dry-run smoke tests."""
 
+    known_extra_keys = frozenset()
+
     def __init__(self) -> None:
         self._train: _DummyTextDataset | None = None
         self._val: _DummyTextDataset | None = None

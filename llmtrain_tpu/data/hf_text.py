@@ -59,6 +59,8 @@ class TokenWindowDataset:
 class HFTextDataModule(DataModule):
     """Loads a HuggingFace text dataset and serves fixed token windows."""
 
+    known_extra_keys = frozenset()
+
     def __init__(self) -> None:
         self._cfg: RunConfig | None = None
         self._train: TokenWindowDataset | None = None

@@ -43,6 +43,8 @@ _DEFAULT_VAL_FRACTION = 0.01
 class LocalTextDataModule(DataModule):
     """Serves fixed token windows over a corpus of local text files."""
 
+    known_extra_keys = frozenset({"globs", "val_fraction", "format", "text_key"})
+
     def __init__(self) -> None:
         self._train: TokenWindowDataset | None = None
         self._val: TokenWindowDataset | None = None

@@ -299,6 +299,7 @@ def generate_text(
     max_new_tokens: int = 48,
     temperature: float = 0.8,
     top_k: int | None = 40,
+    top_p: float | None = None,
     seed: int = 1234,
 ) -> str:
     """Tokenize → sample → decode (the notebook ``generate_text`` contract)."""
@@ -311,6 +312,7 @@ def generate_text(
         rng=jax.random.key(seed),
         temperature=temperature,
         top_k=top_k,
+        top_p=top_p,
     )
     return tokenizer.decode([int(t) for t in out[0]])
 

@@ -30,6 +30,10 @@ Metrics = dict[str, jax.Array]
 class ModelAdapter(ABC):
     """Builds a Flax model + tokenizer and defines its training loss."""
 
+    # Extra-dict keys this adapter understands (config/extras.py warns on
+    # others). None disables the check for plugins with free-form extras.
+    known_extra_keys: frozenset[str] | None = None
+
     # True only for models that stack their layer dim on the "layers"
     # logical axis so a mesh `pipeline` axis can shard stages
     # (models/gpt_pipeline.py). The Trainer rejects pipeline > 1 otherwise.

@@ -50,6 +50,8 @@ class _TinyLM(nn.Module):
 class DummyGPTAdapter(ModelAdapter):
     """Tiny adapter for dry-run smoke tests."""
 
+    known_extra_keys = frozenset()
+
     def build_model(self, cfg: RunConfig) -> nn.Module:
         vocab_size = cfg.model.vocab_size or 128
         d_model = min(cfg.model.d_model or 128, 64)

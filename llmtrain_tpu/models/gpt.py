@@ -461,6 +461,8 @@ class GPT(nn.Module):
 class GPTAdapter(ModelAdapter):
     """Model adapter for the decoder-only GPT implementation."""
 
+    known_extra_keys = frozenset({"tokenizer", "loss_impl", "ce_chunk", "z_loss"})
+
     def build_model(self, cfg: RunConfig) -> nn.Module:
         vocab_size = cfg.model.vocab_size
         if vocab_size is None:

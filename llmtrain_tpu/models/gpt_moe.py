@@ -36,6 +36,10 @@ from .gpt import GPTAdapter
 class GPTMoEAdapter(GPTAdapter):
     """GPT with Mixture-of-Experts MLPs and expert parallelism."""
 
+    known_extra_keys = GPTAdapter.known_extra_keys | frozenset(
+        {"n_experts", "capacity_factor", "moe_aux_weight", "router_top_k"}
+    )
+
     def build_model(self, cfg: RunConfig):
         extra = cfg.model.extra
         n_experts = int(extra.get("n_experts", 0))

@@ -353,6 +353,16 @@ class PipelineGPTAdapter(ModelAdapter):
     """
 
     supports_pipeline = True
+    known_extra_keys = frozenset(
+        {
+            "tokenizer",
+            "loss_impl",
+            "ce_chunk",
+            "z_loss",
+            "pipeline_microbatches",
+            "pipeline_virtual_chunks",
+        }
+    )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
         vocab_size = cfg.model.vocab_size

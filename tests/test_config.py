@@ -140,3 +140,79 @@ def test_loader_validation_errors_are_structured(tmp_path):
         load_and_validate_config(path)
     errs = exc_info.value.errors
     assert errs and any("trainer" in e["loc"] for e in errs)
+
+
+class TestUnknownExtraWarnings:
+    """config/extras.py: typos in extra dicts warn (never error)."""
+
+    def _cfg(self, **extras):
+        from llmtrain_tpu.config.schemas import RunConfig
+
+        return RunConfig.model_validate(
+            {
+                "run": {"name": "x", "device": "cpu"},
+                "model": {
+                    "name": "gpt",
+                    "block_size": 8,
+                    "d_model": 16,
+                    "n_layers": 1,
+                    "n_heads": 4,
+                    "d_ff": 32,
+                    "vocab_size": 64,
+                    "extra": {"tokenizer": "byte", **extras.get("model", {})},
+                },
+                "data": {"name": "dummy_text", "extra": extras.get("data", {})},
+                "trainer": {
+                    "max_steps": 1,
+                    "micro_batch_size": 2,
+                    "warmup_steps": 0,
+                    "extra": extras.get("trainer", {}),
+                },
+                "mlflow": {"enabled": False},
+            }
+        )
+
+    def test_clean_config_has_no_unknowns(self):
+        from llmtrain_tpu.config.extras import unknown_extra_keys
+
+        assert unknown_extra_keys(self._cfg()) == {}
+
+    def test_typos_reported_per_section(self):
+        from llmtrain_tpu.config.extras import unknown_extra_keys
+
+        found = unknown_extra_keys(
+            self._cfg(
+                model={"los_impl": "chunked_ce"},
+                data={"globz": ["x"]},
+                trainer={"keep_last": 5},
+            )
+        )
+        assert found == {
+            "model.extra": ["los_impl"],
+            "data.extra": ["globz"],
+            "trainer.extra": ["keep_last"],
+        }
+
+    def test_known_keys_of_each_family(self):
+        from llmtrain_tpu.config.extras import unknown_extra_keys
+
+        cfg = self._cfg(model={"loss_impl": "chunked_ce", "ce_chunk": 64, "z_loss": 0.1})
+        assert unknown_extra_keys(cfg) == {}
+
+    def test_validate_cli_warns_but_exits_zero(self, tmp_path):
+        import subprocess
+        import sys
+
+        import yaml
+
+        cfg = self._cfg(model={"los_impl": "chunked_ce"})
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False))
+        proc = subprocess.run(
+            [sys.executable, "-m", "llmtrain_tpu", "validate", "--config", str(cfg_path)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "los_impl" in proc.stderr and "warning" in proc.stderr
