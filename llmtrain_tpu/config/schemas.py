@@ -54,7 +54,7 @@ class ModelConfig(BaseModel):
     dtype: Literal["float32", "bfloat16"] = "float32"
     param_dtype: Literal["float32", "bfloat16"] = "float32"
     remat: bool = False
-    attention: Literal["dense", "flash", "ring"] = "dense"
+    attention: Literal["dense", "flash", "ring", "ulysses"] = "dense"
     extra: dict[str, Any] = Field(default_factory=dict)
 
     model_config = _STRICT

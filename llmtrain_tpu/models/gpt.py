@@ -109,6 +109,12 @@ class CausalSelfAttention(nn.Module):
             from ..ops.ring_attention import ring_or_blockwise
 
             out = ring_or_blockwise(q, k, v, causal=True)
+        elif self.attention == "ulysses":
+            # All-to-all sequence parallelism (ops/ulysses_attention.py):
+            # the ring alternative — 2 all-to-alls instead of s ppermutes.
+            from ..ops.ulysses_attention import ulysses_or_blockwise
+
+            out = ulysses_or_blockwise(q, k, v, causal=True)
         else:
             out = dense_attention(
                 q,
@@ -481,7 +487,7 @@ class GPTAdapter(ModelAdapter):
         z_loss = float(cfg.model.extra.get("z_loss", 0.0))
         if z_loss < 0.0:
             raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
-        if cfg.model.attention in ("flash", "ring") and cfg.model.dropout > 0.0:
+        if cfg.model.attention in ("flash", "ring", "ulysses") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
                 "attention-probability dropout; set model.dropout to 0.0 or "

@@ -101,6 +101,27 @@ def _mesh_dim_axes(mesh: jax.sharding.Mesh) -> tuple:
     )
 
 
+def attention_shard_map(mesh: jax.sharding.Mesh, local_fn):
+    """Wrap a local-shard attention fn into a (q, k, v) shard_map over the
+    standard activation layout (``RING_DIM_AXES``): batch over
+    (data, fsdp), sequence over ``sequence``, heads over ``tensor``.
+    Shared by ring and ulysses (ops/ulysses_attention.py)."""
+    P = jax.sharding.PartitionSpec
+    spec = P(
+        *(
+            axes if len(axes) > 1 else (axes[0] if axes else None)
+            for axes in _mesh_dim_axes(mesh)
+        )
+    )
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -109,24 +130,9 @@ def ring_attention_sharded(
     *,
     causal: bool = True,
 ) -> jax.Array:
-    """shard_map wrapper: global (B, T, H, D) arrays over the named mesh.
-
-    Batch shards over (data, fsdp), sequence over ``sequence``, heads over
-    ``tensor`` (``RING_DIM_AXES``).
-    """
-    P = jax.sharding.PartitionSpec
-    spec = P(
-        *(
-            axes if len(axes) > 1 else (axes[0] if axes else None)
-            for axes in _mesh_dim_axes(mesh)
-        )
-    )
-    fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name="sequence", causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    """shard_map wrapper: global (B, T, H, D) arrays over the named mesh."""
+    fn = attention_shard_map(
+        mesh, functools.partial(ring_attention, axis_name="sequence", causal=causal)
     )
     return fn(q, k, v)
 

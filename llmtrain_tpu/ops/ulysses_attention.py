@@ -1,0 +1,127 @@
+"""Ulysses (all-to-all) sequence parallelism: the alternative to ring.
+
+New TPU capability beyond the reference (single-device attention only,
+reference models/gpt.py:56-69). Where ring attention keeps queries local
+and rotates K/V shards around the ``sequence`` axis (ops/ring_attention.py,
+one ppermute per step), Ulysses (DeepSpeed-Ulysses; see PAPERS.md)
+re-shards ONCE per attention: an all-to-all swaps the sharded dimension
+from sequence to heads (q/k/v stacked into one collective), every device
+runs exact attention over the FULL sequence for its ``H/s`` head slice,
+and a second all-to-all swaps back.
+
+Trade-off vs ring: 2 all-to-alls per attention (one for stacked q/k/v,
+one for the output) instead of ``s`` ppermutes of K/V — fewer, larger
+collectives (better for small ``s`` on fast ICI) — but it needs
+``local_heads % s == 0`` (heads AFTER tensor sharding), so it caps at
+H-way sequence sharding while ring scales to any ``s``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .blockwise_attention import blockwise_attention
+from .ring_attention import _dim_shards, attention_shard_map
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+) -> jax.Array:
+    """Local-shard Ulysses attention; must run inside shard_map.
+
+    q/k/v: (B, T_local, H, D) shards, contiguous along the global sequence
+    in axis order. Returns the (B, T_local, H, D) output shard.
+    """
+    s = jax.lax.psum(1, axis_name)
+    heads = q.shape[2]
+    if heads % s != 0:
+        raise ValueError(
+            f"ulysses needs local heads ({heads}) divisible by the "
+            f"sequence axis size ({s})"
+        )
+
+    # Collective 1: device i holds sequence shard i, all local heads; after
+    # the exchange it holds head-slice i for the FULL sequence, shards
+    # concatenated in axis order so positions line up globally. q/k/v ride
+    # one stacked all-to-all (axes shift by 1 for the stack dim).
+    qkv = jnp.stack((q, k, v))  # (3, B, T_local, H, D)
+    qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
+    qh, kh, vh = qkv[0], qkv[1], qkv[2]  # each (B, T, H/s, D)
+
+    out = blockwise_attention(qh, kh, vh, causal=causal)  # (B, T, H/s, D)
+    # Collective 2: back to sequence-sharded, all heads local.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """shard_map wrapper: global (B, T, H, D) arrays over the named mesh
+    (same activation layout as ring — ring_attention.attention_shard_map).
+    """
+    fn = attention_shard_map(
+        mesh,
+        functools.partial(ulysses_attention, axis_name="sequence", causal=causal),
+    )
+    return fn(q, k, v)
+
+
+def ulysses_or_blockwise(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
+) -> jax.Array:
+    """Route to Ulysses when an ambient mesh has a sequence axis > 1 and
+    every sharded dim divides (including local heads by the sequence
+    degree); otherwise fall back to single-device blockwise.
+    """
+    from ..parallel.sharding import ambient_mesh
+
+    mesh = ambient_mesh()
+    if (
+        mesh is not None
+        and "sequence" in mesh.axis_names
+        and mesh.shape["sequence"] > 1
+    ):
+        seq = mesh.shape["sequence"]
+        local_heads = q.shape[2] // _dim_shards(mesh, 2)
+        dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
+        if dims_ok and local_heads % seq == 0:
+            return ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        if q.shape[0] > 1:
+            # Batch-1 traces (the param-init probe, models/base.py:58) fall
+            # back silently by design; real batches losing sequence
+            # parallelism deserve a trace-time diagnostic.
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "ulysses attention falling back to single-device blockwise: "
+                "shape (B=%d, T=%d, H=%d) with mesh shards (batch %d, "
+                "sequence %d, heads %d) — needs every dim divisible AND "
+                "local heads divisible by the sequence degree",
+                q.shape[0],
+                q.shape[1],
+                q.shape[2],
+                _dim_shards(mesh, 0),
+                seq,
+                _dim_shards(mesh, 2),
+            )
+    return blockwise_attention(q, k, v, causal=causal)
+
+
+__all__ = [
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+    "ulysses_or_blockwise",
+]

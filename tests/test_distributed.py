@@ -251,3 +251,14 @@ class TestParallelismEquivalence:
         )
         assert abs(dense[0] - ring[0]) < 1e-5, (dense, ring)
         assert abs(dense[1] - ring[1]) < 5e-3, (dense, ring)
+
+    def test_ulysses_attention_matches_dense(self):
+        """Ulysses (all-to-all SP) computes the same training run as dense
+        attention on the same mesh — the exact-attention claim for the
+        second sequence-parallel scheme (ops/ulysses_attention.py)."""
+        dense = self._run({"data": 4, "sequence": 2}, micro_batch_size=16)
+        uly = self._run(
+            {"data": 4, "sequence": 2}, micro_batch_size=16, attention="ulysses"
+        )
+        assert abs(dense[0] - uly[0]) < 1e-5, (dense, uly)
+        assert abs(dense[1] - uly[1]) < 5e-3, (dense, uly)
