@@ -137,13 +137,25 @@ def ring_attention_sharded(
     return fn(q, k, v)
 
 
-def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
-    """Route to ring attention when an ambient mesh has a sequence axis > 1;
-    otherwise fall back to single-device blockwise (same math, no ring).
+def route_or_blockwise(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    scheme: str,
+    sharded_fn,
+    extra_predicate=None,
+):
+    """Shared route-or-fallback policy for sequence-parallel schemes.
 
-    Every dim the shard_map specs shard must divide evenly, or the fallback
-    is taken — notably batch=1 traces (param init uses a (1, block_size)
-    probe, models/base.py:46) can never shard over data×fsdp.
+    Routes to ``sharded_fn(q, k, v, mesh, causal=...)`` when an ambient
+    mesh has a sequence axis > 1, every sharded dim divides evenly, and
+    the optional ``extra_predicate(mesh, q)`` holds; otherwise falls back
+    to single-device blockwise. Batch-1 traces (the param-init probe,
+    ModelAdapter.init_params' (1, block_size) batch) fall back silently by
+    design; real batches losing sequence parallelism get a trace-time
+    warning.
     """
     mesh = _ambient_mesh()
     if (
@@ -151,19 +163,18 @@ def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool 
         and "sequence" in mesh.axis_names
         and mesh.shape["sequence"] > 1
     ):
-        if all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3)):
-            return ring_attention_sharded(q, k, v, mesh, causal=causal)
+        dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
+        if dims_ok and (extra_predicate is None or extra_predicate(mesh, q)):
+            return sharded_fn(q, k, v, mesh, causal=causal)
         if q.shape[0] > 1:
-            # Batch-1 traces (the param-init probe, models/base.py:46) fall
-            # back silently by design; real batches losing sequence
-            # parallelism deserve a trace-time diagnostic.
             from ..utils.logging import get_logger
 
             get_logger().warning(
-                "ring attention falling back to single-device blockwise: "
-                "shape (B=%d, T=%d, H=%d) not divisible by mesh shards "
-                "(batch %d, sequence %d, heads %d) — sequence parallelism "
-                "is DISABLED for this computation",
+                "%s attention falling back to single-device blockwise: "
+                "shape (B=%d, T=%d, H=%d) vs mesh shards (batch %d, "
+                "sequence %d, heads %d) — sequence parallelism is DISABLED "
+                "for this computation",
+                scheme,
                 q.shape[0],
                 q.shape[1],
                 q.shape[2],
@@ -172,6 +183,14 @@ def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool 
                 _dim_shards(mesh, 2),
             )
     return blockwise_attention(q, k, v, causal=causal)
+
+
+def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
+    """Ring attention when an ambient mesh shards the sequence; blockwise
+    otherwise (same math, no ring)."""
+    return route_or_blockwise(
+        q, k, v, causal=causal, scheme="ring", sharded_fn=ring_attention_sharded
+    )
 
 
 def _ambient_mesh() -> jax.sharding.Mesh | None:

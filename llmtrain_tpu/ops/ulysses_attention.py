@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .blockwise_attention import blockwise_attention
-from .ring_attention import _dim_shards, attention_shard_map
+from .ring_attention import _dim_shards, attention_shard_map, route_or_blockwise
 
 
 def ulysses_attention(
@@ -79,45 +79,28 @@ def ulysses_attention_sharded(
     return fn(q, k, v)
 
 
+def _local_heads_divide(mesh: jax.sharding.Mesh, q: jax.Array) -> bool:
+    """Ulysses' extra constraint: heads remaining after tensor sharding
+    must split across the sequence axis."""
+    local_heads = q.shape[2] // _dim_shards(mesh, 2)
+    return local_heads % mesh.shape["sequence"] == 0
+
+
 def ulysses_or_blockwise(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
 ) -> jax.Array:
-    """Route to Ulysses when an ambient mesh has a sequence axis > 1 and
-    every sharded dim divides (including local heads by the sequence
-    degree); otherwise fall back to single-device blockwise.
-    """
-    from ..parallel.sharding import ambient_mesh
-
-    mesh = ambient_mesh()
-    if (
-        mesh is not None
-        and "sequence" in mesh.axis_names
-        and mesh.shape["sequence"] > 1
-    ):
-        seq = mesh.shape["sequence"]
-        local_heads = q.shape[2] // _dim_shards(mesh, 2)
-        dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
-        if dims_ok and local_heads % seq == 0:
-            return ulysses_attention_sharded(q, k, v, mesh, causal=causal)
-        if q.shape[0] > 1:
-            # Batch-1 traces (the param-init probe, models/base.py:58) fall
-            # back silently by design; real batches losing sequence
-            # parallelism deserve a trace-time diagnostic.
-            from ..utils.logging import get_logger
-
-            get_logger().warning(
-                "ulysses attention falling back to single-device blockwise: "
-                "shape (B=%d, T=%d, H=%d) with mesh shards (batch %d, "
-                "sequence %d, heads %d) — needs every dim divisible AND "
-                "local heads divisible by the sequence degree",
-                q.shape[0],
-                q.shape[1],
-                q.shape[2],
-                _dim_shards(mesh, 0),
-                seq,
-                _dim_shards(mesh, 2),
-            )
-    return blockwise_attention(q, k, v, causal=causal)
+    """Ulysses when an ambient mesh shards the sequence and local heads
+    divide by the sequence degree; blockwise otherwise (shared policy:
+    ring_attention.route_or_blockwise)."""
+    return route_or_blockwise(
+        q,
+        k,
+        v,
+        causal=causal,
+        scheme="ulysses",
+        sharded_fn=ulysses_attention_sharded,
+        extra_predicate=_local_heads_divide,
+    )
 
 
 __all__ = [
