@@ -60,6 +60,13 @@ class CausalSelfAttention(nn.Module):
     attention: str = "dense"
     decode: bool = False  # autoregressive KV-cache mode (generation only)
     cache_len: int = 0  # KV-cache capacity; block_size when decode=True
+    # Grouped-query attention: K/V heads (0 = n_heads, classic MHA; 1 =
+    # MQA). Queries in group g attend the shared K/V head g. The decode
+    # cache stores only n_kv_heads — the serving-memory win; training
+    # paths broadcast K/V up to n_heads before attention, so flash/ring/
+    # ulysses kernels are unchanged. n_kv_heads == n_heads keeps the MHA
+    # fused-qkv parameter tree (checkpoint compatibility).
+    n_kv_heads: int = 0
 
     @nn.compact
     def __call__(
@@ -70,22 +77,60 @@ class CausalSelfAttention(nn.Module):
         deterministic: bool = True,
     ) -> jax.Array:
         head_dim = self.d_model // self.n_heads
+        kv_heads = self.n_kv_heads or self.n_heads
 
-        qkv = nn.DenseGeneral(
-            features=(3, self.n_heads, head_dim),
-            axis=-1,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("qkv", "heads", "kv")
-            ),
-            name="qkv_proj",
-        )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_heads == self.n_heads:
+            qkv = nn.DenseGeneral(
+                features=(3, self.n_heads, head_dim),
+                axis=-1,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("qkv", "heads", "kv")
+                ),
+                name="qkv_proj",
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            if self.n_heads % kv_heads != 0:
+                raise ValueError(
+                    f"n_heads ({self.n_heads}) must be divisible by "
+                    f"n_kv_heads ({kv_heads})"
+                )
+            q = nn.DenseGeneral(
+                features=(self.n_heads, head_dim),
+                axis=-1,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "heads", "kv")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("heads", "kv")
+                ),
+                name="q_proj",
+            )(x)
+            kv = nn.DenseGeneral(
+                features=(2, kv_heads, head_dim),
+                axis=-1,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("qkv", "heads", "kv")
+                ),
+                name="kv_proj",
+            )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]
         q = nn.with_logical_constraint(q, ("batch", "length", "act_heads", "act_kv"))
         k = nn.with_logical_constraint(k, ("batch", "length", "act_heads", "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
+
+        if not self.decode and kv_heads != self.n_heads:
+            # Training paths see full-width K/V (compute-equivalent GQA);
+            # the decode path keeps the narrow cache and broadcasts at read.
+            reps = self.n_heads // kv_heads
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
 
         if self.decode:
             # KV-cache decode: append this call's keys/values at the cache
@@ -155,18 +200,19 @@ class CausalSelfAttention(nn.Module):
         if self.cache_len <= 0:
             raise ValueError("decode=True requires cache_len > 0 (the block size)")
         batch, t, n_heads, head_dim = q.shape
+        kv_width = k.shape[2]  # n_kv_heads under GQA, else n_heads
         cached_key = self.variable(
             "cache",
             "cached_key",
             jnp.zeros,
-            (batch, self.cache_len, n_heads, head_dim),
+            (batch, self.cache_len, kv_width, head_dim),
             k.dtype,
         )
         cached_value = self.variable(
             "cache",
             "cached_value",
             jnp.zeros,
-            (batch, self.cache_len, n_heads, head_dim),
+            (batch, self.cache_len, kv_width, head_dim),
             v.dtype,
         )
         cache_index = self.variable(
@@ -183,6 +229,12 @@ class CausalSelfAttention(nn.Module):
         cache_index.value = idx + t
 
         keys, values = cached_key.value, cached_value.value
+        if keys.shape[2] != n_heads:
+            # Grouped-query decode: the cache holds n_kv_heads (the memory
+            # win); broadcast to the query head count only at read time.
+            reps = n_heads // keys.shape[2]
+            keys = jnp.repeat(keys, reps, axis=2)
+            values = jnp.repeat(values, reps, axis=2)
         scale = 1.0 / math.sqrt(head_dim)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
         scores = scores.astype(jnp.float32)
@@ -243,6 +295,7 @@ class TransformerBlock(nn.Module):
     attention: str = "dense"
     decode: bool = False
     cache_len: int = 0
+    n_kv_heads: int = 0  # grouped-query attention (see CausalSelfAttention)
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -273,6 +326,7 @@ class TransformerBlock(nn.Module):
             attention=self.attention,
             decode=self.decode,
             cache_len=self.cache_len,
+            n_kv_heads=self.n_kv_heads,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -347,6 +401,9 @@ class GPT(nn.Module):
     # PaLM z-loss coefficient: adds z_loss * log(Z)^2 per token to the LM
     # objective (both loss paths). 0 = off (reference behavior).
     z_loss: float = 0.0
+    # Grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = MQA). The
+    # decode cache shrinks by n_heads/n_kv_heads (see CausalSelfAttention).
+    n_kv_heads: int = 0
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -426,6 +483,7 @@ class GPT(nn.Module):
                 attention=self.attention,
                 decode=self.decode,
                 cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
+                n_kv_heads=self.n_kv_heads,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
@@ -467,7 +525,9 @@ class GPT(nn.Module):
 class GPTAdapter(ModelAdapter):
     """Model adapter for the decoder-only GPT implementation."""
 
-    known_extra_keys = frozenset({"tokenizer", "loss_impl", "ce_chunk", "z_loss"})
+    known_extra_keys = frozenset(
+        {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads"}
+    )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
         vocab_size = cfg.model.vocab_size
@@ -487,6 +547,14 @@ class GPTAdapter(ModelAdapter):
         z_loss = float(cfg.model.extra.get("z_loss", 0.0))
         if z_loss < 0.0:
             raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
+        n_kv_heads = int(cfg.model.extra.get("n_kv_heads", 0))
+        if n_kv_heads < 0:
+            raise ValueError(f"model.extra.n_kv_heads must be >= 0, got {n_kv_heads}")
+        if n_kv_heads and cfg.model.n_heads % n_kv_heads != 0:
+            raise ValueError(
+                f"model.n_heads ({cfg.model.n_heads}) must be divisible by "
+                f"model.extra.n_kv_heads ({n_kv_heads})"
+            )
         if cfg.model.attention in ("flash", "ring", "ulysses") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
@@ -509,6 +577,7 @@ class GPTAdapter(ModelAdapter):
             loss_impl=loss_impl,
             ce_chunk=ce_chunk,
             z_loss=z_loss,
+            n_kv_heads=n_kv_heads,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
@@ -518,6 +587,22 @@ class GPTAdapter(ModelAdapter):
         from ..data.tokenizers import build_tokenizer
 
         return build_tokenizer(cfg.model.extra.get("tokenizer", "gpt2"))
+
+    def validate_mesh(self, cfg: RunConfig, mesh: Any) -> None:
+        """Mesh-dependent checks the Trainer runs before compiling.
+
+        GQA's narrow K/V heads carry the same ``heads`` logical axis as
+        queries, so they must divide over the ``tensor`` mesh axis or
+        pjit fails with an opaque sharding error.
+        """
+        n_kv_heads = int(cfg.model.extra.get("n_kv_heads", 0))
+        tp = int(mesh.shape.get("tensor", 1))
+        if n_kv_heads and tp > 1 and n_kv_heads % tp != 0:
+            raise ValueError(
+                f"model.extra.n_kv_heads ({n_kv_heads}) must be divisible "
+                f"by the mesh tensor axis ({tp}) — K/V heads shard over "
+                "tensor parallelism like query heads do"
+            )
 
     def compute_loss_components(
         self,

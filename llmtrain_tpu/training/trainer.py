@@ -108,6 +108,12 @@ class Trainer:
                 "pipeline stages; use a pipeline-capable model "
                 "(e.g. 'gpt_pipeline') or set pipeline to 1"
             )
+        # Adapter-specific mesh compatibility (e.g. GQA's n_kv_heads must
+        # shard over the tensor axis) — fail with a clear message instead
+        # of an opaque pjit sharding error at compile time.
+        validate_mesh = getattr(self._adapter, "validate_mesh", None)
+        if validate_mesh is not None:
+            validate_mesh(cfg, self._mesh)
         self._rules = list(DEFAULT_LOGICAL_AXIS_RULES)
         self._dp = data_parallel_degree(self._mesh)
         self._global_micro = cfg.trainer.micro_batch_size * self._dp

@@ -143,3 +143,182 @@ def test_remat_matches_no_remat():
     out_a = base.apply({"params": params}, tokens, deterministic=True)
     out_b = rem.apply({"params": params}, tokens, deterministic=True)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
+
+
+class TestGroupedQueryAttention:
+    """GQA (model.extra.n_kv_heads): narrow K/V heads shared across query
+    groups; the decode cache stores only n_kv_heads."""
+
+    def _model(self, n_kv_heads, **kw):
+        return GPT(
+            vocab_size=64, block_size=16, d_model=32, n_layers=2, n_heads=4,
+            d_ff=64, dropout=0.0, n_kv_heads=n_kv_heads, **kw,
+        )
+
+    def _params(self, model):
+        from flax.linen import meta as nn_meta
+
+        ids = jnp.zeros((1, 16), jnp.int32)
+        return nn_meta.unbox(model.init(jax.random.key(0), ids, deterministic=True))[
+            "params"
+        ]
+
+    def test_mha_param_tree_unchanged(self):
+        """n_kv_heads=0 (and ==n_heads) keeps the fused qkv_proj tree so
+        existing checkpoints still load."""
+        for kvh in (0, 4):
+            params = self._params(self._model(kvh))
+            attn = params["block_0"]["attn"]
+            assert "qkv_proj" in attn and "q_proj" not in attn
+
+    def test_gqa_param_tree_and_shapes(self):
+        params = self._params(self._model(2))
+        attn = params["block_0"]["attn"]
+        assert "qkv_proj" not in attn
+        assert attn["q_proj"]["kernel"].shape == (32, 4, 8)
+        assert attn["kv_proj"]["kernel"].shape == (32, 2, 2, 8)
+
+    @pytest.mark.parametrize("kvh", [1, 2], ids=["mqa", "gqa2"])
+    def test_causality_invariance(self, kvh):
+        """Perturbing tokens after position t leaves logits <= t unchanged
+        (the reference's flagship invariant, test_gpt_model.py:144-175)."""
+        model = self._model(kvh)
+        params = self._params(model)
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 64, (2, 16))
+        t = 7
+        pert = ids.copy()
+        pert[:, t + 1 :] = rng.integers(0, 64, (2, 16 - t - 1))
+        a = model.apply({"params": params}, jnp.asarray(ids, jnp.int32), deterministic=True)
+        b = model.apply({"params": params}, jnp.asarray(pert, jnp.int32), deterministic=True)
+        np.testing.assert_allclose(
+            np.asarray(a[:, : t + 1]), np.asarray(b[:, : t + 1]), atol=1e-6
+        )
+
+    def test_decode_cache_stores_narrow_kv(self):
+        model = self._model(1).for_decoding(cache_len=8)
+        variables = model.init(
+            jax.random.key(0), jnp.zeros((2, 1), jnp.int32), deterministic=True
+        )
+        cache_shape = variables["cache"]["block_0"]["attn"]["cached_key"].shape
+        assert cache_shape == (2, 8, 1, 8)  # n_kv_heads=1, not n_heads=4
+
+    @pytest.mark.parametrize("kvh", [1, 2], ids=["mqa", "gqa2"])
+    def test_cached_decode_matches_windowed(self, kvh):
+        """The narrow-cache decode path equals the full re-forward path —
+        the GQA twin of the MHA equivalence test (test_generation.py)."""
+        from llmtrain_tpu.generation import generate
+
+        model = self._model(kvh)
+        params = self._params(model)
+        prompt = np.asarray([[3, 1, 4, 1, 5]], np.int32)
+        cached = generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0, use_cache=True
+        )
+        windowed = generate(
+            model, params, prompt, max_new_tokens=8, temperature=0.0, use_cache=False
+        )
+        np.testing.assert_array_equal(cached, windowed)
+
+    def test_training_loss_decreases(self):
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        initialize_registries()
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "gqa", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "gpt",
+                    "block_size": 8,
+                    "d_model": 16,
+                    "n_layers": 1,
+                    "n_heads": 4,
+                    "d_ff": 32,
+                    "dropout": 0.0,
+                    "vocab_size": 64,
+                    "extra": {"tokenizer": "byte", "n_kv_heads": 2},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": 10,
+                    "micro_batch_size": 2,
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 2,
+                    "log_every_steps": 5,
+                    "eval_every_steps": 10,
+                    "save_every_steps": 10,
+                },
+                "mlflow": {"enabled": False},
+            }
+        )
+        trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+        result = trainer.fit()
+        assert result.final_loss < result.first_step_loss
+
+    def test_invalid_n_kv_heads_rejected(self):
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.models.gpt import GPTAdapter
+
+        def cfg(kvh):
+            return RunConfig.model_validate(
+                {
+                    "run": {"name": "x", "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 8, "d_model": 16,
+                        "n_layers": 1, "n_heads": 4, "d_ff": 32,
+                        "vocab_size": 64,
+                        "extra": {"tokenizer": "byte", "n_kv_heads": kvh},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                }
+            )
+
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            GPTAdapter().build_model(cfg(3))  # 4 % 3 != 0
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            GPTAdapter().build_model(cfg(-1))
+
+    def test_tp_mesh_incompatible_kv_heads_rejected_loudly(self):
+        """MQA (n_kv_heads=1) on a tensor=2 mesh must fail with a clear
+        message at Trainer construction, not an opaque pjit sharding error
+        at compile time; kv_heads >= tp shards fine."""
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        initialize_registries()
+
+        def cfg(kvh):
+            return RunConfig.model_validate(
+                {
+                    "run": {"name": "gqa-tp", "seed": 0, "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 8, "d_model": 32,
+                        "n_layers": 1, "n_heads": 4, "d_ff": 64,
+                        "dropout": 0.0, "vocab_size": 64,
+                        "extra": {"tokenizer": "byte", "n_kv_heads": kvh},
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {
+                        "max_steps": 1, "micro_batch_size": 2,
+                        "grad_accum_steps": 1, "warmup_steps": 0,
+                        "log_every_steps": 1, "eval_every_steps": 1,
+                        "save_every_steps": 1,
+                    },
+                    "distributed": {"mesh": {"tensor": 2, "data": 4}},
+                    "mlflow": {"enabled": False},
+                }
+            )
+
+        with pytest.raises(ValueError, match="divisible by the mesh tensor axis"):
+            Trainer(cfg(1), run_dir=None, tracker=NullTracker())
+        result = Trainer(cfg(2), run_dir=None, tracker=NullTracker()).fit(
+            max_steps_override=1
+        )
+        assert result.final_step == 1
