@@ -675,7 +675,9 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 params,
                 stacked,
                 max_new_tokens=args.max_new_tokens,
-                rng=jax.random.key(args.seed),
+                # Fold the length-group in so different groups don't draw
+                # from identical sample streams at each decode step.
+                rng=jax.random.fold_in(jax.random.key(args.seed), tp),
                 temperature=args.temperature,
                 top_k=args.top_k,  # generate() maps <=0 to "disabled"
                 top_p=args.top_p,

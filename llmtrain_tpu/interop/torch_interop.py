@@ -1,33 +1,48 @@
-"""GPT parameter conversion to/from a torch-layout state dict.
+"""GPT parameter conversion to/from the reference torch GPT's state dict.
 
-The torch mirror architecture and the exact layout transforms are the
-ones proven numerically equivalent in tests/test_torch_parity.py (logits
-2e-5, gradients 1e-4, optimizer trajectory 3e-5 vs the reference-spec
-torch GPT): flax Dense kernels are (in, out) vs torch Linear (out, in);
-the fused qkv DenseGeneral kernel (D, 3, H, hd) flattens C-order so
-torch's row-chunk(3) recovers q/k/v; out_proj (H, hd, D) contracts in
-the same C-order as torch's post-attention reshape.
+The exported dict uses the reference model's ACTUAL module names
+(reference src/llmtrain/models/gpt.py:27-146), so
+``GPT.from_config(cfg); model.load_state_dict(torch.load(path))`` works
+strict=True on the reference implementation:
 
-State-dict naming (the mirror's):
-
-    tok.weight, pos.weight,
-    blocks.{i}.ln_1.{weight,bias}, blocks.{i}.qkv.{weight,bias},
-    blocks.{i}.out_proj.{weight,bias}, blocks.{i}.ln_2.{weight,bias},
+    token_embedding.weight, position_embedding.weight,
+    blocks.{i}.ln_1.{weight,bias},
+    blocks.{i}.attn.qkv_proj.{weight,bias},
+    blocks.{i}.attn.out_proj.{weight,bias},
+    blocks.{i}.attn.causal_mask          (persistent bool buffer,
+                                          reference gpt.py:32-33),
+    blocks.{i}.ln_2.{weight,bias},
     blocks.{i}.mlp_fc.{weight,bias}, blocks.{i}.mlp_proj.{weight,bias},
-    ln_f.{weight,bias}, lm_head.weight (untied models only)
+    ln_f.{weight,bias},
+    lm_head.weight                       (ALWAYS — tied models share the
+                                          tensor with token_embedding,
+                                          reference gpt.py:143-146)
 
+The layout transforms are the ones proven numerically equivalent in
+tests/test_torch_parity.py (logits 2e-5, gradients 1e-4, optimizer
+trajectory 3e-5 vs the reference-spec torch mirror): flax Dense kernels
+are (in, out) vs torch Linear (out, in); the fused qkv DenseGeneral
+kernel (D, 3, H, hd) flattens C-order so torch's row-chunk(3) recovers
+q/k/v; out_proj (H, hd, D) contracts in the same C-order as torch's
+post-attention reshape.
+
+Import accepts the same naming, tolerates the tied ``lm_head.weight``
+duplicate, and ignores the deterministic ``causal_mask`` buffers.
 Conversion is pure numpy — torch is only needed by callers that
 ``torch.save``/``torch.load`` the result (the export-checkpoint CLI).
-All tensors are exported in float32.
+All float tensors are exported in float32.
 """
 
 from __future__ import annotations
 
+import re
 from typing import Any
 
 import numpy as np
 
 Params = Any  # nested dict pytree of arrays
+
+_CAUSAL_MASK_RE = re.compile(r"^blocks\.\d+\.attn\.causal_mask$")
 
 
 def _np(a) -> np.ndarray:
@@ -35,7 +50,7 @@ def _np(a) -> np.ndarray:
 
 
 def params_to_torch_state_dict(params: Params) -> dict[str, np.ndarray]:
-    """Flax GPT params (models/gpt.py tree) → torch-layout state dict."""
+    """Flax GPT params (models/gpt.py tree) → reference torch state dict."""
     for required in ("token_embedding", "position_embedding", "ln_f"):
         if required not in params:
             raise ValueError(
@@ -43,25 +58,42 @@ def params_to_torch_state_dict(params: Params) -> dict[str, np.ndarray]:
                 "GPT tree is supported (model.name 'gpt')"
             )
     sd: dict[str, np.ndarray] = {
-        "tok.weight": _np(params["token_embedding"]["embedding"]),
-        "pos.weight": _np(params["position_embedding"]["embedding"]),
+        "token_embedding.weight": _np(params["token_embedding"]["embedding"]),
+        "position_embedding.weight": _np(params["position_embedding"]["embedding"]),
         "ln_f.weight": _np(params["ln_f"]["scale"]),
         "ln_f.bias": _np(params["ln_f"]["bias"]),
     }
-    d = sd["tok.weight"].shape[1]
+    block_size, d = sd["position_embedding.weight"].shape
+    # The reference registers the causal mask as a persistent buffer
+    # (gpt.py:32-33), so strict load_state_dict expects it per block.
+    causal_mask = np.triu(
+        np.ones((block_size, block_size), dtype=bool), k=1
+    ).reshape(1, 1, block_size, block_size)
     i = 0
     while f"block_{i}" in params:
         p = params[f"block_{i}"]
         att = p["attn"]
+        if "q_proj" in att or "kv_proj" in att:
+            raise ValueError(
+                "GQA/MQA checkpoints (model.extra.n_kv_heads) split the "
+                "attention projection into q_proj/kv_proj, which has no "
+                "counterpart in the reference torch GPT's fused qkv_proj — "
+                "export is only supported for full multi-head attention"
+            )
+        if "qkv_proj" not in att:
+            raise ValueError(
+                f"block_{i}.attn has no qkv_proj; not a models/gpt.py GPT tree"
+            )
         pre = f"blocks.{i}"
         sd[f"{pre}.ln_1.weight"] = _np(p["ln_1"]["scale"])
         sd[f"{pre}.ln_1.bias"] = _np(p["ln_1"]["bias"])
         sd[f"{pre}.ln_2.weight"] = _np(p["ln_2"]["scale"])
         sd[f"{pre}.ln_2.bias"] = _np(p["ln_2"]["bias"])
-        sd[f"{pre}.qkv.weight"] = _np(att["qkv_proj"]["kernel"]).reshape(d, 3 * d).T
-        sd[f"{pre}.qkv.bias"] = _np(att["qkv_proj"]["bias"]).reshape(3 * d)
-        sd[f"{pre}.out_proj.weight"] = _np(att["out_proj"]["kernel"]).reshape(d, d).T
-        sd[f"{pre}.out_proj.bias"] = _np(att["out_proj"]["bias"])
+        sd[f"{pre}.attn.qkv_proj.weight"] = _np(att["qkv_proj"]["kernel"]).reshape(d, 3 * d).T
+        sd[f"{pre}.attn.qkv_proj.bias"] = _np(att["qkv_proj"]["bias"]).reshape(3 * d)
+        sd[f"{pre}.attn.out_proj.weight"] = _np(att["out_proj"]["kernel"]).reshape(d, d).T
+        sd[f"{pre}.attn.out_proj.bias"] = _np(att["out_proj"]["bias"])
+        sd[f"{pre}.attn.causal_mask"] = causal_mask
         sd[f"{pre}.mlp_fc.weight"] = _np(p["mlp_fc"]["kernel"]).T
         sd[f"{pre}.mlp_fc.bias"] = _np(p["mlp_fc"]["bias"])
         sd[f"{pre}.mlp_proj.weight"] = _np(p["mlp_proj"]["kernel"]).T
@@ -71,17 +103,25 @@ def params_to_torch_state_dict(params: Params) -> dict[str, np.ndarray]:
         raise ValueError("params contain no block_0; not a models/gpt.py GPT tree")
     if "lm_head" in params:
         sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    else:
+        # Tied model: the reference still materializes lm_head.weight in
+        # its state dict (the tensor is shared, gpt.py:145-146).
+        sd["lm_head.weight"] = sd["token_embedding.weight"]
     return sd
 
 
 def params_from_torch_state_dict(
     sd: dict[str, Any], template: Params
 ) -> Params:
-    """torch-layout state dict → flax GPT params shaped like ``template``.
+    """Reference torch state dict → flax GPT params shaped like ``template``.
 
     ``template`` (e.g. a fresh ``adapter.init_params`` tree) supplies the
     tree structure, dtypes, and expected shapes; every template leaf must
-    be present in ``sd`` (missing/mismatched keys raise).
+    be present in ``sd`` (missing/mismatched keys raise). The reference's
+    ``causal_mask`` buffers are ignored, and for tied templates the
+    mandatory ``lm_head.weight`` duplicate is accepted iff it matches
+    ``token_embedding.weight`` (a differing head means the source model
+    was untied and cannot load into a tied template).
     """
     import jax.numpy as jnp
 
@@ -101,8 +141,14 @@ def params_from_torch_state_dict(
 
     d = np.shape(template["token_embedding"]["embedding"])[1]
     out: dict[str, Any] = {
-        "token_embedding": {"embedding": put("tok.weight", template["token_embedding"]["embedding"])},
-        "position_embedding": {"embedding": put("pos.weight", template["position_embedding"]["embedding"])},
+        "token_embedding": {
+            "embedding": put("token_embedding.weight", template["token_embedding"]["embedding"])
+        },
+        "position_embedding": {
+            "embedding": put(
+                "position_embedding.weight", template["position_embedding"]["embedding"]
+            )
+        },
         "ln_f": {
             "scale": put("ln_f.weight", template["ln_f"]["scale"]),
             "bias": put("ln_f.bias", template["ln_f"]["bias"]),
@@ -113,6 +159,13 @@ def params_from_torch_state_dict(
         t = template[f"block_{i}"]
         pre = f"blocks.{i}"
         att_t = t["attn"]
+        if "qkv_proj" not in att_t:
+            raise ValueError(
+                "template uses split q_proj/kv_proj attention (GQA/MQA, "
+                "model.extra.n_kv_heads) — the reference torch GPT has no "
+                "such checkpoint format; import requires full multi-head "
+                "attention"
+            )
         h, hd = np.shape(att_t["qkv_proj"]["kernel"])[2:4]
         out[f"block_{i}"] = {
             "ln_1": {
@@ -126,23 +179,23 @@ def params_from_torch_state_dict(
             "attn": {
                 "qkv_proj": {
                     "kernel": put(
-                        f"{pre}.qkv.weight",
+                        f"{pre}.attn.qkv_proj.weight",
                         att_t["qkv_proj"]["kernel"],
                         lambda a: a.T.reshape(d, 3, h, hd),
                     ),
                     "bias": put(
-                        f"{pre}.qkv.bias",
+                        f"{pre}.attn.qkv_proj.bias",
                         att_t["qkv_proj"]["bias"],
                         lambda a: a.reshape(3, h, hd),
                     ),
                 },
                 "out_proj": {
                     "kernel": put(
-                        f"{pre}.out_proj.weight",
+                        f"{pre}.attn.out_proj.weight",
                         att_t["out_proj"]["kernel"],
                         lambda a: a.T.reshape(h, hd, d),
                     ),
-                    "bias": put(f"{pre}.out_proj.bias", att_t["out_proj"]["bias"]),
+                    "bias": put(f"{pre}.attn.out_proj.bias", att_t["out_proj"]["bias"]),
                 },
             },
             "mlp_fc": {
@@ -159,6 +212,25 @@ def params_from_torch_state_dict(
         out["lm_head"] = {
             "kernel": put("lm_head.weight", template["lm_head"]["kernel"], lambda a: a.T)
         }
+    elif "lm_head.weight" in sd:
+        # Tied template: the reference always emits the shared tensor
+        # under lm_head.weight too. Accept it only if it really is the
+        # tied duplicate.
+        head = np.asarray(sd["lm_head.weight"], dtype=np.float32)
+        # Compare against the RAW sd value, not the template-dtype-cast
+        # tree — a bf16 param_dtype would otherwise fail equality for a
+        # genuinely tied f32 checkpoint.
+        tok = np.asarray(sd["token_embedding.weight"], dtype=np.float32)
+        if head.shape != tok.shape or not np.array_equal(head, tok):
+            raise ValueError(
+                "state dict's lm_head.weight differs from "
+                "token_embedding.weight: the source model was untied, but "
+                "the target config has model.tie_embeddings=true"
+            )
+        consumed.add("lm_head.weight")
+    # The causal-mask buffers are deterministic functions of block_size;
+    # nothing to import.
+    consumed.update(k for k in sd if _CAUSAL_MASK_RE.match(k))
     extra = set(template) - set(out)
     if extra:
         raise ValueError(
@@ -167,9 +239,8 @@ def params_from_torch_state_dict(
         )
     unconsumed = set(sd) - consumed
     if unconsumed:
-        # Silently dropping weights (deeper torch model, untied head into a
-        # tied template, ...) would import "successfully" and then produce
-        # different logits than the source model.
+        # Silently dropping weights (deeper torch model, ...) would import
+        # "successfully" and then produce different logits than the source.
         raise ValueError(
             f"state dict has weights the template cannot hold: "
             f"{sorted(unconsumed)[:8]}{'...' if len(unconsumed) > 8 else ''} "

@@ -41,20 +41,21 @@ from llmtrain_tpu.models.gpt import GPT  # noqa: E402
 V, T, D, L, H, FF = 97, 16, 32, 2, 4, 64
 
 
-class _TorchBlock(tnn.Module):
+class _TorchAttn(tnn.Module):
+    """Mirror of the reference CausalSelfAttention's module surface
+    (gpt.py:27-33): fused qkv_proj/out_proj Linears plus the persistent
+    causal_mask buffer — so state_dict keys match the reference's."""
+
     def __init__(self) -> None:
         super().__init__()
-        self.ln_1 = tnn.LayerNorm(D, eps=1e-6)
-        self.qkv = tnn.Linear(D, 3 * D)
+        self.qkv_proj = tnn.Linear(D, 3 * D)
         self.out_proj = tnn.Linear(D, D)
-        self.ln_2 = tnn.LayerNorm(D, eps=1e-6)
-        self.mlp_fc = tnn.Linear(D, FF)
-        self.mlp_proj = tnn.Linear(FF, D)
+        causal = torch.triu(torch.ones(T, T, dtype=torch.bool), diagonal=1)
+        self.register_buffer("causal_mask", causal.view(1, 1, T, T))
 
     def forward(self, x: torch.Tensor) -> torch.Tensor:
         b, t, _ = x.shape
-        h = self.ln_1(x)
-        q, k, v = self.qkv(h).chunk(3, dim=-1)
+        q, k, v = self.qkv_proj(x).chunk(3, dim=-1)
         hd = D // H
 
         def heads(a: torch.Tensor) -> torch.Tensor:
@@ -62,11 +63,25 @@ class _TorchBlock(tnn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         scores = (q @ k.transpose(-2, -1)) / math.sqrt(hd)
-        causal = torch.tril(torch.ones(t, t, dtype=torch.bool))
-        scores = scores.masked_fill(~causal, torch.finfo(scores.dtype).min)
+        scores = scores.masked_fill(
+            self.causal_mask[:, :, :t, :t], torch.finfo(scores.dtype).min
+        )
         att = F.softmax(scores, dim=-1) @ v  # (B, H, T, hd)
         att = att.transpose(1, 2).reshape(b, t, D)
-        x = x + self.out_proj(att)
+        return self.out_proj(att)
+
+
+class _TorchBlock(tnn.Module):
+    def __init__(self) -> None:
+        super().__init__()
+        self.ln_1 = tnn.LayerNorm(D, eps=1e-6)
+        self.attn = _TorchAttn()
+        self.ln_2 = tnn.LayerNorm(D, eps=1e-6)
+        self.mlp_fc = tnn.Linear(D, FF)
+        self.mlp_proj = tnn.Linear(FF, D)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        x = x + self.attn(self.ln_1(x))
         h = self.ln_2(x)
         h = self.mlp_proj(F.gelu(self.mlp_fc(h), approximate="none"))
         return x + h
@@ -75,22 +90,24 @@ class _TorchBlock(tnn.Module):
 class _TorchGPT(tnn.Module):
     def __init__(self, tie: bool) -> None:
         super().__init__()
-        self.tok = tnn.Embedding(V, D)
-        self.pos = tnn.Embedding(T, D)
+        self.token_embedding = tnn.Embedding(V, D)
+        self.position_embedding = tnn.Embedding(T, D)
         self.blocks = tnn.ModuleList(_TorchBlock() for _ in range(L))
         self.ln_f = tnn.LayerNorm(D, eps=1e-6)
         self.tie = tie
-        if not tie:
-            self.lm_head = tnn.Linear(D, V, bias=False)
+        # Like the reference (gpt.py:143-146): lm_head always exists and
+        # tying shares the tensor, so lm_head.weight is always in the
+        # state dict.
+        self.lm_head = tnn.Linear(D, V, bias=False)
+        if tie:
+            self.lm_head.weight = self.token_embedding.weight
 
     def forward(self, ids: torch.Tensor) -> torch.Tensor:
         t = ids.shape[1]
-        x = self.tok(ids) + self.pos(torch.arange(t))[None]
+        x = self.token_embedding(ids) + self.position_embedding(torch.arange(t))[None]
         for blk in self.blocks:
             x = blk(x)
-        x = self.ln_f(x)
-        w = self.tok.weight if self.tie else self.lm_head.weight
-        return F.linear(x, w)
+        return self.lm_head(self.ln_f(x))
 
 
 def _to_torch(a: jax.Array) -> torch.Tensor:
@@ -108,8 +125,10 @@ def _transplant(params: dict, model: _TorchGPT) -> None:
     as torch's ``reshape(b, t, D)`` after the head transpose.
     """
     with torch.no_grad():
-        model.tok.weight.copy_(_to_torch(params["token_embedding"]["embedding"]))
-        model.pos.weight.copy_(_to_torch(params["position_embedding"]["embedding"]))
+        model.token_embedding.weight.copy_(_to_torch(params["token_embedding"]["embedding"]))
+        model.position_embedding.weight.copy_(
+            _to_torch(params["position_embedding"]["embedding"])
+        )
         for i, blk in enumerate(model.blocks):
             p = params[f"block_{i}"]
             blk.ln_1.weight.copy_(_to_torch(p["ln_1"]["scale"]))
@@ -117,12 +136,14 @@ def _transplant(params: dict, model: _TorchGPT) -> None:
             blk.ln_2.weight.copy_(_to_torch(p["ln_2"]["scale"]))
             blk.ln_2.bias.copy_(_to_torch(p["ln_2"]["bias"]))
             att = p["attn"]
-            blk.qkv.weight.copy_(_to_torch(att["qkv_proj"]["kernel"]).reshape(D, 3 * D).T)
-            blk.qkv.bias.copy_(_to_torch(att["qkv_proj"]["bias"]).reshape(3 * D))
-            blk.out_proj.weight.copy_(
+            blk.attn.qkv_proj.weight.copy_(
+                _to_torch(att["qkv_proj"]["kernel"]).reshape(D, 3 * D).T
+            )
+            blk.attn.qkv_proj.bias.copy_(_to_torch(att["qkv_proj"]["bias"]).reshape(3 * D))
+            blk.attn.out_proj.weight.copy_(
                 _to_torch(att["out_proj"]["kernel"]).reshape(D, D).T
             )
-            blk.out_proj.bias.copy_(_to_torch(att["out_proj"]["bias"]))
+            blk.attn.out_proj.bias.copy_(_to_torch(att["out_proj"]["bias"]))
             blk.mlp_fc.weight.copy_(_to_torch(p["mlp_fc"]["kernel"]).T)
             blk.mlp_fc.bias.copy_(_to_torch(p["mlp_fc"]["bias"]))
             blk.mlp_proj.weight.copy_(_to_torch(p["mlp_proj"]["kernel"]).T)
@@ -239,14 +260,22 @@ def test_gradients_match_torch_mirror():
             rtol=1e-4,
         )
 
-    close(flax_grads["token_embedding"]["embedding"], mirror.tok.weight)
-    close(flax_grads["position_embedding"]["embedding"], mirror.pos.weight)
+    close(flax_grads["token_embedding"]["embedding"], mirror.token_embedding.weight)
+    close(flax_grads["position_embedding"]["embedding"], mirror.position_embedding.weight)
     close(flax_grads["ln_f"]["scale"], mirror.ln_f.weight)
     for i, blk in enumerate(mirror.blocks):
         g = flax_grads[f"block_{i}"]
-        close(g["attn"]["qkv_proj"]["kernel"], blk.qkv.weight, lambda a: a.reshape(D, 3 * D).T)
-        close(g["attn"]["qkv_proj"]["bias"], blk.qkv.bias, lambda a: a.reshape(3 * D))
-        close(g["attn"]["out_proj"]["kernel"], blk.out_proj.weight, lambda a: a.reshape(D, D).T)
+        close(
+            g["attn"]["qkv_proj"]["kernel"],
+            blk.attn.qkv_proj.weight,
+            lambda a: a.reshape(D, 3 * D).T,
+        )
+        close(g["attn"]["qkv_proj"]["bias"], blk.attn.qkv_proj.bias, lambda a: a.reshape(3 * D))
+        close(
+            g["attn"]["out_proj"]["kernel"],
+            blk.attn.out_proj.weight,
+            lambda a: a.reshape(D, D).T,
+        )
         close(g["mlp_fc"]["kernel"], blk.mlp_fc.weight, lambda a: a.T)
         close(g["mlp_proj"]["kernel"], blk.mlp_proj.weight, lambda a: a.T)
         close(g["ln_1"]["scale"], blk.ln_1.weight)
