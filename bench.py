@@ -354,7 +354,10 @@ def _run(
                 "vocab_size": vocab,
                 "dtype": "bfloat16" if on_tpu else "float32",
                 "attention": attention,
-                "extra": {"loss_impl": loss_impl},
+                # dummy_text windows are packed (all-ones masks), so the
+                # bench runs the recommended packed-pretraining config:
+                # the mask operand is dropped from the flash kernels.
+                "extra": {"loss_impl": loss_impl, "assume_packed": True},
             },
             "data": {"name": "dummy_text"},
             "trainer": {"micro_batch_size": batch, "grad_accum_steps": 1, "warmup_steps": 0},

@@ -61,12 +61,17 @@ class CausalSelfAttention(nn.Module):
     decode: bool = False  # autoregressive KV-cache mode (generation only)
     cache_len: int = 0  # KV-cache capacity; block_size when decode=True
     # Grouped-query attention: K/V heads (0 = n_heads, classic MHA; 1 =
-    # MQA). Queries in group g attend the shared K/V head g. The decode
-    # cache stores only n_kv_heads — the serving-memory win; training
-    # paths broadcast K/V up to n_heads before attention, so flash/ring/
-    # ulysses kernels are unchanged. n_kv_heads == n_heads keeps the MHA
-    # fused-qkv parameter tree (checkpoint compatibility).
+    # MQA). Queries in group g attend the shared K/V head g. The flash
+    # path consumes narrow K/V natively (the Pallas kernels index K/V by
+    # head group — no jnp.repeat in HBM, the training-bandwidth win); the
+    # decode cache stores only n_kv_heads (the serving-memory win);
+    # ring/ulysses/dense broadcast K/V up to n_heads before attention.
+    # n_kv_heads == n_heads keeps the MHA fused-qkv parameter tree
+    # (checkpoint compatibility).
     n_kv_heads: int = 0
+    # Data is guaranteed packed (all-ones masks): drop the mask operand
+    # from the flash kernels — identical math, no mask streaming.
+    assume_packed: bool = False
 
     @nn.compact
     def __call__(
@@ -125,9 +130,14 @@ class CausalSelfAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "length", "act_heads", "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
 
-        if not self.decode and kv_heads != self.n_heads:
-            # Training paths see full-width K/V (compute-equivalent GQA);
-            # the decode path keeps the narrow cache and broadcasts at read.
+        if (
+            not self.decode
+            and kv_heads != self.n_heads
+            and self.attention != "flash"
+        ):
+            # Ring/ulysses/dense see full-width K/V (compute-equivalent
+            # GQA); flash consumes narrow K/V natively and the decode path
+            # keeps the narrow cache, broadcasting at read.
             reps = self.n_heads // kv_heads
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
@@ -140,23 +150,31 @@ class CausalSelfAttention(nn.Module):
             # generation re-runs the full forward per token.
             out = self._decode_attention(q, k, v)
         elif self.attention == "flash":
-            # Flash/ring modes are the packed-sequence fast path: padding
-            # masks are NOT applied inside attention (the data pipeline emits
-            # all-ones masks; the loss still respects the mask). Use 'dense'
-            # for genuinely padded batches.
+            # Padding masks are applied INSIDE attention (reference
+            # gpt.py:60-64 semantics) — the Pallas kernels take the (B, T)
+            # key mask directly. assume_packed drops the operand when the
+            # data is provably packed (all-ones masks ≡ no mask).
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(
+                q, k, v,
+                attention_mask=None if self.assume_packed else attention_mask,
+                causal=True,
+            )
         elif self.attention == "ring":
             # Sequence-parallel exact attention over the mesh's `sequence`
             # axis (ops/ring_attention.py); falls back to blockwise when no
-            # ambient mesh shards the sequence.
+            # ambient mesh shards the sequence. NOTE: ring/ulysses are
+            # packed-sequence paths — padding masks are NOT applied inside
+            # attention here (only flash/dense do that); use those for
+            # genuinely padded batches.
             from ..ops.ring_attention import ring_or_blockwise
 
             out = ring_or_blockwise(q, k, v, causal=True)
         elif self.attention == "ulysses":
             # All-to-all sequence parallelism (ops/ulysses_attention.py):
             # the ring alternative — 2 all-to-alls instead of s ppermutes.
+            # Packed sequences only, same caveat as ring above.
             from ..ops.ulysses_attention import ulysses_or_blockwise
 
             out = ulysses_or_blockwise(q, k, v, causal=True)
@@ -296,6 +314,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     cache_len: int = 0
     n_kv_heads: int = 0  # grouped-query attention (see CausalSelfAttention)
+    assume_packed: bool = False  # drop the flash mask operand (packed data)
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -327,6 +346,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             cache_len=self.cache_len,
             n_kv_heads=self.n_kv_heads,
+            assume_packed=self.assume_packed,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -404,6 +424,9 @@ class GPT(nn.Module):
     # Grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = MQA). The
     # decode cache shrinks by n_heads/n_kv_heads (see CausalSelfAttention).
     n_kv_heads: int = 0
+    # Data is guaranteed packed (all-ones masks): skip the in-attention
+    # mask on the flash path (model.extra.assume_packed).
+    assume_packed: bool = False
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -484,6 +507,7 @@ class GPT(nn.Module):
                 decode=self.decode,
                 cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
                 n_kv_heads=self.n_kv_heads,
+                assume_packed=self.assume_packed,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
@@ -526,7 +550,8 @@ class GPTAdapter(ModelAdapter):
     """Model adapter for the decoder-only GPT implementation."""
 
     known_extra_keys = frozenset(
-        {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads"}
+        {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
+         "assume_packed"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -578,6 +603,7 @@ class GPTAdapter(ModelAdapter):
             ce_chunk=ce_chunk,
             z_loss=z_loss,
             n_kv_heads=n_kv_heads,
+            assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

@@ -30,12 +30,14 @@ def _chunk_scan(
     kv_offset: jax.Array | int,
     causal: bool,
     kv_chunk: int,
+    key_mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Online-softmax accumulation of one q-chunk over all kv-chunks.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D). Offsets give the absolute positions
     of the first query/key, so the causal mask works on chunks of a larger
-    sequence (ring attention passes nonzero kv_offset).
+    sequence (ring attention passes nonzero kv_offset). ``key_mask`` is an
+    optional (B, Tk) padding mask (nonzero = attend).
     Returns (acc, row_max, row_sum) with acc un-normalized: out = acc / row_sum.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -44,19 +46,24 @@ def _chunk_scan(
 
     k_chunks = k.reshape(k.shape[0], num_kv, kv_chunk, *k.shape[2:])
     v_chunks = v.reshape(v.shape[0], num_kv, kv_chunk, *v.shape[2:])
+    mask_chunks = None
+    if key_mask is not None:
+        mask_chunks = (key_mask != 0).reshape(key_mask.shape[0], num_kv, kv_chunk)
 
     q_pos = q_offset + jnp.arange(tq)
 
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(carry, inputs):
         acc, row_max, row_sum = carry
-        k_c, v_c, chunk_idx = inputs
+        k_c, v_c, m_c, chunk_idx = inputs
         s = jnp.einsum("bqhd,bkhd->bqhk", q, k_c) * scale
         s = s.astype(jnp.float32)
         if causal:
             k_pos = kv_offset + chunk_idx * kv_chunk + jnp.arange(kv_chunk)
             mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, kv_chunk)
             s = jnp.where(mask[None, :, None, :], s, _NEG_INF)
+        if m_c is not None:
+            s = jnp.where(m_c[:, None, None, :], s, _NEG_INF)  # (B,1,1,chunk)
         new_max = jnp.maximum(row_max, s.max(axis=-1))
         correction = jnp.exp(row_max - new_max)
         p = jnp.exp(s - new_max[..., None])
@@ -74,8 +81,9 @@ def _chunk_scan(
     )
     k_scan = jnp.moveaxis(k_chunks, 1, 0)
     v_scan = jnp.moveaxis(v_chunks, 1, 0)
+    m_scan = None if mask_chunks is None else jnp.moveaxis(mask_chunks, 1, 0)
     (acc, row_max, row_sum), _ = jax.lax.scan(
-        body, init, (k_scan, v_scan, jnp.arange(num_kv))
+        body, init, (k_scan, v_scan, m_scan, jnp.arange(num_kv))
     )
     return acc, row_max, row_sum
 
@@ -90,8 +98,13 @@ def blockwise_attention(
     kv_chunk: int = 512,
     q_offset: jax.Array | int = 0,
     kv_offset: jax.Array | int = 0,
+    key_mask: jax.Array | None = None,
 ) -> jax.Array:
-    """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory."""
+    """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory.
+
+    ``key_mask`` is an optional (B, Tk) padding mask (nonzero = attend),
+    the reference's in-attention padding semantics (gpt.py:60-64).
+    """
     b, tq, h, d = q.shape
     q_chunk = min(q_chunk, tq)
     kv_chunk = min(kv_chunk, k.shape[1])
@@ -111,6 +124,7 @@ def blockwise_attention(
             kv_offset=kv_offset,
             causal=causal,
             kv_chunk=kv_chunk,
+            key_mask=key_mask,
         )
         return (acc / row_sum[..., None]).astype(q.dtype)
 

@@ -14,9 +14,18 @@ Both paths are O(T) memory — no (T, T) materialization. Set
 ``LLMTRAIN_FLASH_BWD=blockwise`` to force the recompute backward on TPU
 (the A/B knob for benchmarking fused vs recompute).
 
-Padding masks route to the model's dense path (``models/gpt.py``); flash is
-the packed/causal fast path, which is also what the data pipeline produces
-(all-ones masks from hf_text windows).
+Key-padding masks are applied INSIDE attention on every flash path
+(parity with the reference, models/gpt.py:60-64): masked keys get -inf
+logits before the softmax. Packed pipelines (hf_text/dummy_text windows)
+emit all-ones masks, for which the masked and unmasked kernels agree
+exactly; ``model.extra.assume_packed`` drops the mask operand from the
+hot path when the data is provably packed. Ring/ulysses remain
+packed-only (masks are not applied there — models/gpt.py routes and
+documents this).
+
+Grouped-query attention is native: ``k``/``v`` may carry n_kv_heads <
+n_heads and the Pallas kernels index K/V by head group — no jnp.repeat
+materialization. The blockwise fallback broadcasts (CPU/test path only).
 """
 
 from __future__ import annotations
@@ -24,6 +33,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 
 from .blockwise_attention import blockwise_attention
 
@@ -49,16 +59,30 @@ def _pallas_bwd_enabled() -> bool:
     return os.environ.get("LLMTRAIN_FLASH_BWD", "pallas").lower() != "blockwise"
 
 
+def _widen(q: jax.Array, k: jax.Array, v: jax.Array):
+    """Broadcast grouped-query K/V to full head width (fallback paths)."""
+    if k.shape[2] != q.shape[2]:
+        reps = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    return k, v
+
+
+def _blockwise(q, k, v, key_mask=None):
+    k, v = _widen(q, k, v)
+    return blockwise_attention(q, k, v, causal=True, key_mask=key_mask)
+
+
 @jax.custom_vjp
 def _flash(q, k, v):
-    block = _auto_block(q.shape[1])
-    if jax.default_backend() == "tpu" and block is not None:
+    if _use_pallas(q.shape[1]):
         from .pallas_attention import pallas_flash_attention
 
+        block = _auto_block(q.shape[1])
         return pallas_flash_attention(
             q, k, v, causal=True, block_q=block, block_k=block
         )
-    return blockwise_attention(q, k, v, causal=True)
+    return _blockwise(q, k, v)
 
 
 def _flash_fwd(q, k, v):
@@ -82,11 +106,55 @@ def _flash_bwd(residuals, g):
         return pallas_flash_attention_bwd(
             q, k, v, out, lse, g, causal=True, block_q=block, block_k=block
         )
-    _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=True), q, k, v)
+    _, vjp = jax.vjp(_blockwise, q, k, v)
     return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# Masked variant: the (B, T) key-padding mask travels as float32 so the
+# custom_vjp can return a well-typed zero cotangent for it.
+@jax.custom_vjp
+def _flash_masked(q, k, v, maskf):
+    if _use_pallas(q.shape[1]):
+        from .pallas_attention import pallas_flash_attention
+
+        block = _auto_block(q.shape[1])
+        return pallas_flash_attention(
+            q, k, v, maskf, causal=True, block_q=block, block_k=block
+        )
+    return _blockwise(q, k, v, key_mask=maskf)
+
+
+def _flash_masked_fwd(q, k, v, maskf):
+    if _use_pallas(q.shape[1]) and _pallas_bwd_enabled():
+        from .pallas_attention import pallas_flash_attention_fwd
+
+        block = _auto_block(q.shape[1])
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, maskf, causal=True, block_q=block, block_k=block
+        )
+        return out, (q, k, v, maskf, out, lse)
+    return _flash_masked(q, k, v, maskf), (q, k, v, maskf, None, None)
+
+
+def _flash_masked_bwd(residuals, g):
+    q, k, v, maskf, out, lse = residuals
+    if out is not None:
+        from .pallas_attention import pallas_flash_attention_bwd
+
+        block = _auto_block(q.shape[1])
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, maskf, causal=True, block_q=block, block_k=block
+        )
+        return dq, dk, dv, jnp.zeros_like(maskf)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise(q_, k_, v_, key_mask=maskf), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(maskf)
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
 def flash_attention(
@@ -97,12 +165,15 @@ def flash_attention(
     attention_mask: jax.Array | None = None,
     causal: bool = True,
 ) -> jax.Array:
-    """Causal attention over (B, T, H, Dh); O(T) memory, differentiable."""
-    if attention_mask is not None:
-        raise ValueError(
-            "flash attention does not support padding masks; use attention='dense' "
-            "for padded batches (hf_text/dummy_text produce all-ones masks)"
-        )
+    """Causal attention over (B, T, H, Dh); O(T) memory, differentiable.
+
+    ``k``/``v`` may be grouped-query narrow (B, T, Hkv, Dh).
+    ``attention_mask`` is the reference's (B, T) padding mask semantics
+    (nonzero = real token): masked keys are excluded inside attention.
+    """
     if not causal:
-        return blockwise_attention(q, k, v, causal=False)
-    return _flash(q, k, v)
+        k, v = _widen(q, k, v)
+        return blockwise_attention(q, k, v, causal=False, key_mask=attention_mask)
+    if attention_mask is None:
+        return _flash(q, k, v)
+    return _flash_masked(q, k, v, attention_mask.astype(jnp.float32))

@@ -195,6 +195,45 @@ class TestGroupedQueryAttention:
             np.asarray(a[:, : t + 1]), np.asarray(b[:, : t + 1]), atol=1e-6
         )
 
+    @pytest.mark.parametrize("kvh", [1, 2], ids=["mqa", "gqa2"])
+    def test_flash_route_matches_dense(self, kvh):
+        """The flash path consumes narrow K/V natively (no jnp.repeat in
+        the model); logits equal the dense-attention route."""
+        dense = self._model(kvh)
+        params = self._params(dense)
+        flash = self._model(kvh, attention="flash")
+        ids = jnp.asarray(np.random.default_rng(7).integers(0, 64, (2, 16)), jnp.int32)
+        a = dense.apply({"params": params}, ids, deterministic=True)
+        b = flash.apply({"params": params}, ids, deterministic=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    @pytest.mark.parametrize("kvh", [2, 0], ids=["gqa2", "mha"])
+    def test_flash_route_applies_padding_mask(self, kvh):
+        """Padded batches through attention='flash' now match dense — the
+        padding mask is applied INSIDE attention on every path (closes the
+        r2 'flash ignores masks' gap; reference gpt.py:60-64)."""
+        dense = self._model(kvh)
+        params = self._params(dense)
+        flash = self._model(kvh, attention="flash")
+        ids = jnp.asarray(np.random.default_rng(8).integers(0, 64, (2, 16)), jnp.int32)
+        mask = jnp.asarray(
+            (np.arange(16)[None, :] < np.asarray([16, 9])[:, None]).astype(np.int32)
+        )
+        a = dense.apply(
+            {"params": params}, ids, attention_mask=mask, deterministic=True
+        )
+        b = flash.apply(
+            {"params": params}, ids, attention_mask=mask, deterministic=True
+        )
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        # assume_packed drops the mask — valid rows must then differ from
+        # the masked result only on rows that actually carry padding.
+        packed = self._model(kvh, attention="flash", assume_packed=True)
+        c = packed.apply(
+            {"params": params}, ids, attention_mask=mask, deterministic=True
+        )
+        np.testing.assert_allclose(np.asarray(a[0]), np.asarray(c[0]), atol=1e-5)
+
     def test_decode_cache_stores_narrow_kv(self):
         model = self._model(1).for_decoding(cache_len=8)
         variables = model.init(

@@ -144,10 +144,160 @@ class TestFlashDispatch:
         g_ref = jax.grad(lambda q: _dense_ref(q, k, v).sum())(q)
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
 
-    def test_mask_rejected(self):
+    def test_all_ones_mask_matches_unmasked(self):
         q, k, v = _qkv(t=16)
-        with pytest.raises(ValueError, match="padding"):
-            flash_attention(q, k, v, attention_mask=jnp.ones((2, 16)))
+        out = flash_attention(q, k, v, attention_mask=jnp.ones((2, 16), jnp.int32))
+        ref = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def _suffix_mask(b, t, seed=1):
+    """Per-row valid prefix lengths in [1, t] — reference padding shape."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(1, t + 1, size=(b,))
+    lens[0] = t  # keep one fully-packed row in the mix
+    return jnp.asarray((np.arange(t)[None, :] < lens[:, None]).astype(np.int32))
+
+
+def _valid(x, mask):
+    """Zero padded query rows: comparisons follow the model contract,
+    which multiplies attention output by the mask (models/gpt.py)."""
+    return np.asarray(x) * np.asarray(mask)[:, :, None, None].astype(np.float32)
+
+
+class TestMaskedFlash:
+    """Key-padding masks applied INSIDE attention (reference gpt.py:60-64),
+    on every flash path: Pallas kernels, blockwise fallback, dispatch."""
+
+    def test_pallas_fwd_matches_masked_dense(self):
+        q, k, v = _qkv(b=3, t=32, h=2, d=8, seed=5)
+        mask = _suffix_mask(3, 32)
+        out = pallas_flash_attention(q, k, v, mask, block_q=8, block_k=8, interpret=True)
+        ref = dense_attention(q, k, v, attention_mask=mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
+    def test_pallas_bwd_matches_masked_dense_grads(self):
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = _qkv(b=3, t=32, h=2, d=8, seed=7)
+        mask = _suffix_mask(3, 32, seed=2)
+        # Cotangent zeroed on padded rows — exactly what the model's
+        # output-mask multiply feeds back into attention.
+        g = jax.random.normal(jax.random.key(9), q.shape, jnp.float32)
+        g = g * mask[:, :, None, None].astype(jnp.float32)
+
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, mask, block_q=8, block_k=8, interpret=True
+        )
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, mask, block_q=8, block_k=8, interpret=True
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, attention_mask=mask) * g)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
+
+    def test_blockwise_key_mask_matches_masked_dense(self):
+        q, k, v = _qkv(b=3, t=16, h=2, d=8, seed=11)
+        mask = _suffix_mask(3, 16, seed=3)
+        out = blockwise_attention(q, k, v, causal=True, q_chunk=4, kv_chunk=4, key_mask=mask)
+        ref = dense_attention(q, k, v, attention_mask=mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
+    def test_dispatch_masked_fwd_and_grads(self):
+        """flash_attention(attention_mask=...) on the CPU fallback path."""
+        q, k, v = _qkv(b=2, t=16, h=2, d=8, seed=13)
+        mask = _suffix_mask(2, 16, seed=4)
+        gmask = mask[:, :, None, None].astype(jnp.float32)
+        out = flash_attention(q, k, v, attention_mask=mask)
+        ref = dense_attention(q, k, v, attention_mask=mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
+        g = jax.grad(
+            lambda q: (flash_attention(q, k, v, attention_mask=mask) * gmask).sum()
+        )(q)
+        g_ref = jax.grad(
+            lambda q: (dense_attention(q, k, v, attention_mask=mask) * gmask).sum()
+        )(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+
+class TestGQAKernels:
+    """Native grouped-query attention: narrow (B, T, Hkv, D) K/V through
+    the Pallas kernels with in-kernel group mapping — no jnp.repeat."""
+
+    def _gqa_qkv(self, b=2, t=32, h=4, hkv=2, d=8, seed=21):
+        ks = jax.random.split(jax.random.key(seed), 3)
+        q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, t, hkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, t, hkv, d), jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("hkv", [1, 2, 4], ids=["mqa", "gqa2", "mha"])
+    def test_fwd_matches_widened_dense(self, hkv):
+        q, kn, vn = self._gqa_qkv(hkv=hkv)
+        reps = q.shape[2] // hkv
+        kw, vw = jnp.repeat(kn, reps, axis=2), jnp.repeat(vn, reps, axis=2)
+        out = pallas_flash_attention(q, kn, vn, block_q=8, block_k=8, interpret=True)
+        ref = dense_attention(q, kw, vw, attention_mask=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("hkv", [1, 2], ids=["mqa", "gqa2"])
+    def test_bwd_matches_widened_autodiff(self, hkv):
+        """dk/dv come back at the NARROW width, equal to autodiff through
+        widen-then-dense (which group-sums the cotangents)."""
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, kn, vn = self._gqa_qkv(hkv=hkv, seed=23)
+        reps = q.shape[2] // hkv
+        g = jax.random.normal(jax.random.key(29), q.shape, jnp.float32)
+
+        out, lse = pallas_flash_attention_fwd(
+            q, kn, vn, block_q=8, block_k=8, interpret=True
+        )
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, kn, vn, out, lse, g, block_q=8, block_k=8, interpret=True
+        )
+        assert dk.shape == kn.shape and dv.shape == vn.shape
+
+        def loss(q, kn, vn):
+            kw = jnp.repeat(kn, reps, axis=2)
+            vw = jnp.repeat(vn, reps, axis=2)
+            return jnp.sum(dense_attention(q, kw, vw, attention_mask=None) * g)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, kn, vn)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
+
+    def test_gqa_with_mask(self):
+        """GQA and key-padding combine in one kernel invocation."""
+        q, kn, vn = self._gqa_qkv(b=3, hkv=2, seed=31)
+        mask = _suffix_mask(3, 32, seed=6)
+        reps = q.shape[2] // 2
+        kw, vw = jnp.repeat(kn, reps, axis=2), jnp.repeat(vn, reps, axis=2)
+        out = pallas_flash_attention(q, kn, vn, mask, block_q=8, block_k=8, interpret=True)
+        ref = dense_attention(q, kw, vw, attention_mask=mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
+    def test_dispatch_gqa_fallback(self):
+        """flash_attention with narrow K/V on the CPU fallback path."""
+        q, kn, vn = self._gqa_qkv(t=16, hkv=2, seed=37)
+        reps = q.shape[2] // 2
+        kw, vw = jnp.repeat(kn, reps, axis=2), jnp.repeat(vn, reps, axis=2)
+        out = flash_attention(q, kn, vn)
+        ref = dense_attention(q, kw, vw, attention_mask=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
 class TestRingAttention:
