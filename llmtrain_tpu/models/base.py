@@ -47,6 +47,16 @@ class ModelAdapter(ABC):
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
         """Construct the tokenizer, or None for models that need none."""
 
+    def batch_divisor(self, cfg: RunConfig, mesh: Any) -> int:
+        """Rows every applied batch must be a multiple of (default 1).
+
+        Pipelined models return data_shards × microbatches: the Trainer
+        pads eval batches up to this with zero-masked rows (exact under
+        token-weighted aggregation) instead of silently dropping pipeline
+        parallelism mid-eval.
+        """
+        return 1
+
     @staticmethod
     def _positive_extra(cfg: RunConfig, key: str, default: int) -> int:
         """Validated ``model.extra`` integer knob (>= 1), shared by adapters."""

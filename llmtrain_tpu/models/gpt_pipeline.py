@@ -249,15 +249,21 @@ class PipelineGPT(nn.Module):
             needed = dp * self.n_microbatches
             if x.shape[0] % needed != 0:
                 # Batch-1 traces (the param-init probe, models/base.py:52)
-                # fall back silently by design; a real batch losing the
-                # pipeline deserves a trace-time diagnostic.
+                # fall back silently by design. A REAL batch must not: on a
+                # pipeline:S mesh "without pipeline parallelism" means every
+                # device materializes all S stages' layers — an OOM at the
+                # sizes pipeline parallelism exists for, reached via a
+                # warning. The Trainer pads eval batches up to
+                # adapter.batch_divisor(), so this is only reachable from
+                # custom callers.
                 if x.shape[0] > 1:
-                    from ..utils.logging import get_logger
-
-                    get_logger().warning(
-                        "gpt_pipeline: batch %d not divisible by data shards "
-                        "x microbatches (%d); running WITHOUT pipeline "
-                        "parallelism", x.shape[0], needed,
+                    raise ValueError(
+                        f"gpt_pipeline: batch {x.shape[0]} is not divisible "
+                        f"by data shards x microbatches ({needed}) on a "
+                        f"{n_stages}-stage pipeline mesh; pad the batch with "
+                        "zero-masked rows (Trainer eval does this via "
+                        "ModelAdapter.batch_divisor) or adjust "
+                        "model.extra.pipeline_microbatches"
                     )
                 n_stages = 1
         if n_stages > 1:
@@ -412,6 +418,31 @@ class PipelineGPTAdapter(ModelAdapter):
         from ..data.tokenizers import build_tokenizer
 
         return build_tokenizer(cfg.model.extra.get("tokenizer", "gpt2"))
+
+    def batch_divisor(self, cfg: RunConfig, mesh: Any) -> int:
+        """data_shards × microbatches on pipeline meshes: the row count
+        every applied batch must divide by for gpipe_apply to engage."""
+        from ..parallel.pipeline import BATCH_AXES, pipeline_degree
+
+        if pipeline_degree(mesh) <= 1:
+            return 1
+        dp = math.prod(int(mesh.shape.get(a, 1)) for a in BATCH_AXES)
+        return dp * self._positive_extra(cfg, "pipeline_microbatches", 4)
+
+    def validate_mesh(self, cfg: RunConfig, mesh: Any) -> None:
+        """Fail at startup (not at trace) when the training batch cannot
+        engage the pipeline: global rows (micro_batch_size × data shards)
+        divide by data_shards × microbatches iff microbatches divides
+        micro_batch_size."""
+        from ..parallel.pipeline import pipeline_degree
+
+        m = self._positive_extra(cfg, "pipeline_microbatches", 4)
+        if pipeline_degree(mesh) > 1 and cfg.trainer.micro_batch_size % m != 0:
+            raise ValueError(
+                f"trainer.micro_batch_size ({cfg.trainer.micro_batch_size}) "
+                f"must be divisible by model.extra.pipeline_microbatches "
+                f"({m}) on a pipeline mesh"
+            )
 
     def compute_loss_components(
         self,

@@ -390,9 +390,12 @@ def _bwd_dkdv_kernel(
         dk_ref[0] = jnp.zeros((block_k, head_dim), dk_ref.dtype)
         dv_ref[0] = jnp.zeros((block_k, head_dim), dv_ref.dtype)
 
-    # q was pre-scaled, so dk already carries one factor of scale.
-    dk_ref[0] += dk
-    dv_ref[0] += dv
+    # q was pre-scaled, so dk already carries one factor of scale. The
+    # astype matters for group==1, where the output refs keep the narrow
+    # K/V dtype (accumulation across revisits only happens at f32,
+    # group>1 — see grad_dtypes at the pallas_call).
+    dk_ref[0] += dk.astype(dk_ref.dtype)
+    dv_ref[0] += dv.astype(dv_ref.dtype)
 
 
 @functools.partial(

@@ -18,6 +18,7 @@ the step, and (at log boundaries) pull small scalars off device.
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -117,6 +118,13 @@ class Trainer:
         self._rules = list(DEFAULT_LOGICAL_AXIS_RULES)
         self._dp = data_parallel_degree(self._mesh)
         self._global_micro = cfg.trainer.micro_batch_size * self._dp
+        # Rows every applied batch must divide by (pipelined models:
+        # data_shards × microbatches); eval pads up to lcm(dp, this).
+        # getattr for duck-typed adapters, like validate_mesh above.
+        divisor_fn = getattr(self._adapter, "batch_divisor", None)
+        self._batch_divisor = (
+            int(divisor_fn(cfg, self._mesh)) if divisor_fn is not None else 1
+        )
 
         self._tx = build_optimizer(cfg.trainer)
         self._schedule = lr_schedule(cfg.trainer)
@@ -585,10 +593,16 @@ class Trainer:
             return None
         n = len(val_ds)
 
-        # Pad the last batch up to a multiple of the data-parallel degree with
+        # Pad the last batch up to a multiple of the data-parallel degree —
+        # and of the model's batch divisor (pipelined models need
+        # data_shards × microbatches; models/base.py batch_divisor) — with
         # zero-masked rows: token-weighted aggregation makes padding exact
         # (padded rows contribute 0 loss and 0 tokens).
-        eval_bs = min(self._global_micro, -(-n // self._dp) * self._dp)
+        mult = math.lcm(self._dp, self._batch_divisor)
+        eval_bs = min(
+            max(self._global_micro // mult, 1) * mult,
+            -(-n // mult) * mult,
+        )
         num_batches = -(-n // eval_bs)
 
         # Pipelined eval: a worker thread assembles batch b+1 (host-side

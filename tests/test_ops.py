@@ -133,6 +133,36 @@ class TestPallasInterpret:
         np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
         np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
 
+    def test_fused_backward_bf16_mha(self):
+        """bf16 MHA backward — the default training dtype on TPU. Guards
+        the group==1 narrow-dtype output store (a float32 value stored
+        into a bfloat16 ref raises in Pallas); grads are checked at bf16
+        tolerance against the dense reference."""
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(b=2, t=32, h=2, d=8, seed=4))
+        g = jax.random.normal(jax.random.key(10), q.shape, jnp.bfloat16)
+
+        out, lse = pallas_flash_attention_fwd(q, k, v, block_q=8, block_k=8, interpret=True)
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, block_q=8, block_k=8, interpret=True
+        )
+        assert dk.dtype == jnp.bfloat16 and dv.dtype == jnp.bfloat16
+
+        qf, kf, vf, gf = (x.astype(jnp.float32) for x in (q, k, v, g))
+
+        def loss(q, k, v):
+            return jnp.sum(_dense_ref(q, k, v) * gf)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(qf, kf, vf)
+        for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float32), np.asarray(want), atol=0.1, rtol=0.1
+            )
+
 
 class TestFlashDispatch:
     def test_cpu_dispatch_and_grads(self):

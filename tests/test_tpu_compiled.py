@@ -194,6 +194,34 @@ class TestCompiledBackward:
             np.asarray(jax.device_get(dv)), np.asarray(jax.device_get(rv)), atol=1e-3
         )
 
+    def test_fused_bwd_bf16_mha(self):
+        """The default training dtype: bf16 MHA backward must lower (the
+        group==1 output refs keep the narrow dtype — a f32 store into a
+        bf16 ref is a Mosaic error) and agree loosely with dense grads."""
+        from llmtrain_tpu.models.gpt import dense_attention
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = _qkv(t=256, dtype=jnp.bfloat16, seed=8)
+        g = jax.random.normal(jax.random.key(9), q.shape, jnp.bfloat16)
+        out, lse = pallas_flash_attention_fwd(q, k, v)
+        dq, dk, dv = pallas_flash_attention_bwd(q, k, v, out, lse, g)
+        assert dk.dtype == jnp.bfloat16 and dv.dtype == jnp.bfloat16
+        qf, kf, vf, gf = (x.astype(jnp.float32) for x in (q, k, v, g))
+
+        def loss(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, attention_mask=None) * gf)
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(qf, kf, vf)
+        for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(got), np.float32),
+                np.asarray(jax.device_get(want)),
+                atol=0.1, rtol=0.1,
+            )
+
     def test_custom_vjp_dispatch_uses_pallas_bwd(self, monkeypatch):
         """flash_attention's grad on TPU goes through the fused kernels and
         agrees with the blockwise-recompute path (the A/B knob)."""
