@@ -34,7 +34,7 @@ from .registry import (
     get_model_adapter,
     initialize_registries,
 )
-from .tracking import MLflowTracker, NullTracker, Tracker
+from .tracking import NullTracker, Tracker, build_tracker
 from .utils import (
     configure_logging,
     create_run_directory,
@@ -481,14 +481,12 @@ def _handle_train_tokenizer(args: argparse.Namespace) -> int:
 
 
 def _create_tracker(cfg, dist_state: DistState | None, run_id: str) -> Tracker:
-    """MLflow on the main process when enabled; Null otherwise (reference :246-248)."""
+    """A real tracker on the main process when enabled; Null otherwise
+    (reference :246-248). Backend selection: tracking/__init__.py
+    build_tracker (mlflow / native SQLite / auto)."""
     is_main = dist_state is None or dist_state.is_main
     if cfg.mlflow.enabled and is_main:
-        return MLflowTracker(
-            cfg.mlflow.tracking_uri,
-            cfg.mlflow.experiment,
-            run_name=cfg.mlflow.run_name or run_id,
-        )
+        return build_tracker(cfg.mlflow, run_id)
     return NullTracker()
 
 

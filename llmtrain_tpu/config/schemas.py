@@ -185,13 +185,21 @@ class DistributedConfig(BaseModel):
 
 
 class MLflowConfig(BaseModel):
-    """MLflow tracking options (reference schemas.py:123-136, unchanged)."""
+    """MLflow tracking options (reference schemas.py:123-136).
+
+    Divergence: ``backend`` selects the tracking implementation —
+    ``auto`` (default) uses the MLflow client when the extra is
+    importable and falls back to the dependency-free native SQLite store
+    (tracking/sqlite.py) otherwise; ``mlflow``/``native`` force one. The
+    reference always requires the mlflow package when enabled.
+    """
 
     enabled: bool = True
     tracking_uri: str = "file:./mlruns"
     experiment: str = "llm-train-k8s"
     run_name: str | None = None
     log_models: bool = False
+    backend: Literal["auto", "mlflow", "native"] = "auto"
 
     model_config = _STRICT
 

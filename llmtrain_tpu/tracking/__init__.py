@@ -1,6 +1,55 @@
-"""Experiment tracking: Tracker protocol, MLflow and Null implementations."""
+"""Experiment tracking: Tracker protocol, MLflow/SQLite/Null backends."""
+
+from __future__ import annotations
+
+from typing import Any
 
 from .base import NullTracker, Tracker
 from .mlflow import MLflowTracker
+from .sqlite import SqliteTracker
 
-__all__ = ["MLflowTracker", "NullTracker", "Tracker"]
+
+def _mlflow_available() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("mlflow") is not None
+
+
+def build_tracker(mlflow_cfg: Any, run_id: str) -> Tracker:
+    """Backend selection for the main process (``mlflow.backend``):
+
+    * ``mlflow`` — the MLflow client (raises a clear error at start_run
+      when the extra is missing; reference behavior).
+    * ``native`` — the stdlib SQLite store (tracking/sqlite.py).
+    * ``auto`` (default) — MLflow when importable, else the native store
+      pointed at the same tracking URI, so tracking works out of the box
+      on hosts without the extra (air-gapped TPU images included). The
+      two backends share the URI convention but NOT an on-disk schema —
+      a given DB file belongs to whichever backend created it.
+    """
+    backend = getattr(mlflow_cfg, "backend", "auto")
+    run_name = mlflow_cfg.run_name or run_id
+    if backend == "mlflow" or (backend == "auto" and _mlflow_available()):
+        return MLflowTracker(
+            mlflow_cfg.tracking_uri, mlflow_cfg.experiment, run_name=run_name
+        )
+    if backend == "auto":
+        from ..utils.logging import get_logger
+
+        get_logger().info(
+            "mlflow not installed; tracking with the native SQLite backend "
+            "at %s (mlflow.backend: native silences this)",
+            mlflow_cfg.tracking_uri,
+        )
+    return SqliteTracker(
+        mlflow_cfg.tracking_uri, mlflow_cfg.experiment, run_name=run_name
+    )
+
+
+__all__ = [
+    "MLflowTracker",
+    "NullTracker",
+    "SqliteTracker",
+    "Tracker",
+    "build_tracker",
+]

@@ -1,0 +1,264 @@
+"""Native SQLite tracking backend — a real store with zero dependencies.
+
+The reference ships MLflow-backed tracking and exercises it end-to-end
+against a SQLite tracking URI (reference tests/test_cli.py:628-704; the
+k8s configmap wires ``sqlite:////mlflow/mlflow.db``). mlflow itself is an
+optional heavyweight extra; on hosts without it this backend persists the
+same information (runs, params, metrics with steps, tags, artifacts) to a
+plain SQLite file with the stdlib ``sqlite3`` module, so the tracking
+round trip is testable — and USED — everywhere, including air-gapped TPU
+images. ``mlflow.backend: auto`` (config/schemas.py) picks mlflow when
+importable and this store otherwise; ``native`` forces it.
+
+Semantics mirror the MLflow tracker (tracking/mlflow.py):
+
+* ``start_run`` joins an existing run carrying the same framework run id
+  (``--auto-resume`` relaunches CONTINUE the run instead of opening a
+  second one), else inserts a fresh row.
+* Only rank 0 ever holds a real tracker (cli.py), so there is a single
+  writer; WAL mode keeps concurrent readers (dashboards, the query
+  helpers below) safe.
+* Params are flattened to dot keys exactly like the MLflow tracker, so a
+  run recorded by either backend reads the same.
+
+The module-level ``read_runs``/``read_params``/``read_metrics`` helpers
+are the query surface the round-trip tests (and users) consume.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from pathlib import Path
+from typing import Any
+
+from .mlflow import _flatten_params
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_uuid     TEXT PRIMARY KEY,
+    run_id       TEXT NOT NULL,
+    experiment   TEXT NOT NULL,
+    run_name     TEXT,
+    status       TEXT NOT NULL,
+    start_time   REAL NOT NULL,
+    end_time     REAL,
+    UNIQUE (run_id, experiment)
+);
+CREATE TABLE IF NOT EXISTS params (
+    run_uuid TEXT NOT NULL REFERENCES runs(run_uuid),
+    key      TEXT NOT NULL,
+    value    TEXT NOT NULL,
+    PRIMARY KEY (run_uuid, key)
+);
+CREATE TABLE IF NOT EXISTS metrics (
+    run_uuid  TEXT NOT NULL REFERENCES runs(run_uuid),
+    key       TEXT NOT NULL,
+    value     REAL NOT NULL,
+    step      INTEGER,
+    timestamp REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_metrics_run_key ON metrics (run_uuid, key, step);
+CREATE TABLE IF NOT EXISTS tags (
+    run_uuid TEXT NOT NULL REFERENCES runs(run_uuid),
+    key      TEXT NOT NULL,
+    value    TEXT NOT NULL,
+    PRIMARY KEY (run_uuid, key)
+);
+CREATE TABLE IF NOT EXISTS artifacts (
+    run_uuid      TEXT NOT NULL REFERENCES runs(run_uuid),
+    local_path    TEXT NOT NULL,
+    artifact_path TEXT
+);
+"""
+
+
+def resolve_db_path(tracking_uri: str) -> Path:
+    """Map a tracking URI to the SQLite file this backend uses.
+
+    ``sqlite:///relative.db`` / ``sqlite:////abs/path.db`` take the path
+    verbatim (MLflow's own SQLite URI convention, so the k8s configmap
+    value works under either backend); ``file:<dir>`` and plain paths get
+    ``llmtrain.db`` inside the directory.
+    """
+    if tracking_uri.startswith("sqlite:"):
+        rest = tracking_uri[len("sqlite:") :]
+        while rest.startswith("//"):
+            rest = rest[1:]
+        # sqlite:////abs -> //abs -> /abs ; sqlite:///rel.db -> /rel.db?
+        # MLflow: sqlite:///x.db is relative x.db, sqlite:////x.db is /x.db.
+        if tracking_uri.startswith("sqlite:////"):
+            return Path("/" + rest.lstrip("/"))
+        return Path(rest.lstrip("/"))
+    if tracking_uri.startswith("file:"):
+        return Path(tracking_uri[len("file:") :]) / "llmtrain.db"
+    return Path(tracking_uri) / "llmtrain.db"
+
+
+class SqliteTracker:
+    """Tracker protocol implementation over a local SQLite file."""
+
+    def __init__(
+        self,
+        tracking_uri: str,
+        experiment: str,
+        *,
+        run_name: str | None = None,
+    ) -> None:
+        self._db_path = resolve_db_path(tracking_uri)
+        self._experiment = experiment
+        self._run_name = run_name
+        self._conn: sqlite3.Connection | None = None
+        self._run_uuid: str | None = None
+
+    # ------------------------------------------------------------- plumbing
+    def _connect(self) -> sqlite3.Connection:
+        if self._conn is None:
+            self._db_path.parent.mkdir(parents=True, exist_ok=True)
+            self._conn = sqlite3.connect(str(self._db_path))
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        return self._conn
+
+    # ------------------------------------------------------------- protocol
+    def start_run(self, run_id: str, run_name: str | None = None) -> None:
+        conn = self._connect()
+        row = conn.execute(
+            "SELECT run_uuid FROM runs WHERE run_id = ? AND experiment = ?",
+            (run_id, self._experiment),
+        ).fetchone()
+        if row is not None:
+            # Crash-restart continuity: an --auto-resume relaunch with the
+            # same stable run id reattaches (mlflow.py join semantics).
+            self._run_uuid = row[0]
+            conn.execute(
+                "UPDATE runs SET status = 'RUNNING', end_time = NULL "
+                "WHERE run_uuid = ?",
+                (self._run_uuid,),
+            )
+        else:
+            import uuid
+
+            self._run_uuid = uuid.uuid4().hex
+            conn.execute(
+                "INSERT INTO runs (run_uuid, run_id, experiment, run_name, "
+                "status, start_time) VALUES (?, ?, ?, ?, 'RUNNING', ?)",
+                (
+                    self._run_uuid,
+                    run_id,
+                    self._experiment,
+                    run_name or self._run_name or run_id,
+                    time.time(),
+                ),
+            )
+            conn.execute(
+                "INSERT OR REPLACE INTO tags (run_uuid, key, value) "
+                "VALUES (?, 'llmtrain.run_id', ?)",
+                (self._run_uuid, run_id),
+            )
+        conn.commit()
+
+    def log_params(self, params: dict[str, Any]) -> None:
+        if self._run_uuid is None:
+            return
+        conn = self._connect()
+        conn.executemany(
+            "INSERT OR REPLACE INTO params (run_uuid, key, value) VALUES (?, ?, ?)",
+            [
+                (self._run_uuid, k, str(v))
+                for k, v in _flatten_params(params).items()
+            ],
+        )
+        conn.commit()
+
+    def log_metrics(self, metrics: dict[str, float], step: int | None = None) -> None:
+        if self._run_uuid is None:
+            return
+        conn = self._connect()
+        now = time.time()
+        conn.executemany(
+            "INSERT INTO metrics (run_uuid, key, value, step, timestamp) "
+            "VALUES (?, ?, ?, ?, ?)",
+            [(self._run_uuid, k, float(v), step, now) for k, v in metrics.items()],
+        )
+        conn.commit()
+
+    def log_artifact(self, local_path: str, artifact_path: str | None = None) -> None:
+        if self._run_uuid is None:
+            return
+        conn = self._connect()
+        conn.execute(
+            "INSERT INTO artifacts (run_uuid, local_path, artifact_path) "
+            "VALUES (?, ?, ?)",
+            (self._run_uuid, local_path, artifact_path),
+        )
+        conn.commit()
+
+    def end_run(self, status: str = "FINISHED") -> None:
+        if self._run_uuid is None:
+            return
+        conn = self._connect()
+        conn.execute(
+            "UPDATE runs SET status = ?, end_time = ? WHERE run_uuid = ?",
+            (status, time.time(), self._run_uuid),
+        )
+        conn.commit()
+        conn.close()
+        self._conn = None
+        self._run_uuid = None
+
+
+# ------------------------------------------------------------------ queries
+def _reader(db_path: str | Path) -> sqlite3.Connection:
+    conn = sqlite3.connect(str(db_path))
+    conn.row_factory = sqlite3.Row
+    return conn
+
+
+def read_runs(db_path: str | Path, experiment: str | None = None) -> list[dict]:
+    """All runs (optionally one experiment's), newest first."""
+    with _reader(db_path) as conn:
+        sql = "SELECT * FROM runs"
+        args: tuple = ()
+        if experiment is not None:
+            sql += " WHERE experiment = ?"
+            args = (experiment,)
+        sql += " ORDER BY start_time DESC"
+        return [dict(r) for r in conn.execute(sql, args)]
+
+
+def read_params(db_path: str | Path, run_id: str) -> dict[str, str]:
+    with _reader(db_path) as conn:
+        rows = conn.execute(
+            "SELECT p.key, p.value FROM params p "
+            "JOIN runs r ON r.run_uuid = p.run_uuid WHERE r.run_id = ?",
+            (run_id,),
+        )
+        return {r["key"]: r["value"] for r in rows}
+
+
+def read_metrics(
+    db_path: str | Path, run_id: str, key: str | None = None
+) -> list[dict]:
+    """Metric rows (key, value, step, timestamp) in insertion order."""
+    with _reader(db_path) as conn:
+        sql = (
+            "SELECT m.key, m.value, m.step, m.timestamp FROM metrics m "
+            "JOIN runs r ON r.run_uuid = m.run_uuid WHERE r.run_id = ?"
+        )
+        args: tuple = (run_id,)
+        if key is not None:
+            sql += " AND m.key = ?"
+            args = (run_id, key)
+        sql += " ORDER BY m.rowid"
+        return [dict(r) for r in conn.execute(sql, args)]
+
+
+__all__ = [
+    "SqliteTracker",
+    "resolve_db_path",
+    "read_runs",
+    "read_params",
+    "read_metrics",
+]
