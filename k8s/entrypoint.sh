@@ -31,14 +31,17 @@ export JAX_NUM_PROCESSES="$NUM_PROCESSES"
 discover_coordinator_ip() {
     # Poll the K8s API for the index-0 pod's IP using the mounted
     # serviceaccount credentials. Prints the IP on success.
-    local sa=/var/run/secrets/kubernetes.io/serviceaccount
+    # LLMTRAIN_SA_DIR / LLMTRAIN_DISCOVERY_{TRIES,SLEEP} are testability
+    # overrides (tests/test_entrypoint.py); production pods use the
+    # defaults.
+    local sa="${LLMTRAIN_SA_DIR:-/var/run/secrets/kubernetes.io/serviceaccount}"
     local ns token url
     ns="$(cat "$sa/namespace")"
     token="$(cat "$sa/token")"
     url="https://kubernetes.default.svc/api/v1/namespaces/${ns}/pods"
     url="${url}?labelSelector=batch.kubernetes.io/job-completion-index%3D0,job-name%3D${JOB_NAME:?JOB_NAME must be set}"
 
-    local tries=60 ip=""
+    local tries="${LLMTRAIN_DISCOVERY_TRIES:-60}" ip=""
     for i in $(seq 1 "$tries"); do
         ip="$(curl -sf --cacert "$sa/ca.crt" -H "Authorization: Bearer ${token}" "$url" \
             | python3 -c 'import json,sys
@@ -49,7 +52,7 @@ print(items[0]["status"].get("podIP", "") if items else "")' || true)"
             return 0
         fi
         echo "entrypoint: waiting for coordinator pod IP ($i/$tries)" >&2
-        sleep 2
+        sleep "${LLMTRAIN_DISCOVERY_SLEEP:-2}"
     done
     return 1
 }

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import sqlite3
 import time
+from contextlib import contextmanager
 from pathlib import Path
 from typing import Any
 
@@ -54,7 +55,10 @@ CREATE TABLE IF NOT EXISTS params (
 CREATE TABLE IF NOT EXISTS metrics (
     run_uuid  TEXT NOT NULL REFERENCES runs(run_uuid),
     key       TEXT NOT NULL,
-    value     REAL NOT NULL,
+    -- Nullable: Python's sqlite3 binds float('nan') as NULL, and a
+    -- diverged run logging loss=nan must not crash training. Reads map
+    -- NULL back to nan (read_metrics).
+    value     REAL,
     step      INTEGER,
     timestamp REAL NOT NULL
 );
@@ -76,20 +80,15 @@ CREATE TABLE IF NOT EXISTS artifacts (
 def resolve_db_path(tracking_uri: str) -> Path:
     """Map a tracking URI to the SQLite file this backend uses.
 
-    ``sqlite:///relative.db`` / ``sqlite:////abs/path.db`` take the path
-    verbatim (MLflow's own SQLite URI convention, so the k8s configmap
-    value works under either backend); ``file:<dir>`` and plain paths get
-    ``llmtrain.db`` inside the directory.
+    ``sqlite:///relative.db`` / ``sqlite:////abs/path.db`` follow
+    MLflow's SQLite URI convention (three slashes relative, four
+    absolute — so the k8s configmap value resolves identically under
+    either backend); ``file:<dir>`` and plain paths get ``llmtrain.db``
+    inside the directory.
     """
     if tracking_uri.startswith("sqlite:"):
-        rest = tracking_uri[len("sqlite:") :]
-        while rest.startswith("//"):
-            rest = rest[1:]
-        # sqlite:////abs -> //abs -> /abs ; sqlite:///rel.db -> /rel.db?
-        # MLflow: sqlite:///x.db is relative x.db, sqlite:////x.db is /x.db.
-        if tracking_uri.startswith("sqlite:////"):
-            return Path("/" + rest.lstrip("/"))
-        return Path(rest.lstrip("/"))
+        p = tracking_uri[len("sqlite:") :].lstrip("/")
+        return Path("/" + p) if tracking_uri.startswith("sqlite:////") else Path(p)
     if tracking_uri.startswith("file:"):
         return Path(tracking_uri[len("file:") :]) / "llmtrain.db"
     return Path(tracking_uri) / "llmtrain.db"
@@ -177,6 +176,8 @@ class SqliteTracker:
             return
         conn = self._connect()
         now = time.time()
+        # NaN binds as NULL (nullable column; read_metrics maps it back) —
+        # a diverged run logging loss=nan must log, not crash training.
         conn.executemany(
             "INSERT INTO metrics (run_uuid, key, value, step, timestamp) "
             "VALUES (?, ?, ?, ?, ?)",
@@ -210,10 +211,17 @@ class SqliteTracker:
 
 
 # ------------------------------------------------------------------ queries
-def _reader(db_path: str | Path) -> sqlite3.Connection:
+@contextmanager
+def _reader(db_path: str | Path):
+    # sqlite3's own context manager only commits/rolls back — it never
+    # closes, which would leak a connection (and its WAL read lock) per
+    # query in a polling dashboard.
     conn = sqlite3.connect(str(db_path))
     conn.row_factory = sqlite3.Row
-    return conn
+    try:
+        yield conn
+    finally:
+        conn.close()
 
 
 def read_runs(db_path: str | Path, experiment: str | None = None) -> list[dict]:
@@ -228,31 +236,53 @@ def read_runs(db_path: str | Path, experiment: str | None = None) -> list[dict]:
         return [dict(r) for r in conn.execute(sql, args)]
 
 
-def read_params(db_path: str | Path, run_id: str) -> dict[str, str]:
+def read_params(
+    db_path: str | Path, run_id: str, experiment: str | None = None
+) -> dict[str, str]:
+    """One run's params. Pass ``experiment`` when the DB may hold the
+    same run id under several experiments (uniqueness is per pair) —
+    without it, params from every matching run merge."""
     with _reader(db_path) as conn:
-        rows = conn.execute(
+        sql = (
             "SELECT p.key, p.value FROM params p "
-            "JOIN runs r ON r.run_uuid = p.run_uuid WHERE r.run_id = ?",
-            (run_id,),
+            "JOIN runs r ON r.run_uuid = p.run_uuid WHERE r.run_id = ?"
         )
-        return {r["key"]: r["value"] for r in rows}
+        args: tuple = (run_id,)
+        if experiment is not None:
+            sql += " AND r.experiment = ?"
+            args = (run_id, experiment)
+        return {r["key"]: r["value"] for r in conn.execute(sql, args)}
 
 
 def read_metrics(
-    db_path: str | Path, run_id: str, key: str | None = None
+    db_path: str | Path,
+    run_id: str,
+    key: str | None = None,
+    experiment: str | None = None,
 ) -> list[dict]:
-    """Metric rows (key, value, step, timestamp) in insertion order."""
+    """Metric rows (key, value, step, timestamp) in insertion order.
+
+    NULL values read back as nan (NaN binds as NULL on insert). Pass
+    ``experiment`` to disambiguate a run id shared across experiments.
+    """
     with _reader(db_path) as conn:
         sql = (
             "SELECT m.key, m.value, m.step, m.timestamp FROM metrics m "
             "JOIN runs r ON r.run_uuid = m.run_uuid WHERE r.run_id = ?"
         )
-        args: tuple = (run_id,)
+        args: list = [run_id]
         if key is not None:
             sql += " AND m.key = ?"
-            args = (run_id, key)
+            args.append(key)
+        if experiment is not None:
+            sql += " AND r.experiment = ?"
+            args.append(experiment)
         sql += " ORDER BY m.rowid"
-        return [dict(r) for r in conn.execute(sql, args)]
+        rows = [dict(r) for r in conn.execute(sql, tuple(args))]
+    for r in rows:
+        if r["value"] is None:
+            r["value"] = float("nan")
+    return rows
 
 
 __all__ = [

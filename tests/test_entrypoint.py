@@ -1,0 +1,187 @@
+"""k8s/entrypoint.sh rank/coordinator derivation (VERDICT r2 #5).
+
+The one shell component on the critical multi-host path (reference
+counterpart k8s/entrypoint.sh:42-82): these subprocess tests run the real
+script with a stubbed environment — a fake ``python`` that dumps the
+exported JAX_* env and argv instead of training, a fake ``curl`` serving
+a canned pods response, and a temp serviceaccount dir — and assert the
+env contract that llmtrain_tpu.distributed.setup_distributed consumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+SCRIPT = Path(__file__).resolve().parents[1] / "k8s" / "entrypoint.sh"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("bash") is None, reason="requires bash"
+)
+
+FAKE_PYTHON = """#!/usr/bin/env bash
+echo "ARGS=$*"
+echo "JAX_PROCESS_ID=${JAX_PROCESS_ID:-}"
+echo "JAX_NUM_PROCESSES=${JAX_NUM_PROCESSES:-}"
+echo "JAX_COORDINATOR_ADDRESS=${JAX_COORDINATOR_ADDRESS:-}"
+"""
+
+FAKE_CURL = """#!/usr/bin/env bash
+cat "$FAKE_PODS_JSON"
+"""
+
+
+def _stub_bin(tmp_path: Path, *, with_curl: bool = False) -> Path:
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir(exist_ok=True)
+    (bin_dir / "python").write_text(FAKE_PYTHON)
+    (bin_dir / "python").chmod(0o755)
+    if with_curl:
+        (bin_dir / "curl").write_text(FAKE_CURL)
+        (bin_dir / "curl").chmod(0o755)
+    return bin_dir
+
+
+def _sa_dir(tmp_path: Path) -> Path:
+    sa = tmp_path / "sa"
+    sa.mkdir(exist_ok=True)
+    (sa / "namespace").write_text("trainer-ns")
+    (sa / "token").write_text("fake-token")
+    (sa / "ca.crt").write_text("fake-ca")
+    return sa
+
+
+def _run(tmp_path: Path, env: dict[str, str], *, with_curl: bool = False):
+    bin_dir = _stub_bin(tmp_path, with_curl=with_curl)
+    full_env = {
+        "PATH": f"{bin_dir}{os.pathsep}{os.environ['PATH']}",
+        "HOME": str(tmp_path),
+        **env,
+    }
+    return subprocess.run(
+        ["bash", str(SCRIPT)],
+        capture_output=True,
+        text=True,
+        env=full_env,
+        timeout=120,
+    )
+
+
+def _parse(stdout: str) -> dict[str, str]:
+    out = {}
+    for line in stdout.splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            out[k] = v
+    return out
+
+
+class TestCoordinatorRank:
+    def test_rank0_exports_own_pod_ip(self, tmp_path):
+        proc = _run(
+            tmp_path,
+            {
+                "JOB_COMPLETION_INDEX": "0",
+                "NUM_PROCESSES": "4",
+                "POD_IP": "10.0.0.5",
+                "LLMTRAIN_CONFIG": "/config/train.yaml",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        got = _parse(proc.stdout)
+        assert got["JAX_PROCESS_ID"] == "0"
+        assert got["JAX_NUM_PROCESSES"] == "4"
+        assert got["JAX_COORDINATOR_ADDRESS"] == "10.0.0.5:29500"
+        assert got["ARGS"] == "-m llmtrain_tpu train --config /config/train.yaml"
+
+    def test_coordinator_port_override(self, tmp_path):
+        proc = _run(
+            tmp_path,
+            {
+                "JOB_COMPLETION_INDEX": "0",
+                "NUM_PROCESSES": "2",
+                "POD_IP": "10.0.0.5",
+                "COORDINATOR_PORT": "19999",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert _parse(proc.stdout)["JAX_COORDINATOR_ADDRESS"] == "10.0.0.5:19999"
+
+    def test_rank0_requires_pod_ip(self, tmp_path):
+        proc = _run(
+            tmp_path, {"JOB_COMPLETION_INDEX": "0", "NUM_PROCESSES": "2"}
+        )
+        assert proc.returncode != 0
+        assert "POD_IP" in proc.stderr
+
+    def test_run_id_enables_auto_resume(self, tmp_path):
+        proc = _run(
+            tmp_path,
+            {
+                "JOB_COMPLETION_INDEX": "0",
+                "NUM_PROCESSES": "2",
+                "POD_IP": "10.0.0.5",
+                "LLMTRAIN_RUN_ID": "stable-run",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert (
+            "--run-id stable-run --auto-resume" in _parse(proc.stdout)["ARGS"]
+        )
+
+
+class TestWorkerRank:
+    def _worker_env(self, tmp_path, pods_json: dict) -> dict[str, str]:
+        pods = tmp_path / "pods.json"
+        pods.write_text(json.dumps(pods_json))
+        return {
+            "JOB_COMPLETION_INDEX": "2",
+            "NUM_PROCESSES": "4",
+            "JOB_NAME": "llmtrain-job",
+            "LLMTRAIN_SA_DIR": str(_sa_dir(tmp_path)),
+            "FAKE_PODS_JSON": str(pods),
+            "LLMTRAIN_DISCOVERY_TRIES": "3",
+            "LLMTRAIN_DISCOVERY_SLEEP": "0",
+        }
+
+    def test_worker_discovers_coordinator_ip(self, tmp_path):
+        env = self._worker_env(
+            tmp_path, {"items": [{"status": {"podIP": "10.0.0.9"}}]}
+        )
+        proc = _run(tmp_path, env, with_curl=True)
+        assert proc.returncode == 0, proc.stderr
+        got = _parse(proc.stdout)
+        assert got["JAX_PROCESS_ID"] == "2"
+        assert got["JAX_NUM_PROCESSES"] == "4"
+        assert got["JAX_COORDINATOR_ADDRESS"] == "10.0.0.9:29500"
+
+    def test_worker_fails_when_no_coordinator_pod(self, tmp_path):
+        env = self._worker_env(tmp_path, {"items": []})
+        proc = _run(tmp_path, env, with_curl=True)
+        assert proc.returncode != 0
+        assert "coordinator discovery failed" in proc.stderr
+
+    def test_worker_waits_for_pending_pod_ip(self, tmp_path):
+        """A scheduled-but-not-ready coordinator pod (no podIP yet) keeps
+        polling rather than exporting an empty address."""
+        env = self._worker_env(tmp_path, {"items": [{"status": {}}]})
+        proc = _run(tmp_path, env, with_curl=True)
+        assert proc.returncode != 0
+        assert proc.stderr.count("waiting for coordinator pod IP") == 3
+
+
+class TestPreconditions:
+    def test_requires_job_completion_index(self, tmp_path):
+        proc = _run(tmp_path, {"NUM_PROCESSES": "2"})
+        assert proc.returncode == 1
+        assert "JOB_COMPLETION_INDEX missing" in proc.stderr
+
+    def test_requires_num_processes(self, tmp_path):
+        proc = _run(tmp_path, {"JOB_COMPLETION_INDEX": "0"})
+        assert proc.returncode == 1
+        assert "NUM_PROCESSES missing" in proc.stderr

@@ -153,15 +153,35 @@ class TestSqliteTrackerUnit:
     def test_same_run_id_across_experiments(self, tmp_path):
         """One DB file can hold the same run id under different
         experiments — the uniqueness constraint is (run_id, experiment),
-        so switching mlflow.experiment mid-project doesn't crash."""
+        so switching mlflow.experiment mid-project doesn't crash — and
+        the query helpers scope by experiment to keep them apart."""
         db = tmp_path / "t.db"
-        for exp in ("exp-a", "exp-b"):
+        for i, exp in enumerate(("exp-a", "exp-b")):
             t = SqliteTracker(f"sqlite:///{db}", exp)
             t.start_run("my-run")
-            t.log_metrics({"m": 1.0}, step=1)
+            t.log_params({"which": exp})
+            t.log_metrics({"m": float(i)}, step=1)
             t.end_run()
         assert len(read_runs(db, "exp-a")) == 1
         assert len(read_runs(db, "exp-b")) == 1
+        assert read_params(db, "my-run", experiment="exp-b")["which"] == "exp-b"
+        ms = read_metrics(db, "my-run", "m", experiment="exp-a")
+        assert [(m["step"], m["value"]) for m in ms] == [(1, 0.0)]
+
+    def test_nan_metric_logs_instead_of_crashing(self, tmp_path):
+        """A diverged run logging loss=nan must keep training alive:
+        sqlite3 binds NaN as NULL, the column is nullable, and reads map
+        NULL back to nan."""
+        import math
+
+        db = tmp_path / "t.db"
+        t = SqliteTracker(f"sqlite:///{db}", "exp")
+        t.start_run("r-nan")
+        t.log_metrics({"train/loss": float("nan"), "ok": 1.5}, step=1)
+        t.end_run()
+        rows = {m["key"]: m["value"] for m in read_metrics(db, "r-nan")}
+        assert math.isnan(rows["train/loss"])
+        assert rows["ok"] == 1.5
 
     def test_build_tracker_backend_selection(self):
         from types import SimpleNamespace
