@@ -117,8 +117,30 @@ class SqliteTracker:
             self._conn = sqlite3.connect(str(self._db_path))
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.executescript(_SCHEMA)
+            self._migrate_nullable_metric_values(self._conn)
             self._conn.commit()
         return self._conn
+
+    @staticmethod
+    def _migrate_nullable_metric_values(conn: sqlite3.Connection) -> None:
+        """v1 DBs declared metrics.value NOT NULL; CREATE IF NOT EXISTS
+        can't relax that, and a NaN metric (bound as NULL) would still
+        crash a resumed run against such a file. Rebuild the table once."""
+        notnull = {
+            row[1]: bool(row[3]) for row in conn.execute("PRAGMA table_info(metrics)")
+        }
+        if not notnull.get("value"):
+            return
+        conn.executescript(
+            "DROP INDEX IF EXISTS idx_metrics_run_key;"
+            "ALTER TABLE metrics RENAME TO _metrics_v1;"
+        )
+        conn.executescript(_SCHEMA)  # recreates metrics (nullable) + index
+        conn.execute(
+            "INSERT INTO metrics (run_uuid, key, value, step, timestamp) "
+            "SELECT run_uuid, key, value, step, timestamp FROM _metrics_v1"
+        )
+        conn.execute("DROP TABLE _metrics_v1")
 
     # ------------------------------------------------------------- protocol
     def start_run(self, run_id: str, run_name: str | None = None) -> None:

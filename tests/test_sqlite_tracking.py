@@ -183,6 +183,46 @@ class TestSqliteTrackerUnit:
         assert math.isnan(rows["train/loss"])
         assert rows["ok"] == 1.5
 
+    def test_migrates_v1_not_null_metrics_schema(self, tmp_path):
+        """A DB created by the v1 schema (metrics.value NOT NULL) is
+        rebuilt on connect so NaN logging works on resumed runs too."""
+        import math
+
+        db = tmp_path / "old.db"
+        with sqlite3.connect(db) as conn:
+            conn.executescript(
+                """
+                CREATE TABLE runs (
+                    run_uuid TEXT PRIMARY KEY, run_id TEXT NOT NULL,
+                    experiment TEXT NOT NULL, run_name TEXT,
+                    status TEXT NOT NULL, start_time REAL NOT NULL,
+                    end_time REAL, UNIQUE (run_id, experiment));
+                CREATE TABLE params (
+                    run_uuid TEXT NOT NULL, key TEXT NOT NULL,
+                    value TEXT NOT NULL, PRIMARY KEY (run_uuid, key));
+                CREATE TABLE metrics (
+                    run_uuid TEXT NOT NULL, key TEXT NOT NULL,
+                    value REAL NOT NULL, step INTEGER, timestamp REAL NOT NULL);
+                CREATE INDEX idx_metrics_run_key ON metrics (run_uuid, key, step);
+                CREATE TABLE tags (
+                    run_uuid TEXT NOT NULL, key TEXT NOT NULL,
+                    value TEXT NOT NULL, PRIMARY KEY (run_uuid, key));
+                CREATE TABLE artifacts (
+                    run_uuid TEXT NOT NULL, local_path TEXT NOT NULL,
+                    artifact_path TEXT);
+                INSERT INTO runs VALUES ('u1', 'old-run', 'exp', 'n',
+                    'FINISHED', 1.0, 2.0);
+                INSERT INTO metrics VALUES ('u1', 'm', 7.5, 1, 1.5);
+                """
+            )
+        t = SqliteTracker(f"sqlite:///{db}", "exp")
+        t.start_run("old-run")  # joins the v1 row after migration
+        t.log_metrics({"m": float("nan")}, step=2)  # crashed pre-migration
+        t.end_run()
+        vals = [m["value"] for m in read_metrics(db, "old-run", "m")]
+        assert vals[0] == 7.5  # preserved through the rebuild
+        assert math.isnan(vals[1])
+
     def test_build_tracker_backend_selection(self):
         from types import SimpleNamespace
 
