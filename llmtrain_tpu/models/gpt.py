@@ -164,20 +164,28 @@ class CausalSelfAttention(nn.Module):
         elif self.attention == "ring":
             # Sequence-parallel exact attention over the mesh's `sequence`
             # axis (ops/ring_attention.py); falls back to blockwise when no
-            # ambient mesh shards the sequence. NOTE: ring/ulysses are
-            # packed-sequence paths — padding masks are NOT applied inside
-            # attention here (only flash/dense do that); use those for
-            # genuinely padded batches.
+            # ambient mesh shards the sequence. Padding masks are applied
+            # inside attention here too (the mask shard rotates with its
+            # K/V shard); assume_packed drops the operand like flash.
             from ..ops.ring_attention import ring_or_blockwise
 
-            out = ring_or_blockwise(q, k, v, causal=True)
+            out = ring_or_blockwise(
+                q, k, v,
+                causal=True,
+                key_mask=None if self.assume_packed else attention_mask,
+            )
         elif self.attention == "ulysses":
             # All-to-all sequence parallelism (ops/ulysses_attention.py):
             # the ring alternative — 2 all-to-alls instead of s ppermutes.
-            # Packed sequences only, same caveat as ring above.
+            # Mask handling as for ring (full mask all-gathered after the
+            # head exchange).
             from ..ops.ulysses_attention import ulysses_or_blockwise
 
-            out = ulysses_or_blockwise(q, k, v, causal=True)
+            out = ulysses_or_blockwise(
+                q, k, v,
+                causal=True,
+                key_mask=None if self.assume_packed else attention_mask,
+            )
         else:
             out = dense_attention(
                 q,

@@ -14,14 +14,13 @@ Both paths are O(T) memory — no (T, T) materialization. Set
 ``LLMTRAIN_FLASH_BWD=blockwise`` to force the recompute backward on TPU
 (the A/B knob for benchmarking fused vs recompute).
 
-Key-padding masks are applied INSIDE attention on every flash path
-(parity with the reference, models/gpt.py:60-64): masked keys get -inf
-logits before the softmax. Packed pipelines (hf_text/dummy_text windows)
-emit all-ones masks, for which the masked and unmasked kernels agree
-exactly; ``model.extra.assume_packed`` drops the mask operand from the
-hot path when the data is provably packed. Ring/ulysses remain
-packed-only (masks are not applied there — models/gpt.py routes and
-documents this).
+Key-padding masks are applied INSIDE attention on every path — flash
+here, ring/ulysses in their own modules — matching the reference
+(models/gpt.py:60-64): masked keys get -inf logits before the softmax.
+Packed pipelines (hf_text/dummy_text windows) emit all-ones masks, for
+which the masked and unmasked kernels agree exactly;
+``model.extra.assume_packed`` drops the mask operand from the hot path
+when the data is provably packed.
 
 Grouped-query attention is native: ``k``/``v`` may carry n_kv_heads <
 n_heads and the Pallas kernels index K/V by head group — no jnp.repeat

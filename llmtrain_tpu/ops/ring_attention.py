@@ -25,6 +25,7 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    key_mask: jax.Array | None = None,
     *,
     axis_name: str = "sequence",
     causal: bool = True,
@@ -33,7 +34,9 @@ def ring_attention(
     """Local-shard ring attention; must run inside shard_map over ``axis_name``.
 
     q/k/v: (B, T_local, H, D) shards, contiguous along the global sequence in
-    axis order. Returns the (B, T_local, H, D) output shard.
+    axis order; ``key_mask`` is the matching (B, T_local) padding-mask shard
+    (nonzero = attend) and rotates around the ring WITH its K/V shard.
+    Returns the (B, T_local, H, D) output shard.
     """
     axis_size = jax.lax.psum(1, axis_name)
     axis_index = jax.lax.axis_index(axis_name)
@@ -44,9 +47,10 @@ def ring_attention(
         chunk = t_local
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    masked = key_mask is not None
 
     def body(i, carry):
-        acc, row_max, row_sum, k_cur, v_cur = carry
+        acc, row_max, row_sum, k_cur, v_cur, m_cur = carry
         # After i rotations this device holds the K/V shard that started on
         # device (axis_index - i); its global offset drives the causal mask.
         kv_offset = ((axis_index - i) % axis_size) * t_local
@@ -58,6 +62,7 @@ def ring_attention(
             kv_offset=kv_offset,
             causal=causal,
             kv_chunk=chunk,
+            key_mask=m_cur if masked else None,
         )
         new_max = jnp.maximum(row_max, max2)
         c1 = jnp.exp(row_max - new_max)
@@ -66,7 +71,9 @@ def ring_attention(
         row_sum = row_sum * c1 + sum2 * c2
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        return acc, new_max, row_sum, k_cur, v_cur
+        if masked:
+            m_cur = jax.lax.ppermute(m_cur, axis_name, perm)
+        return acc, new_max, row_sum, k_cur, v_cur, m_cur
 
     b, _, h, d = q.shape
     init = (
@@ -75,8 +82,9 @@ def ring_attention(
         jnp.zeros((b, t_local, h), jnp.float32),
         k,
         v,
+        jnp.asarray(key_mask, jnp.int32) if masked else jnp.zeros((), jnp.int32),
     )
-    acc, _, row_sum, _, _ = jax.lax.fori_loop(0, axis_size, body, init)
+    acc, _, row_sum, _, _, _ = jax.lax.fori_loop(0, axis_size, body, init)
     return (acc / row_sum[..., None]).astype(q.dtype)
 
 
@@ -101,22 +109,37 @@ def _mesh_dim_axes(mesh: jax.sharding.Mesh) -> tuple:
     )
 
 
-def attention_shard_map(mesh: jax.sharding.Mesh, local_fn):
-    """Wrap a local-shard attention fn into a (q, k, v) shard_map over the
-    standard activation layout (``RING_DIM_AXES``): batch over
-    (data, fsdp), sequence over ``sequence``, heads over ``tensor``.
+def attention_shard_map(
+    mesh: jax.sharding.Mesh,
+    local_fn,
+    *,
+    with_mask: bool = False,
+    mask_replicated: bool = False,
+):
+    """Wrap a local-shard attention fn into a (q, k, v[, key_mask])
+    shard_map over the standard activation layout (``RING_DIM_AXES``):
+    batch over (data, fsdp), sequence over ``sequence``, heads over
+    ``tensor``. The (B, T) mask shards like (batch, sequence) — or, with
+    ``mask_replicated``, only over batch, handing every device the full
+    sequence mask (ulysses wants that post-exchange; gathering it at
+    runtime would be a wasted per-layer collective).
     Shared by ring and ulysses (ops/ulysses_attention.py)."""
     P = jax.sharding.PartitionSpec
-    spec = P(
-        *(
-            axes if len(axes) > 1 else (axes[0] if axes else None)
-            for axes in _mesh_dim_axes(mesh)
+    dim_axes = _mesh_dim_axes(mesh)
+
+    def _ax(axes):
+        return axes if len(axes) > 1 else (axes[0] if axes else None)
+
+    spec = P(*(_ax(axes) for axes in dim_axes))
+    specs = [spec, spec, spec]
+    if with_mask:
+        specs.append(
+            P(_ax(dim_axes[0]), None if mask_replicated else _ax(dim_axes[1]))
         )
-    )
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(specs),
         out_specs=spec,
         check_vma=False,
     )
@@ -129,11 +152,16 @@ def ring_attention_sharded(
     mesh: jax.sharding.Mesh,
     *,
     causal: bool = True,
+    key_mask: jax.Array | None = None,
 ) -> jax.Array:
     """shard_map wrapper: global (B, T, H, D) arrays over the named mesh."""
     fn = attention_shard_map(
-        mesh, functools.partial(ring_attention, axis_name="sequence", causal=causal)
+        mesh,
+        functools.partial(ring_attention, axis_name="sequence", causal=causal),
+        with_mask=key_mask is not None,
     )
+    if key_mask is not None:
+        return fn(q, k, v, key_mask)
     return fn(q, k, v)
 
 
@@ -146,16 +174,17 @@ def route_or_blockwise(
     scheme: str,
     sharded_fn,
     extra_predicate=None,
+    key_mask: jax.Array | None = None,
 ):
     """Shared route-or-fallback policy for sequence-parallel schemes.
 
-    Routes to ``sharded_fn(q, k, v, mesh, causal=...)`` when an ambient
-    mesh has a sequence axis > 1, every sharded dim divides evenly, and
-    the optional ``extra_predicate(mesh, q)`` holds; otherwise falls back
-    to single-device blockwise. Batch-1 traces (the param-init probe,
-    ModelAdapter.init_params' (1, block_size) batch) fall back silently by
-    design; real batches losing sequence parallelism get a trace-time
-    warning.
+    Routes to ``sharded_fn(q, k, v, mesh, causal=..., key_mask=...)``
+    when an ambient mesh has a sequence axis > 1, every sharded dim
+    divides evenly, and the optional ``extra_predicate(mesh, q)`` holds;
+    otherwise falls back to single-device blockwise. Batch-1 traces (the
+    param-init probe, ModelAdapter.init_params' (1, block_size) batch)
+    fall back silently by design; real batches losing sequence
+    parallelism get a trace-time warning.
     """
     mesh = _ambient_mesh()
     if (
@@ -165,7 +194,7 @@ def route_or_blockwise(
     ):
         dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
         if dims_ok and (extra_predicate is None or extra_predicate(mesh, q)):
-            return sharded_fn(q, k, v, mesh, causal=causal)
+            return sharded_fn(q, k, v, mesh, causal=causal, key_mask=key_mask)
         if q.shape[0] > 1:
             from ..utils.logging import get_logger
 
@@ -182,14 +211,23 @@ def route_or_blockwise(
                 _dim_shards(mesh, 1),
                 _dim_shards(mesh, 2),
             )
-    return blockwise_attention(q, k, v, causal=causal)
+    return blockwise_attention(q, k, v, causal=causal, key_mask=key_mask)
 
 
-def ring_or_blockwise(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
+def ring_or_blockwise(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    key_mask: jax.Array | None = None,
+):
     """Ring attention when an ambient mesh shards the sequence; blockwise
-    otherwise (same math, no ring)."""
+    otherwise (same math, no ring). ``key_mask`` is the reference's (B, T)
+    padding mask, applied inside attention on both paths."""
     return route_or_blockwise(
-        q, k, v, causal=causal, scheme="ring", sharded_fn=ring_attention_sharded
+        q, k, v, causal=causal, scheme="ring",
+        sharded_fn=ring_attention_sharded, key_mask=key_mask,
     )
 
 

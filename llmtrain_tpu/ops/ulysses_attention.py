@@ -31,6 +31,7 @@ def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    key_mask: jax.Array | None = None,
     *,
     axis_name: str = "sequence",
     causal: bool = True,
@@ -38,7 +39,11 @@ def ulysses_attention(
     """Local-shard Ulysses attention; must run inside shard_map.
 
     q/k/v: (B, T_local, H, D) shards, contiguous along the global sequence
-    in axis order. Returns the (B, T_local, H, D) output shard.
+    in axis order; ``key_mask`` is the FULL-sequence (B, T) padding mask
+    (replicated over the sequence axis by the shard_map spec — the
+    post-exchange attention sees the whole sequence, and replicating
+    beats an all-gather per layer). Returns the (B, T_local, H, D)
+    output shard.
     """
     s = jax.lax.psum(1, axis_name)
     heads = q.shape[2]
@@ -56,7 +61,7 @@ def ulysses_attention(
     qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
     qh, kh, vh = qkv[0], qkv[1], qkv[2]  # each (B, T, H/s, D)
 
-    out = blockwise_attention(qh, kh, vh, causal=causal)  # (B, T, H/s, D)
+    out = blockwise_attention(qh, kh, vh, causal=causal, key_mask=key_mask)
     # Collective 2: back to sequence-sharded, all heads local.
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -68,6 +73,7 @@ def ulysses_attention_sharded(
     mesh: jax.sharding.Mesh,
     *,
     causal: bool = True,
+    key_mask: jax.Array | None = None,
 ) -> jax.Array:
     """shard_map wrapper: global (B, T, H, D) arrays over the named mesh
     (same activation layout as ring — ring_attention.attention_shard_map).
@@ -75,7 +81,11 @@ def ulysses_attention_sharded(
     fn = attention_shard_map(
         mesh,
         functools.partial(ulysses_attention, axis_name="sequence", causal=causal),
+        with_mask=key_mask is not None,
+        mask_replicated=True,
     )
+    if key_mask is not None:
+        return fn(q, k, v, key_mask)
     return fn(q, k, v)
 
 
@@ -87,11 +97,17 @@ def _local_heads_divide(mesh: jax.sharding.Mesh, q: jax.Array) -> bool:
 
 
 def ulysses_or_blockwise(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    key_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Ulysses when an ambient mesh shards the sequence and local heads
     divide by the sequence degree; blockwise otherwise (shared policy:
-    ring_attention.route_or_blockwise)."""
+    ring_attention.route_or_blockwise). ``key_mask`` is the reference's
+    (B, T) padding mask, applied inside attention on both paths."""
     return route_or_blockwise(
         q,
         k,
@@ -100,6 +116,7 @@ def ulysses_or_blockwise(
         scheme="ulysses",
         sharded_fn=ulysses_attention_sharded,
         extra_predicate=_local_heads_divide,
+        key_mask=key_mask,
     )
 
 
