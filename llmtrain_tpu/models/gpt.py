@@ -177,8 +177,8 @@ class CausalSelfAttention(nn.Module):
         elif self.attention == "ulysses":
             # All-to-all sequence parallelism (ops/ulysses_attention.py):
             # the ring alternative — 2 all-to-alls instead of s ppermutes.
-            # Mask handling as for ring (full mask all-gathered after the
-            # head exchange).
+            # The mask arrives full-sequence on every device (replicated
+            # by the shard_map in_spec — no runtime gather).
             from ..ops.ulysses_attention import ulysses_or_blockwise
 
             out = ulysses_or_blockwise(

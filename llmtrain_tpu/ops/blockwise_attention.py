@@ -73,11 +73,16 @@ def _chunk_scan(
         row_sum = row_sum * correction + p.sum(axis=-1)
         return (acc, new_max, row_sum), None
 
-    b, _, h, d = q.shape
+    # Data-dependent zeros (not fresh constants): under an enclosing
+    # shard_map with varying-axes checking (e.g. the pipeline executor,
+    # parallel/pipeline.py), a constant init would type-mismatch the
+    # varying carry the body produces. Deriving from q inherits its
+    # varying axes; XLA folds the multiply.
+    zrow = q[..., 0].astype(jnp.float32) * 0.0  # (B, Tq, H)
     init = (
-        jnp.zeros((b, tq, h, d), jnp.float32),
-        jnp.full((b, tq, h), _NEG_INF, jnp.float32),
-        jnp.zeros((b, tq, h), jnp.float32),
+        q.astype(jnp.float32) * 0.0,
+        zrow + _NEG_INF,
+        zrow,
     )
     k_scan = jnp.moveaxis(k_chunks, 1, 0)
     v_scan = jnp.moveaxis(v_chunks, 1, 0)

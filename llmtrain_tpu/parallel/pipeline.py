@@ -66,7 +66,7 @@ def _interleave_permutation(n_layers: int, n_stages: int, v: int) -> np.ndarray:
 
 
 def gpipe_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[..., jax.Array],
     params: Any,
     x: jax.Array,
     mesh: jax.sharding.Mesh,
@@ -76,6 +76,7 @@ def gpipe_apply(
     remat_stage: bool = True,
     virtual_chunks: int = 1,
     param_specs: Any | None = None,
+    mask: jax.Array | None = None,
 ) -> jax.Array:
     """Run ``x`` through all layers with pipeline scheduling over ``axis``.
 
@@ -86,6 +87,12 @@ def gpipe_apply(
     (B, T, D) after all layers, replicated over ``axis`` (non-final stages
     receive the result via psum).
 
+    ``mask``: optional (B, T) per-token padding mask. It does NOT ride the
+    stage ring — each tick's stage knows which microbatch it is processing
+    (work item t - stage), so the matching mask slice is indexed from the
+    replicated-over-``axis`` array and passed as ``stage_fn``'s third
+    argument.
+
     ``param_specs``: optional pytree of PartitionSpecs (matching ``params``)
     for the NON-layer dims — e.g. tensor-parallel sharding of head/mlp dims;
     every spec's dim 0 must be the ``axis`` entry. Default: non-layer dims
@@ -95,7 +102,7 @@ def gpipe_apply(
     """
     n_stages = pipeline_degree(mesh)
     if n_stages == 1:
-        return stage_fn(params, x)
+        return stage_fn(params, x) if mask is None else stage_fn(params, x, mask)
     n_micro = n_microbatches
     v = virtual_chunks
     if n_micro < 1:
@@ -143,7 +150,9 @@ def gpipe_apply(
     else:
         p_specs = jax.tree.map(lambda a: P(axis, *([None] * (a.ndim - 1))), params)
 
-    def inner(p: Any, x_local: jax.Array) -> jax.Array:
+    masked = mask is not None
+
+    def inner(p: Any, x_local: jax.Array, *rest: jax.Array) -> jax.Array:
         stage = jax.lax.axis_index(axis)
         batch = x_local.shape[0]
         if batch % n_micro != 0:
@@ -152,6 +161,10 @@ def gpipe_apply(
             )
         mb = batch // n_micro
         xm = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        mask_m = None
+        if masked:
+            (mask_local,) = rest
+            mask_m = mask_local.reshape(n_micro, mb, *mask_local.shape[1:])
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         last = n_stages - 1
 
@@ -197,7 +210,13 @@ def gpipe_apply(
 
             # This stage processes work item t - stage, whose round picks
             # which of the stage's local chunks to run.
-            out = fn(chunk_params(round_of(t - stage)), inp)
+            if masked:
+                m_mb = jax.lax.dynamic_index_in_dim(
+                    mask_m, micro_of(t - stage), keepdims=False
+                )
+                out = fn(chunk_params(round_of(t - stage)), inp, m_mb)
+            else:
+                out = fn(chunk_params(round_of(t - stage)), inp)
 
             # The final stage finishes work item t-(S-1); final-round items
             # are results.
@@ -230,12 +249,17 @@ def gpipe_apply(
         y = jax.lax.psum(out_buf, axis)
         return y.reshape(x_local.shape)
 
+    in_specs: tuple = (p_specs, x_spec)
+    operands: tuple = (params, x)
+    if masked:
+        in_specs = (*in_specs, P(batch_axes, None))
+        operands = (*operands, mask)
     return shard_map(
         inner,
         mesh=mesh,
-        in_specs=(p_specs, x_spec),
+        in_specs=in_specs,
         out_specs=x_spec,
-    )(params, x)
+    )(*operands)
 
 
 __all__ = ["gpipe_apply", "pipeline_degree", "BATCH_AXES"]

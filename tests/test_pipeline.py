@@ -194,6 +194,68 @@ class TestPipelineGPT:
             out = model.apply({"params": params}, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_masked_pipelined_matches_sequential(self, attention):
+        """Padding masks inside pipelined attention: the executor hands
+        each stage tick its microbatch's mask slice, so pipelined and
+        sequential execution agree on padded batches."""
+        cfg = _pp_cfg(model={"attention": attention})
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(5), (8, 16), 0, 32)
+        lens = np.asarray([16, 9, 16, 3, 12, 16, 7, 16])
+        mask = jnp.asarray(
+            (np.arange(16)[None, :] < lens[:, None]).astype(np.int32)
+        )
+        ref = model.apply({"params": params}, tokens, mask)
+        mesh = _mesh()
+        with mesh:
+            out = jax.jit(
+                lambda p, t, m: model.apply({"params": p}, t, m)
+            )(params, tokens, mask)
+        # Compare valid rows (padded rows' logits are zeroed-garbage by
+        # contract; the loss masks them).
+        valid = np.asarray(mask)[:, :, None]
+        np.testing.assert_allclose(
+            np.asarray(out) * valid, np.asarray(ref) * valid, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_masked_pipelined_grads_match_sequential(self, attention):
+        cfg = _pp_cfg(model={"attention": attention})
+        adapter, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(6), (8, 16), 0, 32)
+        lens = np.asarray([16, 9, 16, 3, 12, 16, 7, 14])
+        mask = jnp.asarray(
+            (np.arange(16)[None, :] < lens[:, None]).astype(np.int32)
+        )
+        batch = {"input_ids": tokens, "labels": tokens, "attention_mask": mask}
+
+        def loss(p):
+            ls, tk = adapter.compute_loss_components(model, p, batch)
+            return jnp.sum(ls) / jnp.sum(tk)
+
+        g_ref = jax.grad(loss)(params)
+        with _mesh():
+            g_pp = jax.jit(jax.grad(loss))(params)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_assume_packed_drops_mask(self):
+        """assume_packed ignores the mask operand entirely — identical
+        output with and without one (all-ones equivalence is the packed
+        contract)."""
+        cfg = _pp_cfg(model={"extra": {"tokenizer": "byte",
+                                       "pipeline_microbatches": 2,
+                                       "assume_packed": True}})
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(7), (4, 16), 0, 32)
+        half = jnp.asarray(
+            (np.arange(16)[None, :] < 8).astype(np.int32)
+        ) * jnp.ones((4, 1), jnp.int32)
+        a = model.apply({"params": params}, tokens)
+        b = model.apply({"params": params}, tokens, half)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_batch_divisor_hook(self):
         from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
 
