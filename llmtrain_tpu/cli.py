@@ -302,7 +302,11 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
     try:
         import torch
 
-        from .interop import params_to_torch_state_dict
+        from .interop import (
+            is_pipeline_tree,
+            params_to_torch_state_dict,
+            pipeline_params_to_gpt,
+        )
         from .registry import get_model_adapter
 
         initialize_registries()
@@ -311,6 +315,11 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
         ckpt_path, params, step = _load_checkpoint_params(
             cfg, adapter, model, args.from_spec
         )
+        if is_pipeline_tree(params):
+            # Pipeline-trained run: unstack to the per-layer gpt tree
+            # first (interop/pipeline_convert.py) — same math, so the
+            # export is still reference-exact.
+            params = pipeline_params_to_gpt(params)
         sd = {k: torch.from_numpy(v) for k, v in params_to_torch_state_dict(params).items()}
         out = Path(args.output)
         out.parent.mkdir(parents=True, exist_ok=True)
@@ -356,7 +365,12 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
         import numpy as np
         import torch
 
-        from .interop import params_from_torch_state_dict
+        from .interop import (
+            gpt_params_to_pipeline,
+            is_pipeline_tree,
+            params_from_torch_state_dict,
+            pipeline_params_to_gpt,
+        )
         from .registry import get_model_adapter
         from .training.checkpoint import CheckpointManager, state_to_host
         from .training.optimizer import build_optimizer
@@ -383,7 +397,16 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
             k: (v.float().numpy() if hasattr(v, "numpy") else v)
             for k, v in raw.items()
         }
-        params = params_from_torch_state_dict(sd, template)
+        if is_pipeline_tree(template):
+            # gpt_pipeline config: map the torch per-layer weights through
+            # the gpt-shaped template, then restack for the pipeline tree
+            # (interop/pipeline_convert.py — abstract-template capable).
+            gpt_template = pipeline_params_to_gpt(template)
+            params = gpt_params_to_pipeline(
+                params_from_torch_state_dict(sd, gpt_template)
+            )
+        else:
+            params = params_from_torch_state_dict(sd, template)
 
         state = create_train_state(params, build_optimizer(cfg.trainer))
         target = CheckpointManager(out_dir).save_host(

@@ -1,8 +1,20 @@
-"""Cross-framework interop (torch checkpoint export/import)."""
+"""Cross-framework interop (torch checkpoint export/import) and
+pipeline↔gpt parameter-tree conversion."""
 
+from .pipeline_convert import (
+    gpt_params_to_pipeline,
+    is_pipeline_tree,
+    pipeline_params_to_gpt,
+)
 from .torch_interop import (
     params_from_torch_state_dict,
     params_to_torch_state_dict,
 )
 
-__all__ = ["params_to_torch_state_dict", "params_from_torch_state_dict"]
+__all__ = [
+    "params_to_torch_state_dict",
+    "params_from_torch_state_dict",
+    "pipeline_params_to_gpt",
+    "gpt_params_to_pipeline",
+    "is_pipeline_tree",
+]

@@ -1,0 +1,136 @@
+"""gpt_pipeline ↔ gpt parameter-tree conversion.
+
+The pipeline model stacks every block parameter with a LEADING layer dim
+so stages can shard it over the ``pipeline`` mesh axis
+(models/gpt_pipeline.py ``_stacked``); the plain GPT keeps per-layer
+``block_{i}`` subtrees (models/gpt.py). The math is identical (same
+pre-norm blocks, GELU MLP, LN eps 1e-6, tied lm_head), so converting is
+pure re-indexing — no numerics.
+
+This unlocks the rest of the toolchain for pipeline-trained runs:
+``export-checkpoint`` (reference torch format, via
+interop/torch_interop.py), ``import-checkpoint``, KV-cache ``generate``,
+and torch-parity evaluation all operate on the ``gpt`` tree. The CLI
+applies the conversion automatically when ``model.name: gpt_pipeline``
+(cli.py export/import handlers).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+# stacked leaf name -> (gpt block subtree path)
+_BLOCK_MAP: dict[str, tuple[str, ...]] = {
+    "ln1_scale": ("ln_1", "scale"),
+    "ln1_bias": ("ln_1", "bias"),
+    "qkv_kernel": ("attn", "qkv_proj", "kernel"),
+    "qkv_bias": ("attn", "qkv_proj", "bias"),
+    "out_kernel": ("attn", "out_proj", "kernel"),
+    "out_bias": ("attn", "out_proj", "bias"),
+    "ln2_scale": ("ln_2", "scale"),
+    "ln2_bias": ("ln_2", "bias"),
+    "fc_kernel": ("mlp_fc", "kernel"),
+    "fc_bias": ("mlp_fc", "bias"),
+    "proj_kernel": ("mlp_proj", "kernel"),
+    "proj_bias": ("mlp_proj", "bias"),
+}
+
+
+def _set_path(tree: dict, path: tuple[str, ...], value) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def _get_path(tree: dict, path: tuple[str, ...]):
+    node = tree
+    for key in path:
+        node = node[key]
+    return node
+
+
+def _layer_slice(leaf, i: int):
+    """Layer ``i`` of a stacked leaf; abstract (ShapeDtypeStruct) leaves
+    slice symbolically, so the conversion also maps checkpoint templates
+    (the import-checkpoint path converts shapes before any data exists)."""
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype)
+    return leaf[i]
+
+
+def pipeline_params_to_gpt(params: Params) -> Params:
+    """Stacked gpt_pipeline tree → per-layer models/gpt.py tree.
+
+    Works on real arrays AND abstract ShapeDtypeStruct trees (templates).
+    """
+    for required in ("token_embedding", "position_embedding", "qkv_kernel"):
+        if required not in params:
+            raise ValueError(
+                f"params have no {required!r}; not a models/gpt_pipeline.py tree"
+            )
+    n_layers = params["qkv_kernel"].shape[0]
+    out: dict[str, Any] = {
+        "token_embedding": dict(params["token_embedding"]),
+        "position_embedding": dict(params["position_embedding"]),
+        "ln_f": {"scale": params["ln_f_scale"], "bias": params["ln_f_bias"]},
+    }
+    if "lm_head" in params:
+        out["lm_head"] = dict(params["lm_head"])
+    for i in range(n_layers):
+        block: dict[str, Any] = {}
+        for name, path in _BLOCK_MAP.items():
+            _set_path(block, path, _layer_slice(params[name], i))
+        out[f"block_{i}"] = block
+    return out
+
+
+def gpt_params_to_pipeline(params: Params) -> Params:
+    """Per-layer models/gpt.py tree → stacked gpt_pipeline tree.
+
+    Requires the fused-qkv (MHA) tree — GQA's split q_proj/kv_proj has no
+    pipeline counterpart.
+    """
+    for required in ("token_embedding", "position_embedding", "block_0"):
+        if required not in params:
+            raise ValueError(
+                f"params have no {required!r}; not a models/gpt.py tree"
+            )
+    if "qkv_proj" not in params["block_0"]["attn"]:
+        raise ValueError(
+            "GQA/MQA trees (split q_proj/kv_proj, model.extra.n_kv_heads) "
+            "cannot convert to the pipeline layout, which stacks a fused "
+            "qkv kernel"
+        )
+    n_layers = 0
+    while f"block_{n_layers}" in params:
+        n_layers += 1
+    out: dict[str, Any] = {
+        "token_embedding": dict(params["token_embedding"]),
+        "position_embedding": dict(params["position_embedding"]),
+        "ln_f_scale": params["ln_f"]["scale"],
+        "ln_f_bias": params["ln_f"]["bias"],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = dict(params["lm_head"])
+    for name, path in _BLOCK_MAP.items():
+        out[name] = jnp.stack(
+            [_get_path(params[f"block_{i}"], path) for i in range(n_layers)]
+        )
+    return out
+
+
+def is_pipeline_tree(params: Params) -> bool:
+    return "qkv_kernel" in params and "block_0" not in params
+
+
+__all__ = [
+    "pipeline_params_to_gpt",
+    "gpt_params_to_pipeline",
+    "is_pipeline_tree",
+]

@@ -43,7 +43,10 @@ def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     xf = x.astype(jnp.float32)
     mu = xf.mean(axis=-1, keepdims=True)
     var = xf.var(axis=-1, keepdims=True)
-    y = (xf - mu) * jax.lax.rsqrt(var + 1e-5)
+    # eps matches models/gpt.py's flax LayerNorm (1e-6, docs/parity.md) so
+    # pipeline<->gpt parameter conversion (interop/pipeline_convert.py) is
+    # numerically exact.
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
