@@ -678,6 +678,37 @@ def _handle_generate(args: argparse.Namespace) -> int:
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
 
+        from .interop import is_pipeline_tree, pipeline_params_to_gpt
+
+        if is_pipeline_tree(params):
+            # Pipeline-trained run: decode through the equivalent plain GPT
+            # (interop/pipeline_convert.py — same math), which has the
+            # KV-cache path; the stacked model would fall back to the
+            # windowed re-forward loop.
+            from .models.gpt import GPT
+
+            params = pipeline_params_to_gpt(params)
+            model = GPT(
+                vocab_size=model.vocab_size,
+                block_size=model.block_size,
+                d_model=model.d_model,
+                n_layers=model.n_layers,
+                n_heads=model.n_heads,
+                d_ff=model.d_ff,
+                dropout=0.0,
+                tie_embeddings=model.tie_embeddings,
+                dtype=model.dtype,
+                param_dtype=model.param_dtype,
+                # Keep the validated attention impl: the windowed re-forward
+                # path (outputs beyond block_size) would otherwise revert a
+                # flash config to dense and materialize (T, T).
+                attention=model.attention,
+            )
+            logger.info(
+                "pipeline checkpoint converted to the gpt tree for KV-cache "
+                "decoding"
+            )
+
         eos_token_id = args.eos_token_id
         if eos_token_id is None and tokenizer is not None:
             # tiktoken encodings expose the end-of-text id as eot_token.

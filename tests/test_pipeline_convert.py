@@ -82,6 +82,26 @@ class TestConversion:
             gpt_tpl["block_0"]["attn"]["qkv_proj"]["kernel"], jax.ShapeDtypeStruct
         )
 
+    def test_cached_decode_via_conversion_matches_pipeline_reforward(self):
+        """Greedy KV-cache decoding through the converted GPT equals the
+        pipeline model's own re-forward decoding — the generate CLI's
+        conversion path is exact."""
+        from llmtrain_tpu.generation import generate
+
+        pipe, params = _pipeline_params(True)
+        gpt = GPT(dropout=0.0, tie_embeddings=True, **DIMS)
+        converted = pipeline_params_to_gpt(params)
+        prompt = np.asarray([[3, 1, 4, 1, 5]], np.int32)
+        cached = generate(
+            gpt, converted, prompt, max_new_tokens=8, temperature=0.0,
+            use_cache=True,
+        )
+        windowed = generate(
+            pipe, params, prompt, max_new_tokens=8, temperature=0.0,
+            use_cache=False,
+        )
+        np.testing.assert_array_equal(cached, windowed)
+
     def test_gqa_tree_rejected(self):
         gqa = {
             "token_embedding": {"embedding": np.zeros((4, 2))},
@@ -166,6 +186,13 @@ class TestPipelineExportCLI:
         imp = run(["import-checkpoint", "--config", str(cfg_path), "--input", str(pt),
                    "--output", str(imported), "--json"])
         assert imp.returncode == 0, imp.stderr
+
+        gen = run(["generate", "--config", str(cfg_path), "--from", "src",
+                   "--prompt-ids", "1,2,3", "--max-new-tokens", "4",
+                   "--temperature", "0", "--json"])
+        assert gen.returncode == 0, gen.stderr
+        assert len(json.loads(gen.stdout)["output_ids"]) == 7
+        assert "converted to the gpt tree" in gen.stderr
 
         ev_src = run(["eval", "--config", str(cfg_path), "--from", "src", "--json"])
         ev_imp = run(["eval", "--config", str(cfg_path), "--from", str(imported), "--json"])
