@@ -84,6 +84,12 @@ def params_to_torch_state_dict(params: Params) -> dict[str, np.ndarray]:
             raise ValueError(
                 f"block_{i}.attn has no qkv_proj; not a models/gpt.py GPT tree"
             )
+        if "moe_mlp" in p:
+            raise ValueError(
+                "Mixture-of-Experts checkpoints (model.extra.n_experts) "
+                "have no counterpart in the reference torch GPT's dense "
+                "MLP — export is only supported for dense models"
+            )
         pre = f"blocks.{i}"
         sd[f"{pre}.ln_1.weight"] = _np(p["ln_1"]["scale"])
         sd[f"{pre}.ln_1.bias"] = _np(p["ln_1"]["bias"])

@@ -132,6 +132,47 @@ class TestGPTMoEAdapter:
         with pytest.raises(ValueError, match="n_experts"):
             adapter.build_model(bad)
 
+    def test_composes_with_gqa_and_flash(self):
+        """The adapter inherits GPT's extras: n_kv_heads + flash +
+        chunked CE build and take a loss step together with MoE MLPs."""
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "moe-gqa", "seed": 5, "device": "cpu"},
+                "model": {
+                    "name": "gpt_moe",
+                    "block_size": 8,
+                    "vocab_size": 64,
+                    "d_model": 32,
+                    "n_heads": 4,
+                    "d_ff": 64,
+                    "n_layers": 2,
+                    "dropout": 0.0,
+                    "attention": "flash",
+                    "extra": {
+                        "n_experts": 4,
+                        "capacity_factor": 2.0,
+                        "n_kv_heads": 2,
+                        "loss_impl": "chunked_ce",
+                        "ce_chunk": 32,
+                    },
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {"max_steps": 1, "micro_batch_size": 2, "warmup_steps": 0},
+                "mlflow": {"enabled": False},
+            }
+        )
+        adapter = get_model_adapter("gpt_moe")()
+        model = adapter.build_model(cfg)
+        assert model.n_kv_heads == 2 and model.attention == "flash"
+        params = adapter.init_params(model, cfg, jax.random.key(0))
+        batch = {
+            "input_ids": jnp.zeros((2, 8), jnp.int32),
+            "labels": jnp.zeros((2, 8), jnp.int32),
+            "attention_mask": jnp.ones((2, 8), jnp.int32),
+        }
+        loss_sum, tokens = adapter.compute_loss_components(model, params, batch)
+        assert np.isfinite(float(jnp.sum(loss_sum) / jnp.sum(tokens)))
+
     def test_objective_includes_aux_loss(self):
         cfg = _moe_cfg()
         adapter = get_model_adapter("gpt_moe")()

@@ -258,6 +258,18 @@ def test_exported_state_dict_loads_into_actual_reference_gpt(tie):
     np.testing.assert_allclose(flax_logits, ref_logits, atol=2e-5, rtol=2e-5)
 
 
+def test_moe_export_raises_clear_error():
+    """MoE params (moe_mlp experts) have no reference counterpart; export
+    must say so instead of a bare KeyError('mlp_fc')."""
+    _, params = _flax_gpt(True)
+    moe = dict(params)
+    blk = dict(params["block_0"])
+    blk["moe_mlp"] = blk.pop("mlp_fc")
+    moe["block_0"] = blk
+    with pytest.raises(ValueError, match="n_experts"):
+        params_to_torch_state_dict(moe)
+
+
 def test_gqa_export_raises_clear_error():
     """GQA params (split q_proj/kv_proj) have no reference checkpoint
     format; export must say so instead of dying with a bare KeyError."""
