@@ -257,17 +257,39 @@ def _child_main() -> None:
             )
             return
         # Auto-sweep: chunked CE frees the [B,T,V] logits, which is what
-        # capped the batch at 64 (128 OOMs dense, docs/perf.md). One shot,
-        # no ladder — if it OOMs or underperforms, the dense line stands.
+        # capped the batch at 64 (128 OOMs dense, docs/perf.md). Climb
+        # batch x2 then x4 while each rung keeps winning and the budget
+        # holds; every win is PRINTED immediately (last JSON line wins in
+        # the parent), so a later rung hanging past the watchdog cannot
+        # lose an already-measured improvement. The next rung's cost is
+        # estimated from the just-completed run — first_cost measured a
+        # smaller batch and would underestimate.
         print(_SWEEP_MARKER, file=sys.stderr, flush=True)
-        try:
-            alt = run(att, batch * 2, "chunked_ce")
-        except Exception as exc:  # noqa: BLE001
-            print(f"auto-sweep chunked@{batch * 2} failed: {exc!r}", file=sys.stderr)
-            alt = None
-        best = alt if (alt is not None and alt["value"] > result["value"]) else result
-        # Last JSON line wins in the parent: reprint the best.
-        print(json.dumps(best), flush=True)
+        best = result
+        last_cost = first_cost
+        for mult in (2, 4):
+            if last_cost * 2.2 >= deadline - (time.perf_counter() - t0):
+                print(
+                    f"auto-sweep stopping before chunked@{batch * mult}: "
+                    f"last rung took {last_cost:.0f}s, not enough budget left",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                break
+            rung_t0 = time.perf_counter()
+            try:
+                alt = run(att, batch * mult, "chunked_ce")
+            except Exception as exc:  # noqa: BLE001
+                print(
+                    f"auto-sweep chunked@{batch * mult} failed: {exc!r}",
+                    file=sys.stderr,
+                )
+                break
+            last_cost = time.perf_counter() - rung_t0
+            if alt["value"] <= best["value"]:
+                break
+            best = alt
+            print(json.dumps(best), flush=True)
 
 
 def _measure_with_ladder(run, att: str, batch: int, loss_impl: str, attempts: int) -> dict:
