@@ -235,13 +235,9 @@ class TestSqliteTrackerUnit:
         assert isinstance(build_tracker(cfg, "rid"), SqliteTracker)
         # auto in THIS image (no mlflow) also lands on the native store.
         cfg.backend = "auto"
-        try:
-            import mlflow  # noqa: F401
+        import importlib.util
 
-            has_mlflow = True
-        except ImportError:
-            has_mlflow = False
-        if not has_mlflow:
+        if importlib.util.find_spec("mlflow") is None:
             assert isinstance(build_tracker(cfg, "rid"), SqliteTracker)
 
 
