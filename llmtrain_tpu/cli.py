@@ -703,6 +703,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 # path (outputs beyond block_size) would otherwise revert a
                 # flash config to dense and materialize (T, T).
                 attention=model.attention,
+                n_kv_heads=model.n_kv_heads,
             )
             logger.info(
                 "pipeline checkpoint converted to the gpt tree for KV-cache "

@@ -24,12 +24,12 @@ import jax.numpy as jnp
 
 Params = Any
 
-# stacked leaf name -> (gpt block subtree path)
-_BLOCK_MAP: dict[str, tuple[str, ...]] = {
+# stacked leaf name -> (gpt block subtree path); the attention entries
+# depend on the layout — fused qkv (MHA) vs split q/kv (GQA, both models
+# use the same per-layer shapes).
+_COMMON_MAP: dict[str, tuple[str, ...]] = {
     "ln1_scale": ("ln_1", "scale"),
     "ln1_bias": ("ln_1", "bias"),
-    "qkv_kernel": ("attn", "qkv_proj", "kernel"),
-    "qkv_bias": ("attn", "qkv_proj", "bias"),
     "out_kernel": ("attn", "out_proj", "kernel"),
     "out_bias": ("attn", "out_proj", "bias"),
     "ln2_scale": ("ln_2", "scale"),
@@ -38,6 +38,18 @@ _BLOCK_MAP: dict[str, tuple[str, ...]] = {
     "fc_bias": ("mlp_fc", "bias"),
     "proj_kernel": ("mlp_proj", "kernel"),
     "proj_bias": ("mlp_proj", "bias"),
+}
+_MHA_MAP: dict[str, tuple[str, ...]] = {
+    **_COMMON_MAP,
+    "qkv_kernel": ("attn", "qkv_proj", "kernel"),
+    "qkv_bias": ("attn", "qkv_proj", "bias"),
+}
+_GQA_MAP: dict[str, tuple[str, ...]] = {
+    **_COMMON_MAP,
+    "q_kernel": ("attn", "q_proj", "kernel"),
+    "q_bias": ("attn", "q_proj", "bias"),
+    "kv_kernel": ("attn", "kv_proj", "kernel"),
+    "kv_bias": ("attn", "kv_proj", "bias"),
 }
 
 
@@ -64,17 +76,29 @@ def _layer_slice(leaf, i: int):
     return leaf[i]
 
 
+def _block_map(fused: bool) -> dict[str, tuple[str, ...]]:
+    return _MHA_MAP if fused else _GQA_MAP
+
+
 def pipeline_params_to_gpt(params: Params) -> Params:
     """Stacked gpt_pipeline tree → per-layer models/gpt.py tree.
 
-    Works on real arrays AND abstract ShapeDtypeStruct trees (templates).
+    Works on real arrays AND abstract ShapeDtypeStruct trees (templates);
+    both the fused-qkv (MHA) and split q/kv (GQA) layouts convert.
     """
-    for required in ("token_embedding", "position_embedding", "qkv_kernel"):
+    for required in ("token_embedding", "position_embedding"):
         if required not in params:
             raise ValueError(
                 f"params have no {required!r}; not a models/gpt_pipeline.py tree"
             )
-    n_layers = params["qkv_kernel"].shape[0]
+    fused = "qkv_kernel" in params
+    if not fused and "q_kernel" not in params:
+        raise ValueError(
+            "params have neither qkv_kernel nor q_kernel; not a "
+            "models/gpt_pipeline.py tree"
+        )
+    block_map = _block_map(fused)
+    n_layers = params["qkv_kernel" if fused else "q_kernel"].shape[0]
     out: dict[str, Any] = {
         "token_embedding": dict(params["token_embedding"]),
         "position_embedding": dict(params["position_embedding"]),
@@ -84,7 +108,7 @@ def pipeline_params_to_gpt(params: Params) -> Params:
         out["lm_head"] = dict(params["lm_head"])
     for i in range(n_layers):
         block: dict[str, Any] = {}
-        for name, path in _BLOCK_MAP.items():
+        for name, path in block_map.items():
             _set_path(block, path, _layer_slice(params[name], i))
         out[f"block_{i}"] = block
     return out
@@ -93,20 +117,16 @@ def pipeline_params_to_gpt(params: Params) -> Params:
 def gpt_params_to_pipeline(params: Params) -> Params:
     """Per-layer models/gpt.py tree → stacked gpt_pipeline tree.
 
-    Requires the fused-qkv (MHA) tree — GQA's split q_proj/kv_proj has no
-    pipeline counterpart.
+    Handles both the fused-qkv (MHA) and split q/kv (GQA) layouts — the
+    pipeline model stacks the matching projection shapes.
     """
     for required in ("token_embedding", "position_embedding", "block_0"):
         if required not in params:
             raise ValueError(
                 f"params have no {required!r}; not a models/gpt.py tree"
             )
-    if "qkv_proj" not in params["block_0"]["attn"]:
-        raise ValueError(
-            "GQA/MQA trees (split q_proj/kv_proj, model.extra.n_kv_heads) "
-            "cannot convert to the pipeline layout, which stacks a fused "
-            "qkv kernel"
-        )
+    fused = "qkv_proj" in params["block_0"]["attn"]
+    block_map = _block_map(fused)
     n_layers = 0
     while f"block_{n_layers}" in params:
         n_layers += 1
@@ -118,7 +138,7 @@ def gpt_params_to_pipeline(params: Params) -> Params:
     }
     if "lm_head" in params:
         out["lm_head"] = dict(params["lm_head"])
-    for name, path in _BLOCK_MAP.items():
+    for name, path in block_map.items():
         out[name] = jnp.stack(
             [_get_path(params[f"block_{i}"], path) for i in range(n_layers)]
         )
@@ -126,7 +146,9 @@ def gpt_params_to_pipeline(params: Params) -> Params:
 
 
 def is_pipeline_tree(params: Params) -> bool:
-    return "qkv_kernel" in params and "block_0" not in params
+    return (
+        "qkv_kernel" in params or "q_kernel" in params
+    ) and "block_0" not in params
 
 
 __all__ = [

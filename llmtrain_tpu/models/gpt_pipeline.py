@@ -66,17 +66,34 @@ def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
         p: dict[str, jax.Array], h: jax.Array, key_mask: jax.Array | None = None
     ) -> jax.Array:
         hn = _layernorm(h, p["ln1_scale"], p["ln1_bias"])
-        # qkv kernel is head-major (D, 3, H, Dh) so tensor parallelism can
-        # shard whole heads; local H may be a tp-shard of the global count.
-        qkv = jnp.einsum(
-            "btd,dkhe->btkhe", hn.astype(dtype), p["qkv_kernel"].astype(dtype)
-        ) + p["qkv_bias"].astype(dtype)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, T, Hl, Dh)
+        # Kernels are head-major so tensor parallelism can shard whole
+        # heads; local H may be a tp-shard of the global count. The fused
+        # qkv layout is MHA; GQA splits into q_kernel/kv_kernel with
+        # narrow K/V (layouts match models/gpt.py's projections).
+        if "qkv_kernel" in p:
+            qkv = jnp.einsum(
+                "btd,dkhe->btkhe", hn.astype(dtype), p["qkv_kernel"].astype(dtype)
+            ) + p["qkv_bias"].astype(dtype)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, T, Hl, Dh)
+        else:
+            q = jnp.einsum(
+                "btd,dhe->bthe", hn.astype(dtype), p["q_kernel"].astype(dtype)
+            ) + p["q_bias"].astype(dtype)
+            kv = jnp.einsum(
+                "btd,dkhe->btkhe", hn.astype(dtype), p["kv_kernel"].astype(dtype)
+            ) + p["kv_bias"].astype(dtype)
+            k, v = kv[:, :, 0], kv[:, :, 1]  # (B, T, Hkv_l, Dh)
         if attention == "flash":
             from ..ops.flash_attention import flash_attention
 
+            # Narrow GQA K/V consumed natively (Pallas index maps / the
+            # fallback widens internally).
             att = flash_attention(q, k, v, attention_mask=key_mask, causal=True)
         else:
+            if k.shape[2] != q.shape[2]:
+                reps = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, reps, axis=2)
+                v = jnp.repeat(v, reps, axis=2)
             att = dense_attention(q, k, v, attention_mask=key_mask)
         proj = jnp.einsum(
             "bthe,hed->btd", att.astype(dtype), p["out_kernel"].astype(dtype)
@@ -150,6 +167,10 @@ class PipelineGPT(nn.Module):
     # Data is guaranteed packed (all-ones masks): skip the in-attention
     # mask (model.extra.assume_packed, same knob as models/gpt.py).
     assume_packed: bool = False
+    # Grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = MQA), the
+    # same semantics and param naming family as models/gpt.py — flash
+    # consumes the narrow K/V natively, dense broadcasts.
+    n_kv_heads: int = 0
 
     def _stacked(
         self, name: str, shape: tuple[int, ...], init, axes: tuple[str, ...]
@@ -217,16 +238,43 @@ class PipelineGPT(nn.Module):
         d, f, nh = self.d_model, self.d_ff, self.n_heads
         hd = d // nh
         ones, zeros = nn.initializers.ones_init(), nn.initializers.zeros_init()
+        kvh = self.n_kv_heads or nh
+        if kvh == nh:
+            # Head-major fused qkv so tensor parallelism shards whole heads.
+            attn_params = {
+                "qkv_kernel": self._stacked(
+                    "qkv_kernel", (d, 3, nh, hd), dense_init,
+                    ("embed", "qkv", "heads", "kv"),
+                ),
+                "qkv_bias": self._stacked(
+                    "qkv_bias", (3, nh, hd), zeros, ("qkv", "heads", "kv")
+                ),
+            }
+        else:
+            if nh % kvh != 0:
+                raise ValueError(
+                    f"n_heads ({nh}) must be divisible by n_kv_heads ({kvh})"
+                )
+            # Split projections, same per-layer shapes as models/gpt.py's
+            # q_proj/kv_proj (the conversion in interop/pipeline_convert.py
+            # maps them 1:1).
+            attn_params = {
+                "q_kernel": self._stacked(
+                    "q_kernel", (d, nh, hd), dense_init, ("embed", "heads", "kv")
+                ),
+                "q_bias": self._stacked("q_bias", (nh, hd), zeros, ("heads", "kv")),
+                "kv_kernel": self._stacked(
+                    "kv_kernel", (d, 2, kvh, hd), dense_init,
+                    ("embed", "qkv", "heads", "kv"),
+                ),
+                "kv_bias": self._stacked(
+                    "kv_bias", (2, kvh, hd), zeros, ("qkv", "heads", "kv")
+                ),
+            }
         blocks = {
             "ln1_scale": self._stacked("ln1_scale", (d,), ones, ("embed",)),
             "ln1_bias": self._stacked("ln1_bias", (d,), zeros, ("embed",)),
-            # Head-major qkv so tensor parallelism shards whole heads.
-            "qkv_kernel": self._stacked(
-                "qkv_kernel", (d, 3, nh, hd), dense_init, ("embed", "qkv", "heads", "kv")
-            ),
-            "qkv_bias": self._stacked(
-                "qkv_bias", (3, nh, hd), zeros, ("qkv", "heads", "kv")
-            ),
+            **attn_params,
             "out_kernel": self._stacked(
                 "out_kernel", (nh, hd, d), scaled_init, ("heads", "kv", "embed")
             ),
@@ -304,11 +352,28 @@ class PipelineGPT(nn.Module):
             # appear, or params become tensor-varying with no psum to
             # cancel it and the layer-scan carry types mismatch.
             tens = "tensor" if tp > 1 else None
+            if kvh == nh:
+                attn_specs = {
+                    "qkv_kernel": _pspec(None, None, tens, None),
+                    "qkv_bias": _pspec(None, tens, None),
+                }
+            else:
+                if tp > 1 and kvh % tp != 0:
+                    raise ValueError(
+                        f"n_kv_heads ({kvh}) must be divisible by the mesh "
+                        f"tensor axis ({tp}) — K/V heads shard over tensor "
+                        "parallelism like query heads do"
+                    )
+                attn_specs = {
+                    "q_kernel": _pspec(None, tens, None),
+                    "q_bias": _pspec(tens, None),
+                    "kv_kernel": _pspec(None, None, tens, None),
+                    "kv_bias": _pspec(None, tens, None),
+                }
             param_specs = {
                 "ln1_scale": _pspec(None),
                 "ln1_bias": _pspec(None),
-                "qkv_kernel": _pspec(None, None, tens, None),
-                "qkv_bias": _pspec(None, tens, None),
+                **attn_specs,
                 "out_kernel": _pspec(tens, None, None),
                 "out_bias": _pspec(None),
                 "ln2_scale": _pspec(None),
@@ -388,6 +453,7 @@ class PipelineGPTAdapter(ModelAdapter):
             "ce_chunk",
             "z_loss",
             "assume_packed",
+            "n_kv_heads",
             "pipeline_microbatches",
             "pipeline_virtual_chunks",
         }
@@ -418,6 +484,16 @@ class PipelineGPTAdapter(ModelAdapter):
         z_loss = float(cfg.model.extra.get("z_loss", 0.0))
         if z_loss < 0.0:
             raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
+        n_kv_heads = int(cfg.model.extra.get("n_kv_heads", 0))
+        if n_kv_heads < 0:
+            raise ValueError(
+                f"model.extra.n_kv_heads must be >= 0, got {n_kv_heads}"
+            )
+        if n_kv_heads and cfg.model.n_heads % n_kv_heads != 0:
+            raise ValueError(
+                f"model.n_heads ({cfg.model.n_heads}) must be divisible by "
+                f"model.extra.n_kv_heads ({n_kv_heads})"
+            )
         return PipelineGPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -436,6 +512,7 @@ class PipelineGPTAdapter(ModelAdapter):
             ce_chunk=self._positive_extra(cfg, "ce_chunk", 8192),
             z_loss=z_loss,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
+            n_kv_heads=n_kv_heads,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:
@@ -466,6 +543,14 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"trainer.micro_batch_size ({cfg.trainer.micro_batch_size}) "
                 f"must be divisible by model.extra.pipeline_microbatches "
                 f"({m}) on a pipeline mesh"
+            )
+        n_kv_heads = int(cfg.model.extra.get("n_kv_heads", 0))
+        tp = int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
+        if n_kv_heads and tp > 1 and n_kv_heads % tp != 0:
+            raise ValueError(
+                f"model.extra.n_kv_heads ({n_kv_heads}) must be divisible "
+                f"by the mesh tensor axis ({tp}) — K/V heads shard over "
+                "tensor parallelism like query heads do"
             )
 
     def compute_loss_components(

@@ -256,6 +256,59 @@ class TestPipelineGPT:
         b = model.apply({"params": params}, tokens, half)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_gqa_pipelined_matches_sequential(self, attention):
+        """Grouped-query attention (split stacked q/kv kernels) under the
+        pipeline schedule equals sequential execution; flash consumes the
+        narrow K/V natively."""
+        cfg = _pp_cfg(
+            model={
+                "attention": attention,
+                "extra": {"tokenizer": "byte", "pipeline_microbatches": 2,
+                          "n_kv_heads": 2},
+            }
+        )
+        _, model, params = self._build(cfg)
+        assert "q_kernel" in params and "qkv_kernel" not in params
+        tokens = jax.random.randint(jax.random.key(9), (8, 16), 0, 32)
+        ref = model.apply({"params": params}, tokens)
+        with _mesh():
+            out = jax.jit(lambda p, t: model.apply({"params": p}, t))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gqa_pp_tp_compose_matches_sequential(self):
+        """GQA under pipeline x tensor: the split q/kv sharding specs
+        shard K/V heads over the tensor axis; forward equals sequential
+        execution of the same params."""
+        cfg = _pp_cfg(
+            model={
+                "extra": {"tokenizer": "byte", "pipeline_microbatches": 2,
+                          "n_kv_heads": 2},
+            },
+            distributed={"enabled": False,
+                         "mesh": {"pipeline": 2, "tensor": 2, "data": 2}},
+        )
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(11), (8, 16), 0, 32)
+        ref = model.apply({"params": params}, tokens)
+        devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        mesh = Mesh(devs, ("pipeline", "tensor", "data"))
+        with mesh:
+            out = jax.jit(lambda p, t: model.apply({"params": p}, t))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gqa_pp_tp_kv_heads_must_divide(self):
+        """A tensor axis bigger than n_kv_heads fails at startup with a
+        clear message (validate_mesh), not an opaque sharding error."""
+        cfg = _pp_cfg(
+            model={"extra": {"tokenizer": "byte", "pipeline_microbatches": 2,
+                             "n_kv_heads": 1}},
+            distributed={"enabled": False,
+                         "mesh": {"pipeline": 2, "tensor": 2, "data": 2}},
+        )
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            Trainer(cfg, None, NullTracker())
+
     def test_batch_divisor_hook(self):
         from llmtrain_tpu.models.gpt_pipeline import PipelineGPTAdapter
 

@@ -102,15 +102,34 @@ class TestConversion:
         )
         np.testing.assert_array_equal(cached, windowed)
 
-    def test_gqa_tree_rejected(self):
-        gqa = {
-            "token_embedding": {"embedding": np.zeros((4, 2))},
-            "position_embedding": {"embedding": np.zeros((4, 2))},
-            "ln_f": {"scale": np.ones(2), "bias": np.zeros(2)},
-            "block_0": {"attn": {"q_proj": {}, "kv_proj": {}}},
-        }
-        with pytest.raises(ValueError, match="n_kv_heads"):
-            gpt_params_to_pipeline(gqa)
+    def test_gqa_roundtrip_and_logits(self):
+        """The split q/kv (GQA) layout converts both ways and drives the
+        GQA GPT to the pipeline model's exact logits."""
+        pipe = PipelineGPT(tie_embeddings=True, n_kv_heads=2, **DIMS)
+        params = nn_meta.unbox(
+            pipe.init(jax.random.key(1), jnp.zeros((1, 16), jnp.int32))
+        )["params"]
+        assert "q_kernel" in params and "qkv_kernel" not in params
+        assert is_pipeline_tree(params)
+
+        converted = pipeline_params_to_gpt(params)
+        assert "q_proj" in converted["block_0"]["attn"]
+        back = gpt_params_to_pipeline(converted)
+        for (pa, va), (pb, vb) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(back),
+            strict=True,
+        ):
+            assert pa == pb
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+        gpt = GPT(dropout=0.0, tie_embeddings=True, n_kv_heads=2, **DIMS)
+        ids = jnp.asarray(
+            np.random.default_rng(9).integers(0, 64, (2, 16)), jnp.int32
+        )
+        a = pipe.apply({"params": params}, ids)
+        b = gpt.apply({"params": converted}, ids, deterministic=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
 @pytest.mark.slow
