@@ -86,8 +86,8 @@ def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
         if attention == "flash":
             from ..ops.flash_attention import flash_attention
 
-            # Narrow GQA K/V consumed natively (Pallas index maps / the
-            # fallback widens internally).
+            # Narrow GQA K/V consumed natively (Pallas index maps on TPU,
+            # grouped einsums in the blockwise fallback).
             att = flash_attention(q, k, v, attention_mask=key_mask, causal=True)
         else:
             if k.shape[2] != q.shape[2]:

@@ -34,10 +34,11 @@ def _chunk_scan(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Online-softmax accumulation of one q-chunk over all kv-chunks.
 
-    q: (B, Tq, H, D); k/v: (B, Tk, H, D). Offsets give the absolute positions
-    of the first query/key, so the causal mask works on chunks of a larger
-    sequence (ring attention passes nonzero kv_offset). ``key_mask`` is an
-    optional (B, Tk) padding mask (nonzero = attend).
+    q: (B, Tq, H, D); k/v: (B, Tk, Hkv, D) with Hkv dividing H (narrow
+    grouped-query K/V is consumed natively). Offsets give the absolute
+    positions of the first query/key, so the causal mask works on chunks
+    of a larger sequence (ring attention passes nonzero kv_offset).
+    ``key_mask`` is an optional (B, Tk) padding mask (nonzero = attend).
     Returns (acc, row_max, row_sum) with acc un-normalized: out = acc / row_sum.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -52,11 +53,27 @@ def _chunk_scan(
 
     q_pos = q_offset + jnp.arange(tq)
 
+    b, tq_, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(f"n_heads ({h}) must be a multiple of kv heads ({hkv})")
+    group = h // hkv
+    # Grouped-query attention consumes narrow K/V natively: queries are
+    # viewed as (B, Tq, Hkv, G, D) and contracted against the narrow
+    # heads — same FLOPs as the widened form, but K/V are never
+    # materialized at full width (and ring attention rotates G x fewer
+    # bytes over ICI).
+    qg = q.reshape(b, tq_, hkv, group, d) if group > 1 else None
+
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(carry, inputs):
         acc, row_max, row_sum = carry
         k_c, v_c, m_c, chunk_idx = inputs
-        s = jnp.einsum("bqhd,bkhd->bqhk", q, k_c) * scale
+        if group > 1:
+            s = jnp.einsum("bqkgd,bskd->bqkgs", qg, k_c) * scale
+            s = s.reshape(b, tq_, h, k_c.shape[1])
+        else:
+            s = jnp.einsum("bqhd,bkhd->bqhk", q, k_c) * scale
         s = s.astype(jnp.float32)
         if causal:
             k_pos = kv_offset + chunk_idx * kv_chunk + jnp.arange(kv_chunk)
@@ -67,9 +84,12 @@ def _chunk_scan(
         new_max = jnp.maximum(row_max, s.max(axis=-1))
         correction = jnp.exp(row_max - new_max)
         p = jnp.exp(s - new_max[..., None])
-        acc = acc * correction[..., None] + jnp.einsum(
-            "bqhk,bkhd->bqhd", p.astype(v_c.dtype), v_c
-        ).astype(jnp.float32)
+        if group > 1:
+            pg = p.reshape(b, tq_, hkv, group, k_c.shape[1]).astype(v_c.dtype)
+            upd = jnp.einsum("bqkgs,bskd->bqkgd", pg, v_c).reshape(b, tq_, h, d)
+        else:
+            upd = jnp.einsum("bqhk,bkhd->bqhd", p.astype(v_c.dtype), v_c)
+        acc = acc * correction[..., None] + upd.astype(jnp.float32)
         row_sum = row_sum * correction + p.sum(axis=-1)
         return (acc, new_max, row_sum), None
 
@@ -107,8 +127,9 @@ def blockwise_attention(
 ) -> jax.Array:
     """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory.
 
-    ``key_mask`` is an optional (B, Tk) padding mask (nonzero = attend),
-    the reference's in-attention padding semantics (gpt.py:60-64).
+    ``k``/``v`` may be grouped-query narrow (B, Tk, Hkv, D). ``key_mask``
+    is an optional (B, Tk) padding mask (nonzero = attend), the
+    reference's in-attention padding semantics (gpt.py:60-64).
     """
     b, tq, h, d = q.shape
     q_chunk = min(q_chunk, tq)

@@ -22,9 +22,10 @@ which the masked and unmasked kernels agree exactly;
 ``model.extra.assume_packed`` drops the mask operand from the hot path
 when the data is provably packed.
 
-Grouped-query attention is native: ``k``/``v`` may carry n_kv_heads <
-n_heads and the Pallas kernels index K/V by head group — no jnp.repeat
-materialization. The blockwise fallback broadcasts (CPU/test path only).
+Grouped-query attention is native end to end: ``k``/``v`` may carry
+n_kv_heads < n_heads — the Pallas kernels index K/V by head group and
+the blockwise fallback groups queries in its einsums; K/V are never
+materialized at full width on any path here.
 """
 
 from __future__ import annotations
@@ -58,17 +59,8 @@ def _pallas_bwd_enabled() -> bool:
     return os.environ.get("LLMTRAIN_FLASH_BWD", "pallas").lower() != "blockwise"
 
 
-def _widen(q: jax.Array, k: jax.Array, v: jax.Array):
-    """Broadcast grouped-query K/V to full head width (fallback paths)."""
-    if k.shape[2] != q.shape[2]:
-        reps = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
-    return k, v
-
-
 def _blockwise(q, k, v, key_mask=None):
-    k, v = _widen(q, k, v)
+    # blockwise consumes grouped-query narrow K/V natively.
     return blockwise_attention(q, k, v, causal=True, key_mask=key_mask)
 
 
@@ -171,7 +163,6 @@ def flash_attention(
     (nonzero = real token): masked keys are excluded inside attention.
     """
     if not causal:
-        k, v = _widen(q, k, v)
         return blockwise_attention(q, k, v, causal=False, key_mask=attention_mask)
     if attention_mask is None:
         return _flash(q, k, v)

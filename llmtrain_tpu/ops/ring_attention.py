@@ -193,6 +193,21 @@ def route_or_blockwise(
         and mesh.shape["sequence"] > 1
     ):
         dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
+        if dims_ok:
+            # Grouped-query narrow K/V must shard its own head count too;
+            # when it doesn't divide, widen by the SMALLEST group divisor
+            # that does (exact math — replicated kv heads) rather than
+            # abandon sequence parallelism, which exists precisely to keep
+            # long contexts from OOMing on one device.
+            hs = _dim_shards(mesh, 2)
+            if k.shape[2] % hs != 0:
+                g = q.shape[2] // k.shape[2]
+                w = next(
+                    w for w in range(1, g + 1)
+                    if g % w == 0 and (k.shape[2] * w) % hs == 0
+                )
+                k = jnp.repeat(k, w, axis=2)
+                v = jnp.repeat(v, w, axis=2)
         if dims_ok and (extra_predicate is None or extra_predicate(mesh, q)):
             return sharded_fn(q, k, v, mesh, causal=causal, key_mask=key_mask)
         if q.shape[0] > 1:
@@ -200,13 +215,14 @@ def route_or_blockwise(
 
             get_logger().warning(
                 "%s attention falling back to single-device blockwise: "
-                "shape (B=%d, T=%d, H=%d) vs mesh shards (batch %d, "
+                "shape (B=%d, T=%d, H=%d, Hkv=%d) vs mesh shards (batch %d, "
                 "sequence %d, heads %d) — sequence parallelism is DISABLED "
                 "for this computation",
                 scheme,
                 q.shape[0],
                 q.shape[1],
                 q.shape[2],
+                k.shape[2],
                 _dim_shards(mesh, 0),
                 _dim_shards(mesh, 1),
                 _dim_shards(mesh, 2),

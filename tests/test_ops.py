@@ -398,6 +398,72 @@ class TestGQAKernels:
         ref = dense_attention(q, kw, vw, attention_mask=None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.parametrize("hkv", [1, 2], ids=["mqa", "gqa2"])
+    def test_blockwise_narrow_kv_fwd_and_grads(self, hkv):
+        """Blockwise consumes narrow K/V natively (grouped queries) —
+        forward and grads equal the widened dense reference."""
+        q, kn, vn = self._gqa_qkv(t=16, hkv=hkv, seed=41)
+        reps = q.shape[2] // hkv
+        g = jax.random.normal(jax.random.key(43), q.shape, jnp.float32)
+
+        def loss_narrow(q, kn, vn):
+            return jnp.sum(
+                blockwise_attention(q, kn, vn, causal=True, q_chunk=4, kv_chunk=4) * g
+            )
+
+        def loss_wide(q, kn, vn):
+            kw = jnp.repeat(kn, reps, axis=2)
+            vw = jnp.repeat(vn, reps, axis=2)
+            return jnp.sum(dense_attention(q, kw, vw, attention_mask=None) * g)
+
+        np.testing.assert_allclose(
+            float(loss_narrow(q, kn, vn)), float(loss_wide(q, kn, vn)), rtol=1e-5
+        )
+        gn = jax.grad(loss_narrow, argnums=(0, 1, 2))(q, kn, vn)
+        gw = jax.grad(loss_wide, argnums=(0, 1, 2))(q, kn, vn)
+        for a, b in zip(gn, gw):
+            assert a.shape == b.shape  # dk/dv born narrow
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_ring_mqa_widens_minimally_instead_of_losing_sp(self):
+        """MQA (hkv=1) with tensor=2 head shards: the router widens K/V
+        just enough (1 -> 2 heads) and KEEPS the ring path — previously
+        this would silently fall back to single-device blockwise."""
+        from llmtrain_tpu.config.schemas import MeshConfig
+        from llmtrain_tpu.distributed import build_mesh
+        from llmtrain_tpu.ops.ring_attention import ring_or_blockwise
+
+        q, kn, vn = self._gqa_qkv(b=4, t=16, h=4, hkv=1, seed=53)
+        kw, vw = jnp.repeat(kn, 4, axis=2), jnp.repeat(vn, 4, axis=2)
+        ref = dense_attention(q, kw, vw, attention_mask=None)
+        mesh = build_mesh(
+            MeshConfig(data=2, fsdp=1, tensor=2, sequence=2), jax.devices()[:8]
+        )
+        with mesh:
+            out = jax.jit(lambda q, k, v: ring_or_blockwise(q, k, v))(q, kn, vn)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_ring_rotates_narrow_kv(self):
+        """Ring attention with grouped-query K/V: narrow shards rotate
+        (G x less ICI traffic) and results match the widened dense
+        reference, masks included."""
+        from llmtrain_tpu.config.schemas import MeshConfig
+        from llmtrain_tpu.distributed import build_mesh
+        from llmtrain_tpu.ops.ring_attention import ring_attention_sharded
+
+        q, kn, vn = self._gqa_qkv(b=4, t=16, h=4, hkv=2, seed=47)
+        reps = 2
+        mask = _suffix_mask(4, 16, seed=11)
+        kw, vw = jnp.repeat(kn, reps, axis=2), jnp.repeat(vn, reps, axis=2)
+        ref = dense_attention(q, kw, vw, attention_mask=mask)
+        mesh = build_mesh(
+            MeshConfig(data=2, fsdp=1, tensor=2, sequence=2), jax.devices()[:8]
+        )
+        out = jax.jit(
+            lambda q, k, v, m: ring_attention_sharded(q, k, v, mesh, key_mask=m)
+        )(q, kn, vn, mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
 
 class TestRingAttention:
     def _mesh(self, sequence=2, data=2, tensor=2):
