@@ -133,12 +133,13 @@ class CausalSelfAttention(nn.Module):
         if (
             not self.decode
             and kv_heads != self.n_heads
-            and self.attention not in ("flash", "ring")
+            and self.attention == "dense"
         ):
-            # Ulysses/dense see full-width K/V (compute-equivalent GQA);
-            # flash consumes narrow K/V natively (Pallas index maps), ring
-            # rotates the narrow shards (G x less ICI traffic — blockwise
-            # groups queries in-kernel), and the decode path keeps the
+            # Only dense sees full-width K/V (compute-equivalent GQA).
+            # Flash consumes narrow K/V natively (Pallas index maps), ring
+            # rotates the narrow shards, ulysses exchanges them narrow
+            # (G x less wire traffic in each case — blockwise groups
+            # queries in its einsums), and the decode path keeps the
             # narrow cache, broadcasting at read.
             reps = self.n_heads // kv_heads
             k = jnp.repeat(k, reps, axis=2)

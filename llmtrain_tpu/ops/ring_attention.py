@@ -145,6 +145,34 @@ def attention_shard_map(
     )
 
 
+def widen_kv_for_shards(q: jax.Array, k: jax.Array, v: jax.Array, mesh):
+    """Widen grouped-query K/V by the SMALLEST exact factor that makes its
+    head count divide the mesh's head shards — keeping K/V as narrow as
+    the sharding allows (exact math; replicated kv heads) instead of
+    abandoning a sharded path. Shared by ring and ulysses wrappers."""
+    hs = _dim_shards(mesh, 2)
+    if k.shape[2] % hs != 0:
+        g = q.shape[2] // k.shape[2]
+        w = next(
+            (
+                w for w in range(1, g + 1)
+                if g % w == 0 and (k.shape[2] * w) % hs == 0
+            ),
+            None,
+        )
+        if w is None:
+            # g-fold widening reaches full H, which the caller's q check
+            # already validated — only reachable when q itself doesn't
+            # divide; keep the message clear instead of a StopIteration.
+            raise ValueError(
+                f"K/V heads ({k.shape[2]}, query heads {q.shape[2]}) cannot "
+                f"be widened to divide the mesh head shards ({hs})"
+            )
+        k = jnp.repeat(k, w, axis=2)
+        v = jnp.repeat(v, w, axis=2)
+    return k, v
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -155,6 +183,7 @@ def ring_attention_sharded(
     key_mask: jax.Array | None = None,
 ) -> jax.Array:
     """shard_map wrapper: global (B, T, H, D) arrays over the named mesh."""
+    k, v = widen_kv_for_shards(q, k, v, mesh)
     fn = attention_shard_map(
         mesh,
         functools.partial(ring_attention, axis_name="sequence", causal=causal),
@@ -192,22 +221,10 @@ def route_or_blockwise(
         and "sequence" in mesh.axis_names
         and mesh.shape["sequence"] > 1
     ):
+        # Narrow grouped-query K/V is widened minimally inside the sharded
+        # wrappers (widen_kv_for_shards) when its head count doesn't
+        # divide the head shards — never a reason to fall back.
         dims_ok = all(q.shape[d] % _dim_shards(mesh, d) == 0 for d in range(3))
-        if dims_ok:
-            # Grouped-query narrow K/V must shard its own head count too;
-            # when it doesn't divide, widen by the SMALLEST group divisor
-            # that does (exact math — replicated kv heads) rather than
-            # abandon sequence parallelism, which exists precisely to keep
-            # long contexts from OOMing on one device.
-            hs = _dim_shards(mesh, 2)
-            if k.shape[2] % hs != 0:
-                g = q.shape[2] // k.shape[2]
-                w = next(
-                    w for w in range(1, g + 1)
-                    if g % w == 0 and (k.shape[2] * w) % hs == 0
-                )
-                k = jnp.repeat(k, w, axis=2)
-                v = jnp.repeat(v, w, axis=2)
         if dims_ok and (extra_predicate is None or extra_predicate(mesh, q)):
             return sharded_fn(q, k, v, mesh, causal=causal, key_mask=key_mask)
         if q.shape[0] > 1:

@@ -443,6 +443,28 @@ class TestGQAKernels:
             out = jax.jit(lambda q, k, v: ring_or_blockwise(q, k, v))(q, kn, vn)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.parametrize("hkv", [1, 2], ids=["mqa", "gqa2"])
+    def test_ulysses_narrow_kv_matches_widened_dense(self, hkv):
+        """Ulysses exchanges narrow K/V (separate q and kv all-to-alls,
+        minimal widening when Hkv doesn't split the axis) and matches the
+        widened dense reference, masks included."""
+        from llmtrain_tpu.config.schemas import MeshConfig
+        from llmtrain_tpu.distributed import build_mesh
+        from llmtrain_tpu.ops.ulysses_attention import ulysses_attention_sharded
+
+        q, kn, vn = self._gqa_qkv(b=4, t=16, h=8, hkv=hkv, seed=59)
+        reps = 8 // hkv
+        mask = _suffix_mask(4, 16, seed=13)
+        kw, vw = jnp.repeat(kn, reps, axis=2), jnp.repeat(vn, reps, axis=2)
+        ref = dense_attention(q, kw, vw, attention_mask=mask)
+        mesh = build_mesh(
+            MeshConfig(data=2, fsdp=1, tensor=2, sequence=2), jax.devices()[:8]
+        )
+        out = jax.jit(
+            lambda q, k, v, m: ulysses_attention_sharded(q, k, v, mesh, key_mask=m)
+        )(q, kn, vn, mask)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
     def test_ring_rotates_narrow_kv(self):
         """Ring attention with grouped-query K/V: narrow shards rotate
         (G x less ICI traffic) and results match the widened dense
