@@ -145,6 +145,19 @@ def attention_shard_map(
     )
 
 
+def min_widen_factor(group: int, kv_heads: int, divisor: int) -> int | None:
+    """Smallest exact K/V replication factor (a divisor of ``group``)
+    making ``kv_heads * w`` divide ``divisor``; None when nothing does.
+    The single widening rule shared by every narrow-K/V path."""
+    return next(
+        (
+            w for w in range(1, group + 1)
+            if group % w == 0 and (kv_heads * w) % divisor == 0
+        ),
+        None,
+    )
+
+
 def widen_kv_for_shards(q: jax.Array, k: jax.Array, v: jax.Array, mesh):
     """Widen grouped-query K/V by the SMALLEST exact factor that makes its
     head count divide the mesh's head shards — keeping K/V as narrow as
@@ -153,13 +166,7 @@ def widen_kv_for_shards(q: jax.Array, k: jax.Array, v: jax.Array, mesh):
     hs = _dim_shards(mesh, 2)
     if k.shape[2] % hs != 0:
         g = q.shape[2] // k.shape[2]
-        w = next(
-            (
-                w for w in range(1, g + 1)
-                if g % w == 0 and (k.shape[2] * w) % hs == 0
-            ),
-            None,
-        )
+        w = min_widen_factor(g, k.shape[2], hs)
         if w is None:
             # g-fold widening reaches full H, which the caller's q check
             # already validated — only reachable when q itself doesn't

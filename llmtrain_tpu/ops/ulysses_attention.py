@@ -27,6 +27,7 @@ from .blockwise_attention import blockwise_attention
 from .ring_attention import (
     _dim_shards,
     attention_shard_map,
+    min_widen_factor,
     route_or_blockwise,
     widen_kv_for_shards,
 )
@@ -61,12 +62,10 @@ def ulysses_attention(
         # Grouped-query narrow K/V: keep it narrow through the exchange
         # when its head count splits across the axis (less wire traffic —
         # the post-exchange blockwise groups queries natively); otherwise
-        # widen by the smallest exact factor that divides.
-        g = heads // k.shape[2]
-        w = next(
-            w for w in range(1, g + 1) if g % w == 0 and (k.shape[2] * w) % s == 0
-        )
-        if w > 1:
+        # widen by the smallest exact factor that divides (w=group always
+        # satisfies both conditions after the heads % s check above).
+        w = min_widen_factor(heads // k.shape[2], k.shape[2], s)
+        if w is not None and w > 1:
             k = jnp.repeat(k, w, axis=2)
             v = jnp.repeat(v, w, axis=2)
 
