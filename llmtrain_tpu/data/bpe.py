@@ -143,6 +143,12 @@ class BPETokenizer:
         self._vocab = vocab
         self.n_vocab = 256 + len(self._merges) + len(self._special)
         self._cache: dict[str, list[int]] = {}
+        # Native cold-word encoder (llmtrain_tpu/native, C via ctypes);
+        # None on hosts without a C compiler — the Python loop below is
+        # the correctness reference either way.
+        from ..native import fastbpe_encoder
+
+        self._native = fastbpe_encoder(self._merges)
 
     # -- tiktoken-compatible surface ------------------------------------
     @property
@@ -166,17 +172,20 @@ class BPETokenizer:
         ids = self._cache.get(word)
         if ids is not None:
             return ids
-        ids = list(word.encode("utf-8"))
-        while len(ids) >= 2:
-            ranked = [
-                (r, i)
-                for i, p in enumerate(zip(ids, ids[1:]))
-                if (r := self._rank.get(p)) is not None
-            ]
-            if not ranked:
-                break
-            rank, _ = min(ranked)
-            ids = _merge(ids, self._merges[rank], 256 + rank)
+        if self._native is not None:
+            ids = self._native.encode_word(word)
+        else:
+            ids = list(word.encode("utf-8"))
+            while len(ids) >= 2:
+                ranked = [
+                    (r, i)
+                    for i, p in enumerate(zip(ids, ids[1:]))
+                    if (r := self._rank.get(p)) is not None
+                ]
+                if not ranked:
+                    break
+                rank, _ = min(ranked)
+                ids = _merge(ids, self._merges[rank], 256 + rank)
         if len(self._cache) < 1_000_000:
             self._cache[word] = ids
         return ids

@@ -259,30 +259,23 @@ class CausalSelfAttention(nn.Module):
 
         keys, values = cached_key.value, cached_value.value
         scale = 1.0 / math.sqrt(head_dim)
-        if keys.shape[2] != n_heads:
-            # Grouped-query decode: the cache holds n_kv_heads (the memory
-            # win) and stays narrow at read too — queries are grouped
-            # against the shared K/V heads, so the per-step HBM read is
-            # G x smaller than broadcasting the cache (query head k*G+g
-            # attends kv head k, matching jnp.repeat semantics).
-            g = n_heads // keys.shape[2]
-            qg = q.reshape(batch, t, keys.shape[2], g, head_dim)
-            scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys) * scale
-            scores = scores.astype(jnp.float32)
-            col = jnp.arange(self.cache_len)[None, None, None, None, :]
-            row = (idx + jnp.arange(t))[None, None, None, :, None]
-            scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
-            probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-            out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)
-            return out.reshape(batch, t, n_heads, head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
+        # Grouped-query decode (g=1 is classic MHA): the cache holds
+        # n_kv_heads (the memory win) and stays narrow at read too —
+        # queries are grouped against the shared K/V heads, so the
+        # per-step HBM read is G x smaller than broadcasting the cache
+        # (query head k*G+g attends kv head k, matching jnp.repeat
+        # semantics).
+        g = n_heads // kv_width
+        qg = q.reshape(batch, t, kv_width, g, head_dim)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys) * scale
         scores = scores.astype(jnp.float32)
         # Query at absolute position idx+i may see cache slots <= idx+i.
-        col = jnp.arange(self.cache_len)[None, None, None, :]
-        row = (idx + jnp.arange(t))[None, None, :, None]
+        col = jnp.arange(self.cache_len)[None, None, None, None, :]
+        row = (idx + jnp.arange(t))[None, None, None, :, None]
         scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)
+        return out.reshape(batch, t, n_heads, head_dim)
 
 
 def dense_attention(

@@ -277,3 +277,44 @@ def test_roundtrip_fuzz_random_unicode():
         chr(rng.randint(*rng.choice(alphabets))) for _ in range(2000)
     )
     assert tok.decode(tok.encode(mixed)) == mixed
+
+
+class TestNativeEncoder:
+    """The C fastbpe encoder (llmtrain_tpu/native) against the pure-Python
+    merge loop — bit-identical token streams, or skip when no compiler."""
+
+    def _pair(self):
+        tok = train_bpe(CORPUS, 512)
+        if tok._native is None:
+            pytest.skip("no C compiler available for the native encoder")
+        ref = BPETokenizer(tok._merges, special_tokens=tok._special)
+        ref._native = None  # force the Python reference loop
+        return tok, ref
+
+    def test_word_level_equivalence(self):
+        tok, ref = self._pair()
+        words = [
+            "the", "quick", "foxes", "lazier", "quick_fn", "arg1",
+            "supercalifragilistic", "x", "", "émigré", "日本語", "a" * 50,
+            "\n", "    ", "mixedCASE_words123",
+        ]
+        for w in words:
+            assert tok._native.encode_word(w) == ref._encode_word(w), w
+
+    def test_full_text_equivalence_and_roundtrip(self):
+        tok, ref = self._pair()
+        text = CORPUS[:500] + " unseen wörds αβγ and_some_new_identifiers_42"
+        native_ids = tok.encode(text)
+        assert native_ids == ref.encode(text)
+        assert tok.decode(native_ids) == text
+
+    def test_env_kill_switch(self, monkeypatch):
+        """LLMTRAIN_NO_NATIVE=1 forces the Python path."""
+        import llmtrain_tpu.native as native
+
+        monkeypatch.setenv("LLMTRAIN_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", False)
+        tok = train_bpe(CORPUS, 400)
+        assert tok._native is None
+        assert tok.decode(tok.encode("the quick fox")) == "the quick fox"
