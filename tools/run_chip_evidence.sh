@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# The on-chip evidence runbook (RESULTS.md "Pending on-chip measurement"),
+# as one command for the first session with a live TPU tunnel:
+#
+#     bash tools/run_chip_evidence.sh [outdir]
+#
+# Probes the backend first with a hard timeout (the axon tunnel's failure
+# mode is an indefinite backend-init hang, never an exception), then runs
+# each step with its own timeout so one hang cannot eat the session.
+# Artifacts land in <outdir> (default chip_evidence/): bench JSON, pytest
+# logs, decode + long-context sweeps. Steps degrade independently — a
+# failed step writes its log and the script continues.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-chip_evidence}"
+mkdir -p "$OUT"
+
+log() { echo "[chip-evidence] $*" >&2; }
+
+log "probing TPU backend (240s timeout)..."
+if ! timeout 240 python -c "import jax; assert jax.default_backend() == 'tpu'" \
+    >"$OUT/probe.log" 2>&1; then
+    log "TPU backend unreachable — aborting (see $OUT/probe.log)"
+    exit 1
+fi
+log "TPU live."
+
+log "1/5 bench.py (auto-sweep; watchdogged internally)..."
+python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log" || log "bench failed"
+tail -1 "$OUT/bench.json" || true
+
+log "2/5 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
+timeout 2400 python -m pytest tests/test_tpu_compiled.py -v \
+    >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
+tail -2 "$OUT/tpu_compiled.log" || true
+
+log "3/5 decode scaling sweep (batch x kv-heads)..."
+timeout 2400 python tools/bench_decode.py --batches 1,8,32 --kv-heads 0,4,1 \
+    >"$OUT/decode.json" 2>"$OUT/decode.log" || log "decode sweep failed/partial"
+
+log "4/5 long-context sweep (T=4k..32k)..."
+timeout 3600 python tools/bench_longctx.py \
+    >"$OUT/longctx.json" 2>"$OUT/longctx.log" || log "longctx sweep failed/partial"
+
+log "5/5 BPE headline train (gpt_pycorpus_bpe_tpu, needs runs/pytok8k.json)..."
+if [ ! -f runs/pytok8k.json ]; then
+    timeout 1200 python -m llmtrain_tpu train-tokenizer \
+        --input /usr/local/lib/python3.12 --vocab-size 8192 \
+        --output runs/pytok8k.json >"$OUT/tokenizer.log" 2>&1 \
+        || log "tokenizer training failed"
+fi
+timeout 5400 python -m llmtrain_tpu train \
+    --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
+    --run-id chip-evidence-bpe --json \
+    >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
+    || log "BPE headline failed/partial"
+
+log "done — artifacts in $OUT/. Fold the numbers into RESULTS.md."
