@@ -44,6 +44,14 @@ from .base import (
 _EMBED_INIT = nn.initializers.normal(stddev=0.02)
 _DENSE_INIT = nn.initializers.normal(stddev=0.02)
 
+# model.extra.remat_policy values -> jax.checkpoint policies (None = the
+# default: save nothing, recompute the whole block).
+REMAT_POLICIES = {
+    "nothing": None,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
 
 def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
     """Residual-projection init, std 0.02/sqrt(2*n_layers) (reference :151-165)."""
@@ -418,6 +426,12 @@ class GPT(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # Rematerialization policy when remat=True (model.extra.remat_policy):
+    # "nothing" (default — save no intermediates, recompute the whole
+    # block) trades the most FLOPs for HBM; "dots" saves matmul outputs
+    # and recomputes only the cheap elementwise ops — less recompute on
+    # the MXU for a modest memory cost, often the better MFU point.
+    remat_policy: str = "nothing"
     attention: str = "dense"
     decode: bool = False  # KV-cache generation mode (see for_decoding())
     decode_cache_len: int = 0  # KV-cache capacity; 0 = block_size
@@ -504,9 +518,20 @@ class GPT(nn.Module):
 
         block_cls = TransformerBlock
         if self.remat:
+            if self.remat_policy not in REMAT_POLICIES:
+                # Direct module users; the adapter validates at config time.
+                raise ValueError(
+                    f"remat_policy {self.remat_policy!r} unknown; expected "
+                    f"one of {sorted(REMAT_POLICIES)}"
+                )
             # argnums include the module at 0; 3 = `deterministic`, a
             # trace-time bool that must stay static through the remat boundary.
-            block_cls = nn.remat(TransformerBlock, static_argnums=(3,))
+            # policy=None is nn.remat's own default (save nothing).
+            block_cls = nn.remat(
+                TransformerBlock,
+                static_argnums=(3,),
+                policy=REMAT_POLICIES[self.remat_policy],
+            )
 
         for layer in range(self.n_layers):
             x = block_cls(
@@ -565,7 +590,7 @@ class GPTAdapter(ModelAdapter):
 
     known_extra_keys = frozenset(
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
-         "assume_packed"}
+         "assume_packed", "remat_policy"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -594,6 +619,14 @@ class GPTAdapter(ModelAdapter):
                 f"model.n_heads ({cfg.model.n_heads}) must be divisible by "
                 f"model.extra.n_kv_heads ({n_kv_heads})"
             )
+        remat_policy = str(cfg.model.extra.get("remat_policy", "nothing"))
+        if remat_policy not in REMAT_POLICIES:
+            # Validated here (not only at trace under remat=True) so a
+            # typo'd policy fails at config time even when remat is off.
+            raise ValueError(
+                f"model.extra.remat_policy {remat_policy!r} unknown; "
+                f"expected one of {sorted(REMAT_POLICIES)}"
+            )
         if cfg.model.attention in ("flash", "ring", "ulysses") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
@@ -618,6 +651,7 @@ class GPTAdapter(ModelAdapter):
             z_loss=z_loss,
             n_kv_heads=n_kv_heads,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
+            remat_policy=remat_policy,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

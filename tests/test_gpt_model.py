@@ -145,6 +145,70 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
 
 
+class TestRematPolicy:
+    """model.extra.remat_policy: value/grad equality across policies (the
+    policy only changes what gets RECOMPUTED, never the math)."""
+
+    def _model(self, policy):
+        return GPT(
+            vocab_size=64, block_size=16, d_model=32, n_layers=2, n_heads=4,
+            d_ff=64, dropout=0.0, remat=True, remat_policy=policy,
+        )
+
+    @pytest.mark.parametrize("policy", ["dots", "dots_no_batch"])
+    def test_matches_default_policy(self, policy):
+        from flax.linen import meta as nn_meta
+
+        base = self._model("nothing")
+        ids = jnp.zeros((1, 16), jnp.int32)
+        params = nn_meta.unbox(
+            base.init(jax.random.key(0), ids, deterministic=True)
+        )["params"]
+        toks = jnp.asarray(
+            np.random.default_rng(3).integers(0, 64, (2, 16)), jnp.int32
+        )
+
+        def loss(model, p):
+            logits = model.apply({"params": p}, toks, deterministic=True)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        v0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+        v1, g1 = jax.value_and_grad(lambda p: loss(self._model(policy), p))(params)
+        assert abs(float(v0) - float(v1)) < 1e-6
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_unknown_policy_raises(self):
+        ids = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="remat_policy"):
+            self._model("everything").init(
+                jax.random.key(0), ids, deterministic=True
+            )
+
+    def test_adapter_validates_policy_even_without_remat(self):
+        """A typo'd policy fails at config time, not silently ignored
+        until someone later flips remat: true."""
+        from llmtrain_tpu.config.schemas import RunConfig
+        from llmtrain_tpu.models.gpt import GPTAdapter
+
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "x", "device": "cpu"},
+                "model": {
+                    "name": "gpt", "block_size": 8, "d_model": 16,
+                    "n_layers": 1, "n_heads": 4, "d_ff": 32,
+                    "vocab_size": 64, "remat": False,
+                    "extra": {"tokenizer": "byte", "remat_policy": "dotz"},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {"max_steps": 1, "micro_batch_size": 2,
+                            "warmup_steps": 0},
+            }
+        )
+        with pytest.raises(ValueError, match="remat_policy"):
+            GPTAdapter().build_model(cfg)
+
+
 class TestGroupedQueryAttention:
     """GQA (model.extra.n_kv_heads): narrow K/V heads shared across query
     groups; the decode cache stores only n_kv_heads."""
