@@ -1,4 +1,4 @@
-.PHONY: test test-all lint train-smoke train-multiproc bench mlflow \
+.PHONY: test test-all lint train-smoke train-multiproc bench chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
@@ -49,6 +49,11 @@ train-moe:
 
 bench:
 	python bench.py
+
+# Full on-chip measurement backlog, one command (probes first; aborts
+# cleanly when the TPU tunnel is down). Artifacts in chip_evidence/.
+chip-evidence:
+	bash tools/run_chip_evidence.sh
 
 mlflow:
 	mlflow ui --backend-store-uri sqlite:///./mlflow.db
