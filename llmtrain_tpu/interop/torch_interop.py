@@ -27,7 +27,9 @@ q/k/v; out_proj (H, hd, D) contracts in the same C-order as torch's
 post-attention reshape.
 
 Import accepts the same naming, tolerates the tied ``lm_head.weight``
-duplicate, and ignores the deterministic ``causal_mask`` buffers.
+duplicate, ignores the deterministic ``causal_mask`` buffers, and maps
+the first-generation export names (``tok.weight``/``blocks.{i}.qkv.*``)
+so pre-alignment .pt files stay importable.
 Conversion is pure numpy — torch is only needed by callers that
 ``torch.save``/``torch.load`` the result (the export-checkpoint CLI).
 All float tensors are exported in float32.
@@ -43,6 +45,35 @@ import numpy as np
 Params = Any  # nested dict pytree of arrays
 
 _CAUSAL_MASK_RE = re.compile(r"^blocks\.\d+\.attn\.causal_mask$")
+
+# The first export format (pre reference-name alignment) used short
+# names and left attention projections unscoped. Files saved by it are
+# mapped on import rather than failing with a generic missing-key error.
+_LEGACY_RENAMES = {
+    "tok.weight": "token_embedding.weight",
+    "pos.weight": "position_embedding.weight",
+}
+_LEGACY_BLOCK_RE = re.compile(r"^(blocks\.\d+)\.(qkv|out_proj)\.(weight|bias)$")
+
+
+def _normalize_legacy_keys(sd: dict[str, Any]) -> dict[str, Any]:
+    """Rename a legacy-format state dict to the current reference names.
+
+    Legacy marker: ``tok.weight`` (the current format always has
+    ``token_embedding.weight`` instead). Tied legacy exports carried no
+    ``lm_head.weight`` duplicate and no ``causal_mask`` buffers; both
+    absences are already tolerated downstream.
+    """
+    if "tok.weight" not in sd:
+        return sd
+    out: dict[str, Any] = {}
+    for k, v in sd.items():
+        m = _LEGACY_BLOCK_RE.match(k)
+        if m:
+            proj = "qkv_proj" if m.group(2) == "qkv" else "out_proj"
+            k = f"{m.group(1)}.attn.{proj}.{m.group(3)}"
+        out[_LEGACY_RENAMES.get(k, k)] = v
+    return out
 
 
 def _np(a) -> np.ndarray:
@@ -131,6 +162,7 @@ def params_from_torch_state_dict(
     """
     import jax.numpy as jnp
 
+    sd = _normalize_legacy_keys(sd)
     consumed: set[str] = set()
 
     def put(key: str, like, transform=lambda a: a) -> Any:

@@ -114,12 +114,45 @@ class SqliteTracker:
     def _connect(self) -> sqlite3.Connection:
         if self._conn is None:
             self._db_path.parent.mkdir(parents=True, exist_ok=True)
-            self._conn = sqlite3.connect(str(self._db_path))
-            self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.executescript(_SCHEMA)
-            self._migrate_nullable_metric_values(self._conn)
-            self._conn.commit()
+            conn = sqlite3.connect(str(self._db_path))
+            # Sniff BEFORE the WAL pragma: journal_mode=WAL is a persistent
+            # on-disk change (+ -wal/-shm sidecars), and a foreign file must
+            # be rejected untouched.
+            self._reject_foreign_schema(conn)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.executescript(_SCHEMA)
+            self._migrate_nullable_metric_values(conn)
+            conn.commit()
+            self._conn = conn
         return self._conn
+
+    @staticmethod
+    def _reject_foreign_schema(conn: sqlite3.Connection) -> None:
+        """Refuse a DB whose ``runs`` table belongs to another product.
+
+        MLflow's own SQLite store also has runs/params/metrics/tags
+        tables (with ``experiment_id`` instead of this backend's
+        ``run_id``/``experiment`` columns). With ``mlflow.backend: auto``
+        and a shared tracking file (the k8s configmap's
+        ``sqlite:////mlflow/mlflow.db``), an image that gains or loses
+        the mlflow extra would silently point this backend at an
+        mlflow-owned file: ``CREATE TABLE IF NOT EXISTS`` accepts the
+        foreign tables and the first INSERT dies mid-training with an
+        opaque OperationalError. Sniff up front and fail with a message
+        that names the fix instead.
+        """
+        cols = {row[1] for row in conn.execute("PRAGMA table_info(runs)")}
+        if cols and not {"run_id", "experiment"} <= cols:
+            path = conn.execute("PRAGMA database_list").fetchone()[2]
+            conn.close()
+            raise RuntimeError(
+                f"tracking DB {path!r} has a 'runs' table from a different "
+                "product (likely MLflow's own SQLite store; its columns are "
+                f"{sorted(cols)}). The native backend cannot share a file "
+                "with the mlflow backend — point mlflow.tracking_uri at a "
+                "separate file, or set mlflow.backend explicitly so both "
+                "relaunches resolve to the backend that created this DB."
+            )
 
     @staticmethod
     def _migrate_nullable_metric_values(conn: sqlite3.Connection) -> None:

@@ -223,6 +223,30 @@ class TestSqliteTrackerUnit:
         assert vals[0] == 7.5  # preserved through the rebuild
         assert math.isnan(vals[1])
 
+    def test_rejects_mlflow_owned_db(self, tmp_path):
+        """Pointing the native backend at a file whose runs table has
+        MLflow's column set must fail up front with a message naming the
+        backend conflict — not on the first INSERT mid-training."""
+        db = tmp_path / "mlflow.db"
+        with sqlite3.connect(db) as conn:
+            # The identifying subset of MLflow's own `runs` table.
+            conn.executescript(
+                """
+                CREATE TABLE runs (
+                    run_uuid VARCHAR(32) PRIMARY KEY, name VARCHAR(250),
+                    experiment_id INTEGER, status VARCHAR(9),
+                    start_time BIGINT, end_time BIGINT,
+                    lifecycle_stage VARCHAR(20), artifact_uri VARCHAR(200));
+                """
+            )
+        t = SqliteTracker(f"sqlite:///{db}", "exp")
+        with pytest.raises(RuntimeError, match="different product"):
+            t.start_run("r1")
+        # The file is untouched — the foreign schema was not "migrated".
+        with sqlite3.connect(db) as conn:
+            cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
+        assert "experiment_id" in cols and "run_id" not in cols
+
     def test_build_tracker_backend_selection(self):
         from types import SimpleNamespace
 

@@ -75,6 +75,32 @@ def test_import_rejects_missing_and_misshaped_keys():
         params_from_torch_state_dict(bad, params)
 
 
+@pytest.mark.parametrize("tie", [True, False], ids=["tied", "untied"])
+def test_import_maps_legacy_export_format(tie):
+    """Pre-alignment .pt files (tok.weight / blocks.{i}.qkv.*, no
+    causal_mask buffers, no tied lm_head duplicate) still import."""
+    _, params = _flax_gpt(tie)
+    sd = params_to_torch_state_dict(params)
+    legacy = {}
+    for k, v in sd.items():
+        if k.endswith(".attn.causal_mask"):
+            continue  # legacy exports had no mask buffers
+        if tie and k == "lm_head.weight":
+            continue  # legacy tied exports omitted the duplicate
+        k = k.replace("token_embedding.weight", "tok.weight")
+        k = k.replace("position_embedding.weight", "pos.weight")
+        k = k.replace(".attn.qkv_proj.", ".qkv.").replace(".attn.out_proj.", ".out_proj.")
+        legacy[k] = v
+    back = params_from_torch_state_dict(legacy, params)
+    for (pa, va), (pb, vb) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(back),
+        strict=True,
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
 def test_export_rejects_non_gpt_tree():
     with pytest.raises(ValueError, match="block_0"):
         params_to_torch_state_dict({"token_embedding": {"embedding": np.zeros((4, 2))},

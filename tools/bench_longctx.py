@@ -99,15 +99,23 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
     }
     t0 = time.perf_counter()
     state, metrics = step_fn(state, batch_dict, rng)
-    jax.block_until_ready(metrics["loss"])
+    jax.device_get(metrics["loss"])
     compile_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
+    # Sync EVERY step via device_get and take the median: r4 on-chip found
+    # that block_until_ready on the final loss under-measured T=4k by >2x
+    # (mfu 3.78 — beyond the device's peak, i.e. impossible). On the
+    # remote-tunnel axon platform block_until_ready can return before
+    # execution finishes (same workaround as bench.py); device_get pulls
+    # the scalar host-side, which cannot complete early. Pulling one f32
+    # per step is a negligible transfer at these shapes.
+    times = []
     for _ in range(steps):
+        t0 = time.perf_counter()
         state, metrics = step_fn(state, batch_dict, rng)
-    jax.block_until_ready(metrics["loss"])
-    elapsed = time.perf_counter() - t0
-    step_time = elapsed / steps
+        jax.device_get(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+    step_time = float(np.median(times))
     tokens_per_sec = batch * seq / step_time
     return {
         "seq": seq,
@@ -132,7 +140,7 @@ def main() -> None:
     ap.add_argument("--seqs", default="4096,8192,16384,32768")
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--attention", default="flash")
-    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--cpu-smoke", action="store_true")
     args = ap.parse_args()
 

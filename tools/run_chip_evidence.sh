@@ -30,7 +30,9 @@ python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log" || log "bench failed"
 tail -1 "$OUT/bench.json" || true
 
 log "2/5 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
-timeout 2400 python -m pytest tests/test_tpu_compiled.py -v \
+# LLMTRAIN_TEST_TPU=1 is the conftest escape hatch — without it the suite
+# forces the hermetic CPU mesh and every TPU-gated test skips.
+timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
     >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
 tail -2 "$OUT/tpu_compiled.log" || true
 
@@ -44,15 +46,24 @@ timeout 3600 python tools/bench_longctx.py \
 
 log "5/5 BPE headline train (gpt_pycorpus_bpe_tpu, needs runs/pytok8k.json)..."
 if [ ! -f runs/pytok8k.json ]; then
-    timeout 1200 python -m llmtrain_tpu train-tokenizer \
-        --input /usr/local/lib/python3.12 --vocab-size 8192 \
-        --output runs/pytok8k.json >"$OUT/tokenizer.log" 2>&1 \
-        || log "tokenizer training failed"
+    CORPUS="${CORPUS:-$(python -c 'import sysconfig; print(sysconfig.get_paths()["stdlib"])')}"
+    if [ ! -d "$CORPUS" ]; then
+        log "ERROR: tokenizer corpus '$CORPUS' not found — set CORPUS=<dir>"
+    else
+        timeout 1200 python -m llmtrain_tpu train-tokenizer \
+            --input "$CORPUS" --vocab-size 8192 \
+            --output runs/pytok8k.json >"$OUT/tokenizer.log" 2>&1 \
+            || log "tokenizer training failed"
+    fi
 fi
-timeout 5400 python -m llmtrain_tpu train \
-    --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
-    --run-id chip-evidence-bpe --json \
-    >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
-    || log "BPE headline failed/partial"
+if [ -f runs/pytok8k.json ]; then
+    timeout 5400 python -m llmtrain_tpu train \
+        --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
+        --run-id chip-evidence-bpe --json \
+        >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
+        || log "BPE headline failed/partial"
+else
+    log "no tokenizer file — skipping BPE headline train"
+fi
 
 log "done — artifacts in $OUT/. Fold the numbers into RESULTS.md."
