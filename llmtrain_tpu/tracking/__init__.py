@@ -15,6 +15,41 @@ def _mlflow_available() -> bool:
     return importlib.util.find_spec("mlflow") is not None
 
 
+def _reject_native_owned_db(tracking_uri: str) -> None:
+    """The reverse of SqliteTracker's foreign-schema sniff.
+
+    An image that GAINS the mlflow extra flips ``backend: auto`` from the
+    native store to MLflow at the same tracking URI (the k8s configmap
+    shares ``sqlite:////mlflow/mlflow.db``). mlflow's SqlAlchemy store
+    would then initialize against a file whose runs/params/metrics/tags
+    tables have the native backend's columns — dying in an opaque
+    alembic/OperationalError (and possibly writing migration state into
+    the native file). Sniff the native marker columns up front and name
+    the fix instead. Only sqlite: URIs can collide; server URIs pass.
+    """
+    if not tracking_uri.startswith("sqlite:"):
+        return
+    from .sqlite import resolve_db_path
+
+    db_path = resolve_db_path(tracking_uri)
+    if not db_path.exists():
+        return
+    import sqlite3
+
+    try:
+        with sqlite3.connect(db_path) as conn:
+            cols = {row[1] for row in conn.execute("PRAGMA table_info(runs)")}
+    except sqlite3.Error:
+        return  # unreadable/odd file: let mlflow produce its own error
+    if cols and {"run_id", "experiment"} <= cols:
+        raise RuntimeError(
+            f"tracking DB {str(db_path)!r} was created by the native SQLite "
+            "backend; the mlflow backend cannot share it. Point "
+            "mlflow.tracking_uri at a separate file, or set "
+            "mlflow.backend: native to keep using this DB."
+        )
+
+
 def build_tracker(mlflow_cfg: Any, run_id: str) -> Tracker:
     """Backend selection for the main process (``mlflow.backend``):
 
@@ -30,6 +65,7 @@ def build_tracker(mlflow_cfg: Any, run_id: str) -> Tracker:
     backend = getattr(mlflow_cfg, "backend", "auto")
     run_name = mlflow_cfg.run_name or run_id
     if backend == "mlflow" or (backend == "auto" and _mlflow_available()):
+        _reject_native_owned_db(mlflow_cfg.tracking_uri)
         return MLflowTracker(
             mlflow_cfg.tracking_uri, mlflow_cfg.experiment, run_name=run_name
         )

@@ -1,0 +1,196 @@
+"""A faithful fake of the mlflow API surface MLflowTracker uses.
+
+The image ships without mlflow (the [mlflow] extra is only installed in
+the k8s images), so tests/test_mlflow_roundtrip.py skips here and
+``tracking/mlflow.py`` would otherwise never execute anywhere the fast
+suite runs. This module lets tests inject a behaviorally-accurate stand-in
+via ``sys.modules["mlflow"]`` — the tracker's lazy ``import mlflow``
+(tracking/mlflow.py:53) then resolves to this module and every line of the
+tracker runs for real.
+
+Faithfulness notes (matched to mlflow 2.x semantics the tracker relies on):
+
+* ``log_params`` stores every value as ``str(value)`` — mlflow params are
+  strings on read-back, which is exactly what the parity test asserts
+  against the native backend's TEXT column.
+* ``start_run(run_id=...)`` reattaches to a known run (raises for an
+  unknown id, as mlflow does); ``start_run(run_name=...)`` creates one in
+  the CURRENT experiment set by ``set_experiment``.
+* ``search_runs(..., filter_string='tags."k" = \'v\'', output_format=
+  "list")`` supports the one filter shape the tracker emits
+  (tracking/mlflow.py:100) and returns Run-shaped objects with
+  ``.info.run_id``.
+* ``log_metrics`` records (key, value, step, timestamp) rows per call —
+  history, not last-write-wins — like mlflow's metric store.
+* State persists in a module-global store keyed by tracking URI for the
+  lifetime of the process, so a second ``MLflowTracker`` (the
+  auto-resume relaunch path) sees the first one's runs. Call ``reset()``
+  between tests.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+_FILTER_RE = re.compile(r'^tags\."([^"]+)"\s*=\s*\'([^\']*)\'$')
+
+
+@dataclass
+class _Run:
+    run_id: str
+    experiment_id: str
+    run_name: str
+    status: str = "RUNNING"
+    start_time: float = field(default_factory=time.time)
+    end_time: float | None = None
+    tags: dict[str, str] = field(default_factory=dict)
+    params: dict[str, str] = field(default_factory=dict)
+    metrics: list[dict[str, Any]] = field(default_factory=list)
+    artifacts: list[tuple[str, str | None]] = field(default_factory=list)
+
+    @property
+    def info(self) -> "_Run":  # mlflow Run.info.run_id shape
+        return self
+
+
+class _Experiment:
+    def __init__(self, experiment_id: str, name: str) -> None:
+        self.experiment_id = experiment_id
+        self.name = name
+
+
+class _Store:
+    def __init__(self) -> None:
+        self.experiments: dict[str, _Experiment] = {}
+        self.runs: dict[str, _Run] = {}
+
+    def experiment(self, name: str) -> _Experiment:
+        if name not in self.experiments:
+            self.experiments[name] = _Experiment(str(len(self.experiments)), name)
+        return self.experiments[name]
+
+
+_stores: dict[str, _Store] = {}
+_tracking_uri: str = "file:./mlruns"
+_current_experiment: str = "Default"
+_active: _Run | None = None
+
+
+def reset() -> None:
+    global _tracking_uri, _current_experiment, _active
+    _stores.clear()
+    _tracking_uri = "file:./mlruns"
+    _current_experiment = "Default"
+    _active = None
+
+
+def _store() -> _Store:
+    return _stores.setdefault(_tracking_uri, _Store())
+
+
+def set_tracking_uri(uri: str) -> None:
+    global _tracking_uri
+    _tracking_uri = uri
+
+
+def get_tracking_uri() -> str:
+    return _tracking_uri
+
+
+def set_experiment(name: str) -> _Experiment:
+    global _current_experiment
+    _current_experiment = name
+    return _store().experiment(name)
+
+
+def get_experiment_by_name(name: str) -> _Experiment | None:
+    return _store().experiments.get(name)
+
+
+def start_run(run_id: str | None = None, run_name: str | None = None) -> _Run:
+    global _active
+    store = _store()
+    if run_id is not None:
+        if run_id not in store.runs:
+            raise Exception(f"Run with id={run_id} not found")  # mlflow-like
+        run = store.runs[run_id]
+        run.status = "RUNNING"
+        run.end_time = None
+    else:
+        exp = store.experiment(_current_experiment)
+        run = _Run(
+            run_id=uuid.uuid4().hex,
+            experiment_id=exp.experiment_id,
+            run_name=run_name or f"run-{len(store.runs)}",
+        )
+        store.runs[run.run_id] = run
+    _active = run
+    return run
+
+
+def active_run() -> _Run | None:
+    return _active
+
+
+def _require_active() -> _Run:
+    if _active is None:
+        raise Exception("no active run; call start_run first")
+    return _active
+
+
+def set_tag(key: str, value: Any) -> None:
+    _require_active().tags[key] = str(value)
+
+
+def log_params(params: dict[str, Any]) -> None:
+    run = _require_active()
+    for k, v in params.items():
+        run.params[k] = str(v)
+
+
+def log_metrics(metrics: dict[str, float], step: int | None = None) -> None:
+    run = _require_active()
+    now = time.time()
+    for k, v in metrics.items():
+        run.metrics.append(
+            {"key": k, "value": float(v), "step": step, "timestamp": now}
+        )
+
+
+def log_artifact(local_path: str, artifact_path: str | None = None) -> None:
+    _require_active().artifacts.append((local_path, artifact_path))
+
+
+def end_run(status: str = "FINISHED") -> None:
+    global _active
+    if _active is not None:
+        _active.status = status
+        _active.end_time = time.time()
+        _active = None
+
+
+def search_runs(
+    experiment_ids: list[str] | None = None,
+    filter_string: str = "",
+    max_results: int = 1000,
+    output_format: str = "pandas",
+) -> list[_Run]:
+    if output_format != "list":
+        raise NotImplementedError("fake_mlflow only supports output_format='list'")
+    m = _FILTER_RE.match(filter_string.strip())
+    if filter_string and not m:
+        raise Exception(f"unsupported filter: {filter_string!r}")
+    out = []
+    for run in _store().runs.values():
+        if experiment_ids is not None and run.experiment_id not in experiment_ids:
+            continue
+        if m and run.tags.get(m.group(1)) != m.group(2):
+            continue
+        out.append(run)
+        if len(out) >= max_results:
+            break
+    return out
