@@ -21,17 +21,14 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax  # noqa: E402
 
 if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
     jax.config.update("jax_platforms", "cpu")
-
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 
 
 def _peak_bytes() -> float:
@@ -41,12 +38,8 @@ def _peak_bytes() -> float:
 
 def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
           steps: int) -> dict:
-    from flax.linen import meta as nn_meta
-
+    from _bench_common import build_train_cell, make_batch, measure_cell
     from llmtrain_tpu.config.schemas import RunConfig
-    from llmtrain_tpu.models.gpt import GPTAdapter
-    from llmtrain_tpu.training.optimizer import build_optimizer
-    from llmtrain_tpu.training.train_step import create_train_state, make_train_step
     from llmtrain_tpu.utils.hw import mfu as compute_mfu
 
     if cpu_smoke:
@@ -79,43 +72,15 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
             },
         }
     )
-    adapter = GPTAdapter()
-    model = adapter.build_model(cfg)
-    tx = build_optimizer(cfg.trainer)
-    rng = jax.random.key(0)
-    params = nn_meta.unbox(adapter.init_params(model, cfg, rng))
-    n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
-    state = create_train_state(params, tx)
-    step_fn = jax.jit(
-        make_train_step(adapter, model, tx, grad_accum_steps=1, use_dropout=False)
-    )
-    tokens = np.random.default_rng(0).integers(
-        0, dims["vocab_size"], size=(1, batch, seq), dtype=np.int32
-    )
-    batch_dict = {
-        "input_ids": jnp.asarray(tokens),
-        "labels": jnp.asarray(tokens),
-        "attention_mask": jnp.ones_like(jnp.asarray(tokens)),
-    }
-    t0 = time.perf_counter()
-    state, metrics = step_fn(state, batch_dict, rng)
-    jax.device_get(metrics["loss"])
-    compile_s = time.perf_counter() - t0
-
-    # Sync EVERY step via device_get and take the median: r4 on-chip found
-    # that block_until_ready on the final loss under-measured T=4k by >2x
-    # (mfu 3.78 — beyond the device's peak, i.e. impossible). On the
-    # remote-tunnel axon platform block_until_ready can return before
-    # execution finishes (same workaround as bench.py); device_get pulls
-    # the scalar host-side, which cannot complete early. Pulling one f32
-    # per step is a negligible transfer at these shapes.
-    times = []
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        state, metrics = step_fn(state, batch_dict, rng)
-        jax.device_get(metrics["loss"])
-        times.append(time.perf_counter() - t0)
-    step_time = float(np.median(times))
+    # Measurement discipline (device_get-synced median of per-step times)
+    # lives in _bench_common.measure_cell: r4 on-chip found that blocking
+    # only on the final loss under-measured T=4k by >2x (mfu 3.78 —
+    # beyond the device's peak, i.e. impossible) because block_until_ready
+    # can return early through the axon tunnel.
+    step_fn, state, n_params = build_train_cell(cfg)
+    batch_dict = make_batch(batch, seq, dims["vocab_size"])
+    m = measure_cell(step_fn, state, batch_dict, steps)
+    step_time = m["step_time_s"]
     tokens_per_sec = batch * seq / step_time
     return {
         "seq": seq,
@@ -130,8 +95,8 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
                         d_model=dims["d_model"]), 4,
         ),
         "peak_hbm_gb": round(_peak_bytes() / 2**30, 3),
-        "compile_s": round(compile_s, 1),
-        "loss": float(jax.device_get(metrics["loss"])),
+        "compile_s": round(m["compile_s"], 1),
+        "loss": m["loss"],
     }
 
 

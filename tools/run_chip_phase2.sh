@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Phase-2 on-chip evidence: the steps the first live window didn't cover
+# (r4: tunnel died after ~35 min, having banked bench/decode/longctx-4k8k).
+#
+#     bash tools/run_chip_phase2.sh [outdir]
+#
+# Same contract as run_chip_evidence.sh: probe with a hard timeout, every
+# step watchdogged and independent, artifacts land in <outdir>.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-chip_evidence_p2}"
+mkdir -p "$OUT"
+
+log() { echo "[chip-p2] $*" >&2; }
+
+log "probing TPU backend (240s timeout)..."
+if ! timeout 240 python -c "import jax; assert jax.default_backend() == 'tpu'" \
+    >"$OUT/probe.log" 2>&1; then
+    log "TPU backend unreachable — aborting (see $OUT/probe.log)"
+    exit 1
+fi
+log "TPU live."
+
+log "1/6 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
+timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
+    >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
+tail -2 "$OUT/tpu_compiled.log" || true
+
+log "2/6 masked-vs-packed A/B + GQA train deltas..."
+timeout 3000 python tools/bench_mask_ab.py \
+    >"$OUT/mask_ab.json" 2>"$OUT/mask_ab.log" || log "mask A/B failed/partial"
+tail -1 "$OUT/mask_ab.json" || true
+
+log "3/6 long-context sweep (fixed per-step sync; retry 16k/32k)..."
+timeout 3600 python tools/bench_longctx.py --seqs 4096,8192,16384,32768 \
+    >"$OUT/longctx.json" 2>"$OUT/longctx.log" || log "longctx failed/partial"
+
+log "4/6 bench auto-sweep with room to climb (deadline 1500s)..."
+# TPU_TIMEOUT must rise with DEADLINE_SEC: the parent watchdog kills the
+# child at TPU_TIMEOUT regardless of the child's sweep budget.
+timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=1600 \
+    python bench.py \
+    >"$OUT/bench_sweep.json" 2>"$OUT/bench_sweep.log" || log "bench sweep failed"
+tail -1 "$OUT/bench_sweep.json" || true
+
+log "5/6 chunked-CE batch-128 cell (the HBM-freed retune)..."
+timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked python bench.py \
+    >"$OUT/bench_c128.json" 2>"$OUT/bench_c128.log" || log "c128 cell failed"
+tail -1 "$OUT/bench_c128.json" || true
+
+log "6/6 BPE headline train (tokenizer already at runs/pytok8k.json)..."
+if [ -f runs/pytok8k.json ]; then
+    timeout 5400 python -m llmtrain_tpu train \
+        --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
+        --run-id chip-evidence-bpe --json \
+        >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
+        || log "BPE headline failed/partial"
+else
+    log "no tokenizer file — skipping BPE headline train"
+fi
+
+log "done — artifacts in $OUT/. Fold the numbers into RESULTS.md."
