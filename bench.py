@@ -87,17 +87,22 @@ def _watchdog_main() -> None:
     cpu_timeout = float(os.environ.get("LLMTRAIN_BENCH_CPU_TIMEOUT", "600"))
 
     force_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    # Evidence runs (tools/run_chip_phase2.sh) set NO_FALLBACK=1: a CPU
+    # default-shape line landing in a chip-evidence artifact would be
+    # mislabeled as an on-chip number. Better no line than a wrong line.
+    no_fallback = os.environ.get("LLMTRAIN_BENCH_NO_FALLBACK") == "1"
     failures: list[str] = []
 
     attempts: list[tuple[dict[str, str], float]] = []
     if not force_cpu:
         attempts.append(({}, tpu_timeout))
         attempts.append(({}, retry_timeout))
-        # The last-resort CPU child must ignore TPU-sweep knobs (a batch
-        # tuned for the chip would blow the CPU timeout).
-        attempts.append(
-            ({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout)
-        )
+        if not no_fallback:
+            # The last-resort CPU child must ignore TPU-sweep knobs (a
+            # batch tuned for the chip would blow the CPU timeout).
+            attempts.append(
+                ({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout)
+            )
     else:
         attempts.append(({"JAX_PLATFORMS": "cpu"}, cpu_timeout))
 

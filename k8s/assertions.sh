@@ -1,0 +1,71 @@
+# E2E assertion functions, factored out of test_e2e.sh so the fast test
+# suite can validate the assertion LOGIC without docker/kind
+# (tests/test_k8s_e2e_assertions.py runs them against a real run dir
+# produced by a CLI train). test_e2e.sh sources this file; the functions
+# use pass/fail hooks the caller defines (or the defaults below).
+#
+# Contract: every assert_* function prints PASS/FAIL lines via pass/fail
+# and returns 0 iff all its assertions passed (FAILURES increments per
+# fail, so callers may also sum over multiple calls).
+
+FAILURES=${FAILURES:-0}
+
+pass() { printf '  PASS: %s\n' "$*"; }
+fail() { printf '  FAIL: %s\n' "$*" >&2; FAILURES=$((FAILURES + 1)); }
+
+# rank-0 pod logs must show the training summary and the entrypoint's
+# exec handoff (k8s/entrypoint.sh prints it before exec'ing python).
+assert_rank0_logs() {
+    local logs="$1" before="$FAILURES"
+    grep -q "final_step" <<<"$logs" \
+        && pass "rank-0 logs report final_step" \
+        || fail "no final_step in rank-0 logs"
+    grep -q "entrypoint: exec python" <<<"$logs" \
+        && pass "entrypoint exec line present" \
+        || fail "entrypoint exec line missing"
+    [ "$FAILURES" -eq "$before" ]
+}
+
+# The run directory the hostPath PV surfaces must contain the artifact
+# tree the Trainer writes (utils/run_dir.py layout).
+assert_artifact_tree() {
+    local run_dir="$1" before="$FAILURES" rel
+    if [ -z "$run_dir" ] || [ ! -d "$run_dir" ]; then
+        fail "no run directory (got '${run_dir:-}')"
+        return 1
+    fi
+    pass "run dir $run_dir exists"
+    for rel in checkpoints logs/train.log config.yaml meta.json; do
+        [ -e "$run_dir/$rel" ] && pass "$rel present" || fail "$rel missing in $run_dir"
+    done
+    [ "$FAILURES" -eq "$before" ]
+}
+
+# The tracking DB must exist, be non-empty, and actually contain a
+# finished run (a 0-byte or schema-only file means tracking silently
+# recorded nothing — the bug class this assertion exists for).
+assert_tracking_db() {
+    local db="$1" before="$FAILURES"
+    if [ ! -s "$db" ]; then
+        fail "tracking db missing/empty: $db"
+        return 1
+    fi
+    pass "tracking db non-empty"
+    if command -v python >/dev/null 2>&1; then
+        if python - "$db" <<'PY'
+import sqlite3, sys
+conn = sqlite3.connect(sys.argv[1])
+try:
+    n = conn.execute(
+        "SELECT COUNT(*) FROM runs WHERE status IN ('FINISHED','RUNNING')"
+    ).fetchone()[0]
+except sqlite3.Error:
+    sys.exit(1)
+sys.exit(0 if n > 0 else 1)
+PY
+        then pass "tracking db has a recorded run"
+        else fail "tracking db has no recorded run (or unreadable schema)"
+        fi
+    fi
+    [ "$FAILURES" -eq "$before" ]
+}

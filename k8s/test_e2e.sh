@@ -20,8 +20,9 @@ MANIFESTS=(k8s/infra.yaml k8s/configmap.yaml k8s/job.yaml)
 FAILURES=0
 
 say()  { printf '==> %s\n' "$*"; }
-pass() { printf '  PASS: %s\n' "$*"; }
-fail() { printf '  FAIL: %s\n' "$*" >&2; FAILURES=$((FAILURES + 1)); }
+# assert_* + pass/fail live in assertions.sh so the fast suite can test
+# them without docker (tests/test_k8s_e2e_assertions.py).
+. "$(dirname "$0")/assertions.sh"
 
 finish() {
     if [ "$KEEP" = true ]; then
@@ -59,12 +60,7 @@ POD0=$(kubectl get pods \
 LOGS0=$(kubectl logs "$POD0")
 
 say "asserting rank-0 output"
-grep -q "final_step" <<<"$LOGS0" \
-    && pass "rank-0 logs report final_step" \
-    || fail "no final_step in rank-0 logs"
-grep -q "entrypoint: exec python" <<<"$LOGS0" \
-    && pass "entrypoint exec line present" \
-    || fail "entrypoint exec line missing"
+assert_rank0_logs "$LOGS0" || true
 
 say "asserting pod exit codes"
 while IFS=$'\t' read -r name code; do
@@ -74,15 +70,8 @@ done < <(kubectl get pods -l "app=$JOB" -o jsonpath='{range .items[*]}{.metadata
 
 say "asserting host artifacts"
 RUN_DIR=$(find ./runs -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)
-if [ -n "$RUN_DIR" ]; then
-    pass "run dir $RUN_DIR exists"
-    for rel in checkpoints logs/train.log config.yaml meta.json; do
-        [ -e "$RUN_DIR/$rel" ] && pass "$rel present" || fail "$rel missing in $RUN_DIR"
-    done
-else
-    fail "no run directory under ./runs"
-fi
-[ -s ./mlflow-k8s/mlflow.db ] && pass "mlflow.db non-empty" || fail "mlflow.db missing/empty"
+assert_artifact_tree "$RUN_DIR" || true
+assert_tracking_db ./mlflow-k8s/mlflow.db || true
 
 if [ "$FAILURES" -eq 0 ]; then
     say "E2E SUCCEEDED"

@@ -1,0 +1,249 @@
+"""Docker-free validation of the k8s e2e harness (VERDICT r3 #5).
+
+The reference's ``k8s/test_e2e.sh`` runs on a local kind cluster
+(reference k8s/test_e2e.sh:107-186); docker/kind has never been present
+in this environment, so the port's ASSERTION LOGIC itself was unvalidated
+— a broken grep would pass an all-green e2e and nothing would notice.
+Two closures here:
+
+* The assertion functions (factored into ``k8s/assertions.sh``) run
+  against a REAL run directory produced by a CLI train — the same
+  artifact tree the hostPath PV surfaces in the cluster — plus negative
+  fixtures proving each assertion can actually fail.
+* The manifests are structurally validated: YAML parses, the names that
+  must agree across files (service account, PVC claims, configmap names,
+  headless-service subdomain) do agree, and the embedded train.yaml
+  payloads validate against the REAL config schema — so
+  ``job-tpu-v5e.yaml`` cannot rot silently.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+K8S = REPO / "k8s"
+
+
+def _sh(snippet: str) -> subprocess.CompletedProcess:
+    """Run a bash snippet with assertions.sh sourced."""
+    return subprocess.run(
+        ["bash", "-c", f'. "{K8S}/assertions.sh"\n{snippet}'],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    """A real CLI train: run dir + native tracking DB + stdout logs."""
+    workdir = tmp_path_factory.mktemp("k8s-fixture")
+    cfg = {
+        "run": {"name": "e2e-fixture", "seed": 0, "device": "cpu"},
+        "model": {
+            "name": "dummy_gpt", "block_size": 8, "d_model": 32,
+            "n_layers": 1, "n_heads": 2, "d_ff": 64, "vocab_size": 32,
+            "extra": {"tokenizer": "byte"},
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 2, "micro_batch_size": 2, "grad_accum_steps": 1,
+            "warmup_steps": 0, "log_every_steps": 1, "eval_every_steps": 2,
+            "save_every_steps": 2,
+        },
+        "mlflow": {
+            "enabled": True, "tracking_uri": "sqlite:///track.db",
+            "experiment": "e2e", "backend": "native",
+        },
+        "logging": {"json_output": True, "log_to_file": True},
+    }
+    (workdir / "cfg.yaml").write_text(yaml.safe_dump(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", "train", "--config", "cfg.yaml",
+         "--json"],
+        capture_output=True, text=True, cwd=workdir, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    run_dir = next((workdir / "runs").iterdir())
+    # Pod logs = the entrypoint's exec line followed by the CLI's output.
+    logs = "entrypoint: exec python -m llmtrain_tpu train --config cfg.yaml\n"
+    logs += proc.stdout + proc.stderr
+    return {"run_dir": run_dir, "db": workdir / "track.db", "logs": logs}
+
+
+class TestAssertRank0Logs:
+    def test_passes_on_real_train_logs(self, trained_run, tmp_path):
+        f = tmp_path / "logs.txt"
+        f.write_text(trained_run["logs"])
+        r = _sh(f'assert_rank0_logs "$(cat "{f}")"')
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("PASS") == 2
+
+    def test_fails_without_final_step(self):
+        r = _sh('assert_rank0_logs "entrypoint: exec python ... but it died"')
+        assert r.returncode != 0
+        assert "FAIL: no final_step" in r.stderr
+
+    def test_fails_without_entrypoint_line(self):
+        r = _sh('assert_rank0_logs "final_step: 2"')
+        assert r.returncode != 0
+        assert "entrypoint exec line missing" in r.stderr
+
+
+class TestAssertArtifactTree:
+    def test_passes_on_real_run_dir(self, trained_run):
+        r = _sh(f'assert_artifact_tree "{trained_run["run_dir"]}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_fails_on_missing_dir(self):
+        r = _sh('assert_artifact_tree ""')
+        assert r.returncode != 0
+
+    def test_fails_on_incomplete_tree(self, tmp_path):
+        (tmp_path / "checkpoints").mkdir()
+        r = _sh(f'assert_artifact_tree "{tmp_path}"')
+        assert r.returncode != 0
+        assert "train.log missing" in r.stderr
+
+
+class TestAssertTrackingDb:
+    def test_passes_on_real_db(self, trained_run):
+        r = _sh(f'assert_tracking_db "{trained_run["db"]}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_fails_on_empty_file(self, tmp_path):
+        db = tmp_path / "empty.db"
+        db.touch()
+        r = _sh(f'assert_tracking_db "{db}"')
+        assert r.returncode != 0
+
+    def test_fails_on_schema_only_db(self, tmp_path):
+        """A DB where tracking initialized but recorded nothing must FAIL
+        — that silent-no-op is the bug class the assertion exists for."""
+        db = tmp_path / "schema.db"
+        with sqlite3.connect(db) as conn:
+            conn.execute(
+                "CREATE TABLE runs (run_uuid TEXT PRIMARY KEY, run_id TEXT, "
+                "experiment TEXT, status TEXT)"
+            )
+        r = _sh(f'assert_tracking_db "{db}"')
+        assert r.returncode != 0
+        assert "no recorded run" in r.stderr
+
+
+# ---------------------------------------------------------------- manifests
+
+
+def _load_all(name: str) -> list[dict]:
+    docs = list(yaml.safe_load_all((K8S / name).read_text()))
+    return [d for d in docs if d is not None]
+
+
+def _by_kind(docs: list[dict], kind: str) -> list[dict]:
+    return [d for d in docs if d.get("kind") == kind]
+
+
+@pytest.fixture(scope="module")
+def manifests():
+    return {
+        name: _load_all(name)
+        for name in (
+            "job.yaml", "job-tpu-v5e.yaml", "infra.yaml", "configmap.yaml",
+            "dashboard-admin.yaml", "kind-config.yaml",
+        )
+    }
+
+
+class TestManifestStructure:
+    def test_all_yaml_parses(self, manifests):
+        for name, docs in manifests.items():
+            assert docs, f"{name} parsed to nothing"
+
+    @pytest.mark.parametrize("job_file", ["job.yaml", "job-tpu-v5e.yaml"])
+    def test_jobs_are_indexed_with_matched_completions(self, manifests, job_file):
+        (job,) = _by_kind(manifests[job_file], "Job")
+        spec = job["spec"]
+        assert spec["completionMode"] == "Indexed"
+        assert spec["completions"] == spec["parallelism"]
+        assert spec["backoffLimit"] == 0  # fail fast, don't flap rendezvous
+
+    def test_job_references_resolve(self, manifests):
+        """Every name job.yaml references must exist in infra/configmap."""
+        (job,) = _by_kind(manifests["job.yaml"], "Job")
+        pod = job["spec"]["template"]["spec"]
+        sa_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["infra.yaml"], "ServiceAccount")}
+        assert pod["serviceAccountName"] in sa_names
+        pvc_names = {
+            d["metadata"]["name"]
+            for d in _by_kind(manifests["infra.yaml"], "PersistentVolumeClaim")
+        }
+        cm_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["configmap.yaml"], "ConfigMap")}
+        for vol in pod["volumes"]:
+            if "persistentVolumeClaim" in vol:
+                assert vol["persistentVolumeClaim"]["claimName"] in pvc_names
+            if "configMap" in vol:
+                assert vol["configMap"]["name"] in cm_names
+
+    def test_tpu_job_references_and_selectors(self, manifests):
+        (job,) = _by_kind(manifests["job-tpu-v5e.yaml"], "Job")
+        pod = job["spec"]["template"]["spec"]
+        # GKE TPU host discovery needs the headless-service subdomain.
+        svc_names = {d["metadata"]["name"]
+                     for d in _by_kind(manifests["infra.yaml"], "Service")}
+        assert pod["subdomain"] in svc_names
+        sel = pod["nodeSelector"]
+        assert "cloud.google.com/gke-tpu-accelerator" in sel
+        assert "cloud.google.com/gke-tpu-topology" in sel
+        (ctr,) = pod["containers"]
+        res = ctr["resources"]
+        assert res["requests"]["google.com/tpu"] == res["limits"]["google.com/tpu"]
+        cm_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["configmap.yaml"], "ConfigMap")}
+        for vol in pod["volumes"]:
+            if "configMap" in vol:
+                assert vol["configMap"]["name"] in cm_names
+
+    def test_headless_service_is_headless(self, manifests):
+        svcs = _by_kind(manifests["infra.yaml"], "Service")
+        headless = [s for s in svcs if s["metadata"]["name"].endswith("headless")]
+        assert headless and all(s["spec"]["clusterIP"] == "None" for s in headless)
+
+    def test_configmap_payloads_validate_against_real_schema(self, manifests):
+        """The embedded train.yaml configs must pass the actual config
+        validators — the strongest rot protection available offline."""
+        from llmtrain_tpu.config.schemas import RunConfig
+
+        payloads = 0
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            for key, raw in cm.get("data", {}).items():
+                if key.endswith(".yaml"):
+                    RunConfig.model_validate(yaml.safe_load(raw))
+                    payloads += 1
+        assert payloads >= 2  # kind CPU config + v5e TPU config
+
+    def test_entrypoint_config_path_matches_configmap_key(self, manifests):
+        """entrypoint.sh defaults to /config/train.yaml; the configmap must
+        publish that key and job.yaml must mount it at /config."""
+        entry = (K8S / "entrypoint.sh").read_text()
+        assert "/config/train.yaml" in entry
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            assert "train.yaml" in cm["data"]
+        (job,) = _by_kind(manifests["job.yaml"], "Job")
+        pod = job["spec"]["template"]["spec"]
+        (ctr,) = pod["containers"]
+        config_mounts = [m for m in ctr["volumeMounts"] if m["name"] == "config"]
+        assert config_mounts and config_mounts[0]["mountPath"] == "/config"

@@ -61,6 +61,43 @@ def make_batch(
     }
 
 
+def time_generate(
+    model,
+    params,
+    prompt: np.ndarray,
+    *,
+    new_tokens: int,
+    repeats: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> float:
+    """ms/token for one-scan KV-cache decode (best of ``repeats``).
+
+    Shared by bench_decode and diag_decode so the decode measurement
+    discipline lives in one place (np.asarray pulls the tokens host-side
+    — the device_get-grade sync; see module docstring).
+    """
+    from llmtrain_tpu.generation import generate
+
+    def run():
+        return np.asarray(
+            generate(
+                model, params, prompt, max_new_tokens=new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                use_cache=True,
+            )
+        )
+
+    run()  # compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return min(times) / new_tokens * 1e3
+
+
 def measure_cell(step_fn, state, batch_dict, steps: int) -> dict:
     """Compile, then time ``steps`` device_get-synced steps (median)."""
     rng = jax.random.key(0)

@@ -24,9 +24,9 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -60,27 +60,16 @@ def _build_model(on_tpu: bool, n_kv_heads: int):
 
 def _bench_cell(model, params, batch: int, prompt_len: int, new_tokens: int,
                 repeats: int) -> dict:
-    from llmtrain_tpu.generation import generate
+    from _bench_common import time_generate
 
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, model.vocab_size, (batch, prompt_len)).astype(np.int32)
-
-    def run():
-        out = generate(
-            model, params, prompt,
-            max_new_tokens=new_tokens, temperature=0.0, use_cache=True,
-        )
-        return np.asarray(out)
-
-    run()  # compile
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    ms_per_tok = time_generate(
+        model, params, prompt, new_tokens=new_tokens, repeats=repeats
+    )
+    best = ms_per_tok * new_tokens / 1e3
     return {
-        "ms_per_step": round(best / new_tokens * 1e3, 3),
+        "ms_per_step": round(ms_per_tok, 3),
         "tokens_per_sec": round(batch * new_tokens / best, 1),
         "wall_s": round(best, 3),
     }

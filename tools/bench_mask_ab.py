@@ -143,10 +143,24 @@ def main() -> None:
 
     ok = [r for r in rows if "error" not in r]
     by = {r["cell"]: r["step_time_ms"] for r in ok}
-    if "packed" in by and "masked" in by:
-        print(json.dumps({
-            "mask_overhead_pct": round(100 * (by["masked"] / by["packed"] - 1), 2),
-        }), flush=True)
+    summary: dict = {}
+    # Mask-operand overhead per kv width (masked vs packed, same kv).
+    suffixes = {c[len("packed"):] for c in by if c.startswith("packed")}
+    for sfx in sorted(suffixes):
+        p, m_ = by.get(f"packed{sfx}"), by.get(f"masked{sfx}")
+        if p and m_:
+            summary[f"mask_overhead_pct{sfx or '+mha'}"] = round(
+                100 * (m_ / p - 1), 2
+            )
+    # Narrow-K/V train-step delta per kv width (gqa vs MHA, packed path).
+    if "packed" in by:
+        for cell, t in by.items():
+            if cell.startswith("packed+gqa"):
+                summary[f"gqa_speedup_pct{cell[len('packed'):]}"] = round(
+                    100 * (by["packed"] / t - 1), 2
+                )
+    if summary:
+        print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Probe the TPU tunnel in a loop and fire a command at the first live
+# probe. The axon tunnel comes and goes (r2-r3: down for whole rounds;
+# r4: one ~35-min window) — evidence runs must be armed, not manual.
+#
+#     bash tools/chip_watch.sh                          # default: phase 2
+#     bash tools/chip_watch.sh 'python bench.py'        # any command
+#     CHIP_WATCH_PROBES=50 CHIP_WATCH_SLEEP=60 bash tools/chip_watch.sh
+#
+# Runs in the foreground; nohup it for unattended arming:
+#     nohup bash tools/chip_watch.sh > chip_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+CMD="${1:-bash tools/run_chip_phase2.sh chip_evidence_p2}"
+PROBES="${CHIP_WATCH_PROBES:-200}"
+SLEEP="${CHIP_WATCH_SLEEP:-120}"
+
+for i in $(seq 1 "$PROBES"); do
+    if timeout 90 python -c 'import jax; assert jax.default_backend() == "tpu"' \
+        >/dev/null 2>&1; then
+        echo "[chip-watch] tunnel live at $(date -u +%H:%M:%S); running: $CMD"
+        eval "$CMD"
+        exit $?
+    fi
+    echo "[chip-watch] probe $i/$PROBES failed at $(date -u +%H:%M:%S); sleeping ${SLEEP}s"
+    sleep "$SLEEP"
+done
+echo "[chip-watch] gave up after $PROBES probes"
+exit 1

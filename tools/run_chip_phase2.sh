@@ -21,34 +21,40 @@ if ! timeout 240 python -c "import jax; assert jax.default_backend() == 'tpu'" \
 fi
 log "TPU live."
 
-log "1/6 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
+log "1/7 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
 timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
     >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
 tail -2 "$OUT/tpu_compiled.log" || true
 
-log "2/6 masked-vs-packed A/B + GQA train deltas..."
+log "2/7 masked-vs-packed A/B + GQA train deltas..."
 timeout 3000 python tools/bench_mask_ab.py \
     >"$OUT/mask_ab.json" 2>"$OUT/mask_ab.log" || log "mask A/B failed/partial"
 tail -1 "$OUT/mask_ab.json" || true
 
-log "3/6 long-context sweep (fixed per-step sync; retry 16k/32k)..."
+log "3/7 long-context sweep (fixed per-step sync; retry 16k/32k)..."
 timeout 3600 python tools/bench_longctx.py --seqs 4096,8192,16384,32768 \
     >"$OUT/longctx.json" 2>"$OUT/longctx.log" || log "longctx failed/partial"
 
-log "4/6 bench auto-sweep with room to climb (deadline 1500s)..."
+log "4/7 decode attribution (layers/vocab/sampler/bf16-cast ablations)..."
+timeout 2400 python tools/diag_decode.py --batches 1,8,32 --kv-heads 0,4 \
+    >"$OUT/diag_decode.json" 2>"$OUT/diag_decode.log" \
+    || log "decode diag failed/partial"
+
+log "5/7 bench auto-sweep with room to climb (deadline 1500s)..."
 # TPU_TIMEOUT must rise with DEADLINE_SEC: the parent watchdog kills the
 # child at TPU_TIMEOUT regardless of the child's sweep budget.
 timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=1600 \
-    python bench.py \
+    LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
     >"$OUT/bench_sweep.json" 2>"$OUT/bench_sweep.log" || log "bench sweep failed"
 tail -1 "$OUT/bench_sweep.json" || true
 
-log "5/6 chunked-CE batch-128 cell (the HBM-freed retune)..."
-timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked python bench.py \
+log "6/7 chunked-CE batch-128 cell (the HBM-freed retune)..."
+timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked \
+    LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
     >"$OUT/bench_c128.json" 2>"$OUT/bench_c128.log" || log "c128 cell failed"
 tail -1 "$OUT/bench_c128.json" || true
 
-log "6/6 BPE headline train (tokenizer already at runs/pytok8k.json)..."
+log "7/7 BPE headline train (tokenizer already at runs/pytok8k.json)..."
 if [ -f runs/pytok8k.json ]; then
     timeout 5400 python -m llmtrain_tpu train \
         --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
