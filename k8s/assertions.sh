@@ -10,8 +10,14 @@
 
 FAILURES=${FAILURES:-0}
 
-pass() { printf '  PASS: %s\n' "$*"; }
-fail() { printf '  FAIL: %s\n' "$*" >&2; FAILURES=$((FAILURES + 1)); }
+# Defaults only: a caller that defines pass/fail BEFORE sourcing keeps
+# its own hooks (e.g. CI annotation emitters).
+if ! declare -f pass >/dev/null; then
+    pass() { printf '  PASS: %s\n' "$*"; }
+fi
+if ! declare -f fail >/dev/null; then
+    fail() { printf '  FAIL: %s\n' "$*" >&2; FAILURES=$((FAILURES + 1)); }
+fi
 
 # rank-0 pod logs must show the training summary and the entrypoint's
 # exec handoff (k8s/entrypoint.sh prints it before exec'ing python).

@@ -20,7 +20,14 @@ for i in $(seq 1 "$PROBES"); do
         >/dev/null 2>&1; then
         echo "[chip-watch] tunnel live at $(date -u +%H:%M:%S); running: $CMD"
         eval "$CMD"
-        exit $?
+        rc=$?
+        # rc=1 is the runbook's own probe failing — the tunnel flapped
+        # between our probe and its re-probe. Keep watching; any other
+        # exit means the run actually fired, so stand down.
+        if [ "$rc" -ne 1 ]; then
+            exit "$rc"
+        fi
+        echo "[chip-watch] command probe-failed (tunnel flap); resuming watch"
     fi
     echo "[chip-watch] probe $i/$PROBES failed at $(date -u +%H:%M:%S); sleeping ${SLEEP}s"
     sleep "$SLEEP"
