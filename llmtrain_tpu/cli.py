@@ -122,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="stop early on this token (default: the tokenizer's EOS, if any)",
     )
     gen.add_argument("--seed", type=int, default=1234)
+    gen.add_argument(
+        "--decode-param-dtype",
+        choices=("compute", "param"),
+        default="compute",
+        help="'compute' (default) casts floating checkpoint params to the "
+        "model compute dtype before decoding — a bf16-compute model then "
+        "streams half the weight bytes per token (decode is weight-bandwidth "
+        "bound; tools/diag_decode.py attribution); 'param' keeps the "
+        "checkpoint's master precision",
+    )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
     evalp = sub.add_parser(
@@ -709,6 +719,26 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 "pipeline checkpoint converted to the gpt tree for KV-cache "
                 "decoding"
             )
+
+        if args.decode_param_dtype == "compute":
+            import jax.numpy as jnp
+
+            # Models without a dtype/param_dtype split (e.g. dummy_gpt)
+            # have nothing to cast.
+            if getattr(model, "dtype", None) is not None and (
+                model.dtype != getattr(model, "param_dtype", model.dtype)
+            ):
+                params = jax.tree.map(
+                    lambda a: a.astype(model.dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                    else a,
+                    params,
+                )
+                logger.info(
+                    "cast floating params to %s for decode (--decode-param-dtype "
+                    "param keeps the checkpoint's master precision)",
+                    jnp.dtype(model.dtype).name,
+                )
 
         eos_token_id = args.eos_token_id
         if eos_token_id is None and tokenizer is not None:

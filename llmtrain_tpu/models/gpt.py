@@ -80,6 +80,15 @@ class CausalSelfAttention(nn.Module):
     # Data is guaranteed packed (all-ones masks): drop the mask operand
     # from the flash kernels — identical math, no mask streaming.
     assume_packed: bool = False
+    # Llama-family knobs (models/llama.py): bias-free projections and
+    # rotary position embeddings (ops/rope.py). RoPE rotates q/k after
+    # projection — at decode time inside ``_decode_attention`` so the
+    # rotation uses absolute positions from the cache cursor BEFORE the
+    # keys are written (cached keys are stored rotated; queries at later
+    # steps then compare directly). GPT defaults leave both off.
+    use_bias: bool = True
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -96,6 +105,7 @@ class CausalSelfAttention(nn.Module):
             qkv = nn.DenseGeneral(
                 features=(3, self.n_heads, head_dim),
                 axis=-1,
+                use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
@@ -114,6 +124,7 @@ class CausalSelfAttention(nn.Module):
             q = nn.DenseGeneral(
                 features=(self.n_heads, head_dim),
                 axis=-1,
+                use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "heads", "kv")),
@@ -125,6 +136,7 @@ class CausalSelfAttention(nn.Module):
             kv = nn.DenseGeneral(
                 features=(2, kv_heads, head_dim),
                 axis=-1,
+                use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
@@ -137,6 +149,19 @@ class CausalSelfAttention(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "length", "act_heads", "act_kv"))
         k = nn.with_logical_constraint(k, ("batch", "length", "act_heads", "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "length", "act_heads", "act_kv"))
+
+        if self.rope and not self.decode:
+            # Global-view positions: under sequence parallelism pjit keeps
+            # the arange consistent with the length-sharded activations.
+            # Rotating before the GQA broadcast/attention impls is exact —
+            # RoPE is per-(position, feature), independent of head layout.
+            # The decode path rotates inside _decode_attention, offset by
+            # the cache cursor.
+            from ..ops.rope import apply_rope
+
+            q, k = apply_rope(
+                q, k, jnp.arange(q.shape[1]), theta=self.rope_theta
+            )
 
         if (
             not self.decode
@@ -211,6 +236,7 @@ class CausalSelfAttention(nn.Module):
         out = nn.DenseGeneral(
             features=self.d_model,
             axis=(-2, -1),
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=nn.with_logical_partitioning(
@@ -257,6 +283,15 @@ class CausalSelfAttention(nn.Module):
         )
 
         idx = cache_index.value
+        if self.rope:
+            # Rotate by absolute position BEFORE the cache write: the
+            # cache then holds rotated keys, and later steps' queries
+            # (rotated by their own positions) compare directly.
+            from ..ops.rope import apply_rope
+
+            q, k = apply_rope(
+                q, k, idx + jnp.arange(t), theta=self.rope_theta
+            )
         cached_key.value = jax.lax.dynamic_update_slice(
             cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
         )

@@ -1,0 +1,332 @@
+"""Llama-family decoder (RMSNorm + RoPE + SwiGLU + untied head), mesh-first.
+
+Beyond-reference model family (the reference ships GPT only,
+``src/llmtrain/models/gpt.py``; SURVEY §2.1): the architecture used by
+Llama/Mistral-class checkpoints —
+
+* **RMSNorm** instead of LayerNorm: no mean subtraction, no bias; f32
+  statistics for bf16 safety (same discipline as gpt_pipeline's
+  ``_layernorm``).
+* **Rotary position embeddings** (ops/rope.py) instead of learned
+  position embeddings — applied to q/k inside attention, so the KV cache
+  stores rotated keys and long-context scaling is a ``rope_theta`` knob,
+  not a parameter-table resize.
+* **SwiGLU MLP**: ``down(silu(gate(x)) * up(x))``, all bias-free.
+* **Untied lm_head** by default (``model.tie_embeddings: false`` is the
+  Llama convention; the flag still works both ways).
+
+Everything else — GQA narrow K/V, flash/ring/ulysses attention routing,
+KV-cache decode, chunked CE, remat policies, logical-axis sharding — is
+the shared machinery in ``models/gpt.py``/``ops/``: attention reuses
+``CausalSelfAttention`` (with ``use_bias=False, rope=True``), so there is
+exactly one KV-cache and one kernel-dispatch implementation in the
+package. Numerics are parity-tested against HF ``transformers``' torch
+Llama in tests/test_llama.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .gpt import (
+    _DENSE_INIT,
+    _EMBED_INIT,
+    REMAT_POLICIES,
+    CausalSelfAttention,
+    GPTAdapter,
+    _scaled_init,
+)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm, f32 statistics, scale-only (no bias)."""
+
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+class LlamaBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    n_layers: int
+    dropout: float
+    dtype: Any
+    param_dtype: Any
+    attention: str = "dense"
+    decode: bool = False
+    cache_len: int = 0
+    n_kv_heads: int = 0
+    assume_packed: bool = False
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        attention_mask: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        norm_kw = dict(
+            eps=self.rms_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        h = RMSNorm(name="attn_norm", **norm_kw)(x)
+        x = x + CausalSelfAttention(
+            d_model=self.d_model,
+            n_heads=self.n_heads,
+            n_layers=self.n_layers,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            attention=self.attention,
+            decode=self.decode,
+            cache_len=self.cache_len,
+            n_kv_heads=self.n_kv_heads,
+            assume_packed=self.assume_packed,
+            use_bias=False,
+            rope=True,
+            rope_theta=self.rope_theta,
+            name="attn",
+        )(h, attention_mask, deterministic=deterministic)
+
+        h = RMSNorm(name="mlp_norm", **norm_kw)(x)
+        dense_kw = dict(
+            use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        gate = nn.Dense(
+            self.d_ff,
+            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
+            name="mlp_gate",
+            **dense_kw,
+        )(h)
+        up = nn.Dense(
+            self.d_ff,
+            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
+            name="mlp_up",
+            **dense_kw,
+        )(h)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
+        h = nn.Dense(
+            self.d_model,
+            kernel_init=nn.with_logical_partitioning(
+                _scaled_init(self.n_layers), ("mlp", "embed")
+            ),
+            name="mlp_down",
+            **dense_kw,
+        )(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
+
+class Llama(nn.Module):
+    """Llama-family decoder-only language model."""
+
+    vocab_size: int
+    block_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    dropout: float
+    tie_embeddings: bool = False  # Llama convention: untied head
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_policy: str = "nothing"
+    attention: str = "dense"
+    decode: bool = False
+    decode_cache_len: int = 0
+    loss_impl: str = "dense"
+    ce_chunk: int = 8192
+    z_loss: float = 0.0
+    n_kv_heads: int = 0
+    assume_packed: bool = False
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+
+    def for_decoding(self, cache_len: int | None = None) -> "Llama":
+        """Clone configured for cached autoregressive decoding (same
+        contract as GPT.for_decoding — generation.py dispatches on it)."""
+        if cache_len is None:
+            cache_len = self.block_size
+        return self.clone(
+            decode=True, remat=False, decode_cache_len=min(cache_len, self.block_size)
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        *,
+        deterministic: bool = True,
+        return_hidden: bool = False,
+    ) -> jax.Array:
+        _, seqlen = input_ids.shape
+        if seqlen > self.block_size:
+            raise ValueError(
+                f"Input sequence length {seqlen} exceeds block size {self.block_size}."
+            )
+
+        token_embedding = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            embedding_init=nn.with_logical_partitioning(_EMBED_INIT, ("vocab", "embed")),
+            name="token_embedding",
+        )
+        # No position embedding: RoPE rotates q/k inside attention, and at
+        # decode time the cache cursor supplies absolute positions — the
+        # model-level position_index variable GPT keeps (gpt.py:506-514)
+        # has no Llama analogue.
+        x = token_embedding(input_ids)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
+        block_cls = LlamaBlock
+        if self.remat:
+            if self.remat_policy not in REMAT_POLICIES:
+                raise ValueError(
+                    f"remat_policy {self.remat_policy!r} unknown; expected "
+                    f"one of {sorted(REMAT_POLICIES)}"
+                )
+            block_cls = nn.remat(
+                LlamaBlock,
+                static_argnums=(3,),
+                policy=REMAT_POLICIES[self.remat_policy],
+            )
+
+        for layer in range(self.n_layers):
+            x = block_cls(
+                d_model=self.d_model,
+                n_heads=self.n_heads,
+                d_ff=self.d_ff,
+                n_layers=self.n_layers,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                attention=self.attention,
+                decode=self.decode,
+                cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
+                n_kv_heads=self.n_kv_heads,
+                assume_packed=self.assume_packed,
+                rope_theta=self.rope_theta,
+                rms_norm_eps=self.rms_norm_eps,
+                name=f"block_{layer}",
+            )(x, attention_mask, deterministic)
+
+        x = RMSNorm(
+            name="norm_f",
+            eps=self.rms_norm_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+
+        if return_hidden:
+            # Chunked-CE path: the loss contracts hidden states against the
+            # vocab matrix (ops/chunked_ce.py via GPTAdapter.vocab_matrix —
+            # param names match, so the adapter machinery is inherited).
+            return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
+
+        if self.tie_embeddings:
+            logits = token_embedding.attend(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size,
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "vocab")),
+                name="lm_head",
+            )(x)
+        return nn.with_logical_constraint(logits, ("batch", "length", "act_vocab"))
+
+
+@register_model("llama")
+class LlamaAdapter(GPTAdapter):
+    """Adapter for the Llama family.
+
+    Inherits the GPT adapter's loss machinery wholesale — chunked CE,
+    z-loss, vocab-matrix access, mesh validation — because the Llama
+    module keeps the same top-level param names (``token_embedding``,
+    ``lm_head``) and loss-relevant attributes.
+    """
+
+    known_extra_keys = GPTAdapter.known_extra_keys | frozenset(
+        {"rope_theta", "rms_norm_eps"}
+    )
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        base = super().build_model(cfg)  # runs all shared validation
+        rope_theta = float(cfg.model.extra.get("rope_theta", 10000.0))
+        if rope_theta <= 0:
+            raise ValueError(f"model.extra.rope_theta must be > 0, got {rope_theta}")
+        rms_norm_eps = float(cfg.model.extra.get("rms_norm_eps", 1e-6))
+        if rms_norm_eps <= 0:
+            raise ValueError(
+                f"model.extra.rms_norm_eps must be > 0, got {rms_norm_eps}"
+            )
+        if (cfg.model.d_model // cfg.model.n_heads) % 2 != 0:
+            raise ValueError(
+                "RoPE needs an even head dim: d_model/n_heads = "
+                f"{cfg.model.d_model // cfg.model.n_heads}"
+            )
+        # The schema default (tie_embeddings: true, GPT convention —
+        # config/schemas.py) is wrong for this family: a config that does
+        # not mention the flag gets the Llama convention (untied head);
+        # an explicit value wins either way.
+        tie = (
+            cfg.model.tie_embeddings
+            if "tie_embeddings" in cfg.model.model_fields_set
+            else False
+        )
+        return Llama(
+            vocab_size=base.vocab_size,
+            block_size=base.block_size,
+            d_model=base.d_model,
+            n_layers=base.n_layers,
+            n_heads=base.n_heads,
+            d_ff=base.d_ff,
+            dropout=base.dropout,
+            tie_embeddings=tie,
+            dtype=base.dtype,
+            param_dtype=base.param_dtype,
+            remat=base.remat,
+            remat_policy=base.remat_policy,
+            attention=base.attention,
+            loss_impl=base.loss_impl,
+            ce_chunk=base.ce_chunk,
+            z_loss=base.z_loss,
+            n_kv_heads=base.n_kv_heads,
+            assume_packed=base.assume_packed,
+            rope_theta=rope_theta,
+            rms_norm_eps=rms_norm_eps,
+        )
+
+
+__all__ = ["Llama", "LlamaBlock", "RMSNorm", "LlamaAdapter"]

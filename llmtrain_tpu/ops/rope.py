@@ -1,0 +1,72 @@
+"""Rotary position embeddings (RoPE), half-split ("rotate_half") layout.
+
+Llama-family models encode position by rotating query/key pairs instead
+of adding learned position embeddings (GPT, models/gpt.py:497-515). The
+layout here is the HF-transformers/Llama convention — feature dim split
+into two halves, NOT interleaved even/odd pairs — so parameters ported
+from (or parity-tested against) ``transformers`` Llama checkpoints match
+bit-for-bit (tests/test_llama.py).
+
+TPU notes: angles are computed in f32 (bf16 loses position resolution
+past ~256 positions) and the rotation is two fused elementwise multiplies
+— XLA folds it into the surrounding projection, so RoPE adds no HBM
+round-trip. Everything is shape-static under jit; the ``positions``
+operand may be a traced value (decode offsets the cache cursor).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jax.Array, head_dim: int, *, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables, each ``positions.shape + (head_dim,)`` in f32.
+
+    ``positions``: integer array of absolute token positions (any shape;
+    typically (T,) at train time, (t,) offset by the cache cursor at
+    decode time).
+    """
+    if head_dim % 2 != 0:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**exponent)  # (head_dim/2,)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (..., d)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(
+    q: jax.Array,
+    k: jax.Array,
+    positions: jax.Array,
+    *,
+    theta: float = 10000.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Rotate q and k by their absolute positions.
+
+    q: (B, T, H, Dh); k: (B, T, Hkv, Dh) — K may be narrower (GQA); the
+    rotation is per-head-feature so both use the same tables.
+    ``positions``: (T,) absolute positions shared across the batch
+    (generation batches rectangular prompts, generation.py:111-120).
+    Rotation runs in f32 and casts back to the input dtype.
+    """
+    cos, sin = rope_angles(positions, q.shape[-1], theta=theta)
+    cos = cos[None, :, None, :]  # (1, T, 1, Dh)
+    sin = sin[None, :, None, :]
+
+    def rot(x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        return (xf * cos + _rotate_half(xf) * sin).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+__all__ = ["apply_rope", "rope_angles"]

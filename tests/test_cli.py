@@ -252,6 +252,47 @@ class TestGenerate:
         assert a.returncode == 0 and b.returncode == 0, a.stderr + b.stderr
         assert json.loads(a.stdout)["completion_ids"] == json.loads(b.stdout)["completion_ids"]
 
+    def test_decode_param_dtype_cast_and_optout(self, workdir):
+        """bf16-compute models decode from bf16 weights by default (half the
+        weight bandwidth, tools/diag_decode.py attribution); --decode-param-
+        dtype param keeps the checkpoint's f32 master params."""
+        cfg = {
+            **CFG,
+            "model": {
+                "name": "gpt",
+                "block_size": 8,
+                "d_model": 32,
+                "n_layers": 1,
+                "n_heads": 2,
+                "d_ff": 64,
+                "dropout": 0.0,
+                "vocab_size": 64,
+                "dtype": "bfloat16",
+                "param_dtype": "float32",
+                "extra": {"tokenizer": "byte"},
+            },
+        }
+        (workdir / "bf16.yaml").write_text(yaml.safe_dump(cfg))
+        first = _run(
+            ["train", "--config", "bf16.yaml", "--json", "--run-id", "runDD"],
+            workdir,
+        )
+        assert first.returncode == 0, first.stderr
+        base = [
+            "generate", "--config", "bf16.yaml", "--from", "runDD",
+            "--prompt-ids", "1,2", "--max-new-tokens", "3",
+            "--temperature", "0", "--json",
+        ]
+        cast = _run(base, workdir)
+        assert cast.returncode == 0, cast.stderr
+        assert "cast floating params to bfloat16" in cast.stderr
+        kept = _run([*base, "--decode-param-dtype", "param"], workdir)
+        assert kept.returncode == 0, kept.stderr
+        assert "cast floating params" not in kept.stderr
+        # Both modes produce a full-length completion from the same ckpt.
+        for proc in (cast, kept):
+            assert len(json.loads(proc.stdout)["completion_ids"]) == 3
+
     def test_generate_eos_token_stops_early(self, workdir):
         """--eos-token-id is wired through to generate(): once the EOS token
         is produced, the rest of the completion is EOS-filled (ADVICE r1)."""
