@@ -171,7 +171,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     export = sub.add_parser(
         "export-checkpoint",
-        help="export a checkpoint's GPT weights as a torch state dict",
+        help="export checkpoint weights as a torch state dict (gpt → "
+        "reference GPT names, llama → HF LlamaForCausalLM names)",
     )
     export.add_argument("--config", required=True, help="path to the YAML run config")
     export.add_argument(
@@ -185,7 +186,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     imp = sub.add_parser(
         "import-checkpoint",
-        help="build a resumable checkpoint from a torch state dict",
+        help="build a resumable checkpoint from a torch state dict "
+        "(gpt ← reference GPT names, llama ← HF LlamaForCausalLM names)",
     )
     imp.add_argument("--config", required=True, help="path to the YAML run config")
     imp.add_argument("--input", required=True, help="torch .pt state-dict path")
@@ -313,7 +315,9 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
         import torch
 
         from .interop import (
+            is_llama_tree,
             is_pipeline_tree,
+            llama_params_to_hf_state_dict,
             params_to_torch_state_dict,
             pipeline_params_to_gpt,
         )
@@ -330,7 +334,15 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
             # first (interop/pipeline_convert.py) — same math, so the
             # export is still reference-exact.
             params = pipeline_params_to_gpt(params)
-        sd = {k: torch.from_numpy(v) for k, v in params_to_torch_state_dict(params).items()}
+        # Each family exports in its ecosystem's lingua franca: llama →
+        # HF LlamaForCausalLM names (interop/llama_hf.py), gpt → the
+        # reference torch GPT names (interop/torch_interop.py).
+        convert = (
+            llama_params_to_hf_state_dict
+            if is_llama_tree(params)
+            else params_to_torch_state_dict
+        )
+        sd = {k: torch.from_numpy(v) for k, v in convert(params).items()}
         out = Path(args.output)
         out.parent.mkdir(parents=True, exist_ok=True)
         torch.save(sd, out)
@@ -377,7 +389,9 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
 
         from .interop import (
             gpt_params_to_pipeline,
+            is_llama_tree,
             is_pipeline_tree,
+            llama_params_from_hf_state_dict,
             params_from_torch_state_dict,
             pipeline_params_to_gpt,
         )
@@ -415,6 +429,10 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
             params = gpt_params_to_pipeline(
                 params_from_torch_state_dict(sd, gpt_template)
             )
+        elif is_llama_tree(template):
+            # llama config: the input is an HF LlamaForCausalLM state
+            # dict (interop/llama_hf.py).
+            params = llama_params_from_hf_state_dict(sd, template)
         else:
             params = params_from_torch_state_dict(sd, template)
 

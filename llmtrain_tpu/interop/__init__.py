@@ -1,6 +1,11 @@
-"""Cross-framework interop (torch checkpoint export/import) and
-pipeline↔gpt parameter-tree conversion."""
+"""Cross-framework interop (torch / HF-Llama checkpoint export/import)
+and pipeline↔gpt parameter-tree conversion."""
 
+from .llama_hf import (
+    is_llama_tree,
+    llama_params_from_hf_state_dict,
+    llama_params_to_hf_state_dict,
+)
 from .pipeline_convert import (
     gpt_params_to_pipeline,
     is_pipeline_tree,
@@ -17,4 +22,7 @@ __all__ = [
     "pipeline_params_to_gpt",
     "gpt_params_to_pipeline",
     "is_pipeline_tree",
+    "is_llama_tree",
+    "llama_params_to_hf_state_dict",
+    "llama_params_from_hf_state_dict",
 ]

@@ -1,0 +1,269 @@
+"""Llama parameter conversion to/from the HF-transformers state dict.
+
+The Llama family's checkpoint lingua franca is HF ``LlamaForCausalLM``
+(the analogue of the reference torch GPT format that
+``interop/torch_interop.py`` speaks for the GPT family). The exported
+dict uses HF's module names, so
+
+    LlamaForCausalLM(config).load_state_dict(torch.load(path))
+
+works strict=True; import accepts the same naming, so weights from any
+HF Llama/Mistral-class checkpoint load into ``models/llama.py``.
+
+    model.embed_tokens.weight
+    model.layers.{i}.input_layernorm.weight
+    model.layers.{i}.self_attn.{q,k,v,o}_proj.weight
+    model.layers.{i}.post_attention_layernorm.weight
+    model.layers.{i}.mlp.{gate,up,down}_proj.weight
+    model.norm.weight
+    lm_head.weight            (tied models: the shared tensor; HF
+                               safetensors may omit it — tolerated on
+                               import into a tied template)
+
+Layout transforms are the ones proven numerically in
+tests/test_llama.py's HF parity tests (logits atol 2e-4 against torch
+LlamaForCausalLM on full forward AND cache prefill): flax kernels are
+(in, out) vs torch Linear (out, in); head-major DenseGeneral kernels
+(D, H, dh) flatten C-order to torch's (H·dh, D) rows. Both the fused-MHA
+tree (``qkv_proj``, n_kv_heads == n_heads) and the split GQA tree
+(``q_proj``/``kv_proj``) are handled — HF always stores q/k/v separately.
+
+Conversion is pure numpy; torch is only needed by callers that
+``torch.save``/``torch.load`` the result. Float tensors export as f32.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+Params = Any  # nested dict pytree of arrays
+
+# Older transformers versions persisted per-layer rotary inv_freq buffers;
+# they are deterministic functions of (head_dim, rope_theta) — ignored.
+_ROTARY_BUFFER_RE = re.compile(r"(^|\.)rotary_emb\.inv_freq$")
+
+
+def is_llama_tree(params: Params) -> bool:
+    """True for a models/llama.py param tree (SwiGLU block markers)."""
+    blk = params.get("block_0") if hasattr(params, "get") else None
+    return blk is not None and "mlp_gate" in blk and "attn_norm" in blk
+
+
+def _np(a) -> np.ndarray:
+    return np.array(a, dtype=np.float32)
+
+
+def llama_params_to_hf_state_dict(params: Params) -> dict[str, np.ndarray]:
+    """Flax Llama params (models/llama.py tree) → HF Llama state dict."""
+    for required in ("token_embedding", "norm_f"):
+        if required not in params:
+            raise ValueError(
+                f"params have no {required!r}; only the models/llama.py "
+                "tree is supported (model.name 'llama')"
+            )
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["token_embedding"]["embedding"]),
+        "model.norm.weight": _np(params["norm_f"]["scale"]),
+    }
+    d = sd["model.embed_tokens.weight"].shape[1]
+    i = 0
+    while f"block_{i}" in params:
+        p = params[f"block_{i}"]
+        if "mlp_gate" not in p:
+            raise ValueError(
+                f"block_{i} has no mlp_gate; not a models/llama.py tree"
+            )
+        att = p["attn"]
+        pre = f"model.layers.{i}."
+        if "qkv_proj" in att:
+            # Fused MHA tree (n_kv_heads == n_heads): HF stores q/k/v
+            # separately, so split the (D, 3, H, dh) kernel.
+            kern = _np(att["qkv_proj"]["kernel"])
+            q, k, v = kern[:, 0], kern[:, 1], kern[:, 2]
+        else:
+            q = _np(att["q_proj"]["kernel"])
+            kv = _np(att["kv_proj"]["kernel"])
+            k, v = kv[:, 0], kv[:, 1]
+        sd[pre + "self_attn.q_proj.weight"] = q.reshape(d, -1).T
+        sd[pre + "self_attn.k_proj.weight"] = k.reshape(d, -1).T
+        sd[pre + "self_attn.v_proj.weight"] = v.reshape(d, -1).T
+        sd[pre + "self_attn.o_proj.weight"] = (
+            _np(att["out_proj"]["kernel"]).reshape(-1, d).T
+        )
+        sd[pre + "input_layernorm.weight"] = _np(p["attn_norm"]["scale"])
+        sd[pre + "post_attention_layernorm.weight"] = _np(p["mlp_norm"]["scale"])
+        sd[pre + "mlp.gate_proj.weight"] = _np(p["mlp_gate"]["kernel"]).T
+        sd[pre + "mlp.up_proj.weight"] = _np(p["mlp_up"]["kernel"]).T
+        sd[pre + "mlp.down_proj.weight"] = _np(p["mlp_down"]["kernel"]).T
+        i += 1
+    if i == 0:
+        raise ValueError("params contain no block_0; not a models/llama.py tree")
+    if "lm_head" in params:
+        sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    else:
+        # Tied model: HF materializes the shared tensor under
+        # lm_head.weight in .bin state dicts (tie_word_embeddings=True).
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd
+
+
+def llama_params_from_hf_state_dict(sd: dict[str, Any], template: Params) -> Params:
+    """HF Llama state dict → flax params shaped like ``template``.
+
+    ``template`` (a fresh ``adapter.init_params`` tree) supplies
+    structure, dtypes, and shapes; missing/mismatched/unconsumed keys
+    raise (silently dropping weights would "import" a different model).
+    Rotary inv_freq buffers are ignored; a tied template tolerates a
+    missing ``lm_head.weight`` (HF safetensors drops shared tensors) and
+    rejects one that differs from the embedding.
+    """
+    import jax.numpy as jnp
+
+    consumed: set[str] = set()
+
+    def put(key: str, like, transform=lambda a: a) -> Any:
+        if key not in sd:
+            raise ValueError(f"state dict is missing {key!r}")
+        consumed.add(key)
+        a = transform(np.asarray(sd[key], dtype=np.float32))
+        want = tuple(np.shape(like))
+        if tuple(a.shape) != want:
+            raise ValueError(
+                f"{key!r}: converted shape {tuple(a.shape)} != expected {want}"
+            )
+        return jnp.asarray(a, dtype=like.dtype)
+
+    def take_proj(key: str, shape: tuple) -> np.ndarray:
+        """Torch (out, in) Linear weight → transposed + head-major reshape."""
+        if key not in sd:
+            raise ValueError(f"state dict is missing {key!r}")
+        consumed.add(key)
+        a = np.asarray(sd[key], dtype=np.float32).T
+        if a.size != int(np.prod(shape)):
+            raise ValueError(
+                f"{key!r}: shape {a.shape} cannot reshape to {shape}"
+            )
+        return a.reshape(shape)
+
+    d = np.shape(template["token_embedding"]["embedding"])[1]
+    out: dict[str, Any] = {
+        "token_embedding": {
+            "embedding": put(
+                "model.embed_tokens.weight",
+                template["token_embedding"]["embedding"],
+            )
+        },
+        "norm_f": {"scale": put("model.norm.weight", template["norm_f"]["scale"])},
+    }
+    i = 0
+    while f"block_{i}" in template:
+        t = template[f"block_{i}"]
+        att_t = t["attn"]
+        pre = f"model.layers.{i}."
+        if "qkv_proj" in att_t:
+            like = att_t["qkv_proj"]["kernel"]
+            h, hd = np.shape(like)[2:4]
+            qkv = np.stack(
+                [
+                    take_proj(pre + f"self_attn.{n}_proj.weight", (d, h, hd))
+                    for n in ("q", "k", "v")
+                ],
+                axis=1,
+            )
+            attn = {"qkv_proj": {"kernel": jnp.asarray(qkv, dtype=like.dtype)}}
+        else:
+            h, hd = np.shape(att_t["q_proj"]["kernel"])[1:3]
+            like = att_t["kv_proj"]["kernel"]
+            hkv = np.shape(like)[2]
+            kv = np.stack(
+                [
+                    take_proj(pre + f"self_attn.{n}_proj.weight", (d, hkv, hd))
+                    for n in ("k", "v")
+                ],
+                axis=1,
+            )
+            attn = {
+                "q_proj": {
+                    "kernel": put(
+                        pre + "self_attn.q_proj.weight",
+                        att_t["q_proj"]["kernel"],
+                        lambda a: a.T.reshape(d, h, hd),
+                    )
+                },
+                "kv_proj": {"kernel": jnp.asarray(kv, dtype=like.dtype)},
+            }
+        attn["out_proj"] = {
+            "kernel": put(
+                pre + "self_attn.o_proj.weight",
+                att_t["out_proj"]["kernel"],
+                lambda a: a.T.reshape(-1, np.shape(att_t["out_proj"]["kernel"])[1], d),
+            )
+        }
+        out[f"block_{i}"] = {
+            "attn_norm": {
+                "scale": put(pre + "input_layernorm.weight", t["attn_norm"]["scale"])
+            },
+            "mlp_norm": {
+                "scale": put(
+                    pre + "post_attention_layernorm.weight", t["mlp_norm"]["scale"]
+                )
+            },
+            "attn": attn,
+            "mlp_gate": {
+                "kernel": put(
+                    pre + "mlp.gate_proj.weight", t["mlp_gate"]["kernel"],
+                    lambda a: a.T,
+                )
+            },
+            "mlp_up": {
+                "kernel": put(
+                    pre + "mlp.up_proj.weight", t["mlp_up"]["kernel"], lambda a: a.T
+                )
+            },
+            "mlp_down": {
+                "kernel": put(
+                    pre + "mlp.down_proj.weight", t["mlp_down"]["kernel"],
+                    lambda a: a.T,
+                )
+            },
+        }
+        i += 1
+    if "lm_head" in template:
+        out["lm_head"] = {
+            "kernel": put("lm_head.weight", template["lm_head"]["kernel"], lambda a: a.T)
+        }
+    elif "lm_head.weight" in sd:
+        head = np.asarray(sd["lm_head.weight"], dtype=np.float32)
+        tok = np.asarray(sd["model.embed_tokens.weight"], dtype=np.float32)
+        if head.shape != tok.shape or not np.array_equal(head, tok):
+            raise ValueError(
+                "state dict's lm_head.weight differs from "
+                "model.embed_tokens.weight: the source model was untied, "
+                "but the target config has model.tie_embeddings=true"
+            )
+        consumed.add("lm_head.weight")
+    consumed.update(k for k in sd if _ROTARY_BUFFER_RE.search(k))
+    extra = set(template) - set(out)
+    if extra:
+        raise ValueError(
+            f"template has params the converter does not map: {sorted(extra)} "
+            "(only the models/llama.py tree is supported)"
+        )
+    unconsumed = set(sd) - consumed
+    if unconsumed:
+        raise ValueError(
+            f"state dict has weights the template cannot hold: "
+            f"{sorted(unconsumed)[:8]}{'...' if len(unconsumed) > 8 else ''} "
+            "(layer count / head count / weight tying mismatch?)"
+        )
+    return out
+
+
+__all__ = [
+    "is_llama_tree",
+    "llama_params_to_hf_state_dict",
+    "llama_params_from_hf_state_dict",
+]

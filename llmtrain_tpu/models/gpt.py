@@ -89,6 +89,11 @@ class CausalSelfAttention(nn.Module):
     use_bias: bool = True
     rope: bool = False
     rope_theta: float = 10000.0
+    # Sliding-window attention (Mistral semantics: query i attends keys in
+    # (i-window, i]). 0 = full causal. Supported on the dense/flash/decode
+    # paths; ring/ulysses reject it loudly (a windowed ring schedule is a
+    # different algorithm — most hops would carry dead shards).
+    sliding_window: int = 0
 
     @nn.compact
     def __call__(
@@ -100,6 +105,11 @@ class CausalSelfAttention(nn.Module):
     ) -> jax.Array:
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
+        if self.sliding_window and self.attention in ("ring", "ulysses"):
+            raise ValueError(
+                f"sliding_window is not supported with attention="
+                f"{self.attention!r}; use 'flash' or 'dense'"
+            )
 
         if kv_heads == self.n_heads:
             qkv = nn.DenseGeneral(
@@ -196,6 +206,7 @@ class CausalSelfAttention(nn.Module):
                 q, k, v,
                 attention_mask=None if self.assume_packed else attention_mask,
                 causal=True,
+                window=self.sliding_window,
             )
         elif self.attention == "ring":
             # Sequence-parallel exact attention over the mesh's `sequence`
@@ -231,6 +242,7 @@ class CausalSelfAttention(nn.Module):
                 dropout=self.dropout,
                 deterministic=deterministic,
                 dropout_rng_module=self,
+                window=self.sliding_window,
             )
 
         out = nn.DenseGeneral(
@@ -312,10 +324,16 @@ class CausalSelfAttention(nn.Module):
         qg = q.reshape(batch, t, kv_width, g, head_dim)
         scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys) * scale
         scores = scores.astype(jnp.float32)
-        # Query at absolute position idx+i may see cache slots <= idx+i.
+        # Query at absolute position idx+i may see cache slots <= idx+i
+        # (and, under a sliding window, slots > idx+i - window). The cache
+        # stays full-length — windowed decode bounds the attention read,
+        # not the cache memory (a ring-buffer cache is a future win).
         col = jnp.arange(self.cache_len)[None, None, None, None, :]
         row = (idx + jnp.arange(t))[None, None, None, :, None]
-        scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
+        live = col <= row
+        if self.sliding_window:
+            live = live & (row - col < self.sliding_window)
+        scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)
         return out.reshape(batch, t, n_heads, head_dim)
@@ -330,10 +348,14 @@ def dense_attention(
     dropout: float = 0.0,
     deterministic: bool = True,
     dropout_rng_module: nn.Module | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Full-matrix causal attention; softmax in f32, matmuls on MXU dtype.
 
-    q/k/v: (B, T, H, Dh). Returns (B, T, H, Dh).
+    q/k/v: (B, T, H, Dh). Returns (B, T, H, Dh). ``window`` > 0 restricts
+    each query to its trailing ``window`` keys (Mistral sliding-window
+    semantics) — the full-matrix reference for the flash kernels' skip-
+    block implementation.
     """
     head_dim = q.shape[-1]
     seqlen = q.shape[1]
@@ -344,6 +366,9 @@ def dense_attention(
 
     big_neg = jnp.finfo(jnp.float32).min
     causal = jnp.tril(jnp.ones((seqlen, seqlen), dtype=jnp.bool_))
+    if window:
+        pos = jnp.arange(seqlen)
+        causal = causal & (pos[:, None] - pos[None, :] < window)
     scores = jnp.where(causal[None, None, :, :], scores, big_neg)
     if attention_mask is not None:
         key_mask = attention_mask.astype(jnp.bool_)[:, None, None, :]  # (B,1,1,T)
@@ -372,6 +397,7 @@ class TransformerBlock(nn.Module):
     cache_len: int = 0
     n_kv_heads: int = 0  # grouped-query attention (see CausalSelfAttention)
     assume_packed: bool = False  # drop the flash mask operand (packed data)
+    sliding_window: int = 0  # Mistral-style window; 0 = full causal
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -404,6 +430,7 @@ class TransformerBlock(nn.Module):
             cache_len=self.cache_len,
             n_kv_heads=self.n_kv_heads,
             assume_packed=self.assume_packed,
+            sliding_window=self.sliding_window,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -490,6 +517,10 @@ class GPT(nn.Module):
     # Data is guaranteed packed (all-ones masks): skip the in-attention
     # mask on the flash path (model.extra.assume_packed).
     assume_packed: bool = False
+    # Sliding-window attention (model.extra.sliding_window): each query
+    # attends its trailing W keys — O(T·W) attention compute on the flash
+    # path. 0 = full causal.
+    sliding_window: int = 0
 
     def for_decoding(self, cache_len: int | None = None) -> "GPT":
         """Clone configured for cached autoregressive decoding.
@@ -582,6 +613,7 @@ class GPT(nn.Module):
                 cache_len=(self.decode_cache_len or self.block_size) if self.decode else 0,
                 n_kv_heads=self.n_kv_heads,
                 assume_packed=self.assume_packed,
+                sliding_window=self.sliding_window,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
@@ -625,7 +657,7 @@ class GPTAdapter(ModelAdapter):
 
     known_extra_keys = frozenset(
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
-         "assume_packed", "remat_policy"}
+         "assume_packed", "remat_policy", "sliding_window"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -668,6 +700,16 @@ class GPTAdapter(ModelAdapter):
                 "attention-probability dropout; set model.dropout to 0.0 or "
                 "use attention='dense'"
             )
+        sliding_window = int(cfg.model.extra.get("sliding_window", 0))
+        if sliding_window < 0:
+            raise ValueError(
+                f"model.extra.sliding_window must be >= 0, got {sliding_window}"
+            )
+        if sliding_window and cfg.model.attention in ("ring", "ulysses"):
+            raise ValueError(
+                "model.extra.sliding_window is not supported with "
+                f"attention={cfg.model.attention!r}; use 'flash' or 'dense'"
+            )
         return GPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -687,6 +729,7 @@ class GPTAdapter(ModelAdapter):
             n_kv_heads=n_kv_heads,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             remat_policy=remat_policy,
+            sliding_window=sliding_window,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

@@ -53,9 +53,14 @@ class RMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        # Logical axis "norm" maps to None (parallel/sharding.py): a (D,)
+        # scale gains nothing from fsdp sharding, and mapping it to
+        # "embed"→fsdp makes XLA reshard the residual-stream grads
+        # embed-wise for the dscale reduction — an involuntary-full-
+        # rematerialization path on fsdp×tensor meshes.
         scale = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
             (x.shape[-1],),
             self.param_dtype,
         )
@@ -81,6 +86,7 @@ class LlamaBlock(nn.Module):
     assume_packed: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    sliding_window: int = 0  # Mistral-style window; 0 = full causal
 
     @nn.compact
     def __call__(
@@ -92,7 +98,12 @@ class LlamaBlock(nn.Module):
         norm_kw = dict(
             eps=self.rms_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype
         )
-        h = RMSNorm(name="attn_norm", **norm_kw)(x)
+        # Pin the norm outputs' sharding: without the constraint XLA's
+        # backward pass reshards the residual-stream grads through a
+        # full-rematerialization path on fsdp×tensor meshes (SPMD warning
+        # seen in dryrun_llama).
+        act = ("batch", "length", "act_embed")
+        h = nn.with_logical_constraint(RMSNorm(name="attn_norm", **norm_kw)(x), act)
         x = x + CausalSelfAttention(
             d_model=self.d_model,
             n_heads=self.n_heads,
@@ -108,10 +119,11 @@ class LlamaBlock(nn.Module):
             use_bias=False,
             rope=True,
             rope_theta=self.rope_theta,
+            sliding_window=self.sliding_window,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
-        h = RMSNorm(name="mlp_norm", **norm_kw)(x)
+        h = nn.with_logical_constraint(RMSNorm(name="mlp_norm", **norm_kw)(x), act)
         dense_kw = dict(
             use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
         )
@@ -167,6 +179,9 @@ class Llama(nn.Module):
     assume_packed: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    # Sliding-window attention (model.extra.sliding_window, the Mistral
+    # architecture knob): O(T·W) attention on the flash path.
+    sliding_window: int = 0
 
     def for_decoding(self, cache_len: int | None = None) -> "Llama":
         """Clone configured for cached autoregressive decoding (same
@@ -237,6 +252,7 @@ class Llama(nn.Module):
                 assume_packed=self.assume_packed,
                 rope_theta=self.rope_theta,
                 rms_norm_eps=self.rms_norm_eps,
+                sliding_window=self.sliding_window,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 
@@ -326,6 +342,7 @@ class LlamaAdapter(GPTAdapter):
             assume_packed=base.assume_packed,
             rope_theta=rope_theta,
             rms_norm_eps=rms_norm_eps,
+            sliding_window=base.sliding_window,
         )
 
 

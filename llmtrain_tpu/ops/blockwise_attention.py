@@ -31,6 +31,7 @@ def _chunk_scan(
     causal: bool,
     kv_chunk: int,
     key_mask: jax.Array | None = None,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Online-softmax accumulation of one q-chunk over all kv-chunks.
 
@@ -39,6 +40,9 @@ def _chunk_scan(
     positions of the first query/key, so the causal mask works on chunks
     of a larger sequence (ring attention passes nonzero kv_offset).
     ``key_mask`` is an optional (B, Tk) padding mask (nonzero = attend).
+    ``window`` > 0 adds sliding-window masking (Mistral semantics: query
+    i attends keys in (i-window, i]); mask-only here — the fallback path
+    keeps its simple full scan, the Pallas kernels skip dead blocks.
     Returns (acc, row_max, row_sum) with acc un-normalized: out = acc / row_sum.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -78,6 +82,8 @@ def _chunk_scan(
         if causal:
             k_pos = kv_offset + chunk_idx * kv_chunk + jnp.arange(kv_chunk)
             mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, kv_chunk)
+            if window:
+                mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
             s = jnp.where(mask[None, :, None, :], s, _NEG_INF)
         if m_c is not None:
             s = jnp.where(m_c[:, None, None, :], s, _NEG_INF)  # (B,1,1,chunk)
@@ -124,13 +130,20 @@ def blockwise_attention(
     q_offset: jax.Array | int = 0,
     kv_offset: jax.Array | int = 0,
     key_mask: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory.
 
     ``k``/``v`` may be grouped-query narrow (B, Tk, Hkv, D). ``key_mask``
     is an optional (B, Tk) padding mask (nonzero = attend), the
     reference's in-attention padding semantics (gpt.py:60-64).
+    ``window`` > 0 restricts each query to its trailing ``window`` keys
+    (requires ``causal``).
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     b, tq, h, d = q.shape
     q_chunk = min(q_chunk, tq)
     kv_chunk = min(kv_chunk, k.shape[1])
@@ -151,6 +164,7 @@ def blockwise_attention(
             causal=causal,
             kv_chunk=kv_chunk,
             key_mask=key_mask,
+            window=window,
         )
         return (acc / row_sum[..., None]).astype(q.dtype)
 

@@ -30,6 +30,7 @@ materialized at full width on any path here.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -59,45 +60,51 @@ def _pallas_bwd_enabled() -> bool:
     return os.environ.get("LLMTRAIN_FLASH_BWD", "pallas").lower() != "blockwise"
 
 
-def _blockwise(q, k, v, key_mask=None):
+def _blockwise(q, k, v, key_mask=None, window=0):
     # blockwise consumes grouped-query narrow K/V natively.
-    return blockwise_attention(q, k, v, causal=True, key_mask=key_mask)
+    return blockwise_attention(q, k, v, causal=True, key_mask=key_mask,
+                               window=window)
 
 
-@jax.custom_vjp
-def _flash(q, k, v):
+# ``window`` is a static Python int (0 = off) and travels as the leading
+# nondiff arg of both custom_vjps — Mistral-style sliding-window masking
+# with dead K/V blocks skipped in the Pallas kernels.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(window, q, k, v):
     if _use_pallas(q.shape[1]):
         from .pallas_attention import pallas_flash_attention
 
         block = _auto_block(q.shape[1])
         return pallas_flash_attention(
-            q, k, v, causal=True, block_q=block, block_k=block
+            q, k, v, causal=True, block_q=block, block_k=block, window=window
         )
-    return _blockwise(q, k, v)
+    return _blockwise(q, k, v, window=window)
 
 
-def _flash_fwd(q, k, v):
+def _flash_fwd(window, q, k, v):
     if _use_pallas(q.shape[1]) and _pallas_bwd_enabled():
         from .pallas_attention import pallas_flash_attention_fwd
 
         block = _auto_block(q.shape[1])
         out, lse = pallas_flash_attention_fwd(
-            q, k, v, causal=True, block_q=block, block_k=block
+            q, k, v, causal=True, block_q=block, block_k=block, window=window
         )
         return out, (q, k, v, out, lse)
-    return _flash(q, k, v), (q, k, v, None, None)
+    return _flash(window, q, k, v), (q, k, v, None, None)
 
 
-def _flash_bwd(residuals, g):
+def _flash_bwd(window, residuals, g):
     q, k, v, out, lse = residuals
     if out is not None:
         from .pallas_attention import pallas_flash_attention_bwd
 
         block = _auto_block(q.shape[1])
         return pallas_flash_attention_bwd(
-            q, k, v, out, lse, g, causal=True, block_q=block, block_k=block
+            q, k, v, out, lse, g, causal=True, block_q=block, block_k=block,
+            window=window,
         )
-    _, vjp = jax.vjp(_blockwise, q, k, v)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise(q_, k_, v_, window=window),
+                     q, k, v)
     return vjp(g)
 
 
@@ -106,41 +113,47 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 # Masked variant: the (B, T) key-padding mask travels as float32 so the
 # custom_vjp can return a well-typed zero cotangent for it.
-@jax.custom_vjp
-def _flash_masked(q, k, v, maskf):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_masked(window, q, k, v, maskf):
     if _use_pallas(q.shape[1]):
         from .pallas_attention import pallas_flash_attention
 
         block = _auto_block(q.shape[1])
         return pallas_flash_attention(
-            q, k, v, maskf, causal=True, block_q=block, block_k=block
+            q, k, v, maskf, causal=True, block_q=block, block_k=block,
+            window=window,
         )
-    return _blockwise(q, k, v, key_mask=maskf)
+    return _blockwise(q, k, v, key_mask=maskf, window=window)
 
 
-def _flash_masked_fwd(q, k, v, maskf):
+def _flash_masked_fwd(window, q, k, v, maskf):
     if _use_pallas(q.shape[1]) and _pallas_bwd_enabled():
         from .pallas_attention import pallas_flash_attention_fwd
 
         block = _auto_block(q.shape[1])
         out, lse = pallas_flash_attention_fwd(
-            q, k, v, maskf, causal=True, block_q=block, block_k=block
+            q, k, v, maskf, causal=True, block_q=block, block_k=block,
+            window=window,
         )
         return out, (q, k, v, maskf, out, lse)
-    return _flash_masked(q, k, v, maskf), (q, k, v, maskf, None, None)
+    return _flash_masked(window, q, k, v, maskf), (q, k, v, maskf, None, None)
 
 
-def _flash_masked_bwd(residuals, g):
+def _flash_masked_bwd(window, residuals, g):
     q, k, v, maskf, out, lse = residuals
     if out is not None:
         from .pallas_attention import pallas_flash_attention_bwd
 
         block = _auto_block(q.shape[1])
         dq, dk, dv = pallas_flash_attention_bwd(
-            q, k, v, out, lse, g, maskf, causal=True, block_q=block, block_k=block
+            q, k, v, out, lse, g, maskf, causal=True, block_q=block,
+            block_k=block, window=window,
         )
         return dq, dk, dv, jnp.zeros_like(maskf)
-    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise(q_, k_, v_, key_mask=maskf), q, k, v)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise(q_, k_, v_, key_mask=maskf, window=window),
+        q, k, v,
+    )
     dq, dk, dv = vjp(g)
     return dq, dk, dv, jnp.zeros_like(maskf)
 
@@ -155,15 +168,23 @@ def flash_attention(
     *,
     attention_mask: jax.Array | None = None,
     causal: bool = True,
+    window: int = 0,
 ) -> jax.Array:
     """Causal attention over (B, T, H, Dh); O(T) memory, differentiable.
 
     ``k``/``v`` may be grouped-query narrow (B, T, Hkv, Dh).
     ``attention_mask`` is the reference's (B, T) padding mask semantics
     (nonzero = real token): masked keys are excluded inside attention.
+    ``window`` > 0 restricts each query to its trailing ``window`` keys
+    (Mistral sliding-window semantics; requires ``causal``); the Pallas
+    kernels skip dead K/V blocks, so compute is O(T·window).
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     if not causal:
+        if window:
+            raise ValueError("sliding window requires causal attention")
         return blockwise_attention(q, k, v, causal=False, key_mask=attention_mask)
     if attention_mask is None:
-        return _flash(q, k, v)
-    return _flash_masked(q, k, v, attention_mask.astype(jnp.float32))
+        return _flash(int(window), q, k, v)
+    return _flash_masked(int(window), q, k, v, attention_mask.astype(jnp.float32))

@@ -49,7 +49,8 @@ _NEG_INF = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, *rest, block_k: int, scale: float, causal: bool, masked: bool
+    q_ref, k_ref, v_ref, *rest, block_k: int, scale: float, causal: bool,
+    masked: bool, window: int = 0,
 ):
     """One q-block vs the streamed K/V sequence.
 
@@ -75,10 +76,17 @@ def _flash_kernel(
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     num_kv = seq_len // block_k
+    start_kv = 0
     if causal:
         # Only blocks that intersect the causal triangle for this q block.
         num_kv_live = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
         num_kv = jnp.minimum(num_kv, num_kv_live)
+    if window:
+        # Sliding window: the earliest key this q block can see is
+        # qi*BQ - window + 1; blocks wholly before it are dead.
+        start_kv = jax.lax.div(
+            jnp.maximum(qi * block_q - window + 1, 0), block_k
+        )
 
     def body(kb, carry):
         acc, row_max, row_sum = carry
@@ -92,7 +100,10 @@ def _flash_kernel(
         )  # (BQ, BK)
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            live = q_pos >= k_pos
+            if window:
+                live &= q_pos - k_pos < window
+            s = jnp.where(live, s, _NEG_INF)
         if masked:
             m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]  # (BK,) int32
             s = jnp.where(m_blk[None, :] != 0, s, _NEG_INF)
@@ -113,7 +124,7 @@ def _flash_kernel(
         jnp.full((block_q,), _NEG_INF, jnp.float32),
         jnp.zeros((block_q,), jnp.float32),
     )
-    acc, row_max, row_sum = jax.lax.fori_loop(0, num_kv, body, init)
+    acc, row_max, row_sum = jax.lax.fori_loop(start_kv, num_kv, body, init)
     o_ref[0] = (acc / row_sum[:, None]).astype(o_ref.dtype)
     l_ref[0] = (row_max + jnp.log(row_sum))[None, :]
 
@@ -162,7 +173,7 @@ def _mask3(mask: jax.Array | None) -> jax.Array | None:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window")
 )
 def pallas_flash_attention_fwd(
     q: jax.Array,
@@ -174,18 +185,26 @@ def pallas_flash_attention_fwd(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention over (B, T, H, D) q returning ``(out, lse)``.
 
     ``k``/``v`` may carry fewer heads (B, T, Hkv, D) for grouped-query
     attention; ``mask`` is an optional (B, T) key-padding mask (nonzero =
-    attend). ``lse`` has shape (B*H, T), float32 — the backward residual.
+    attend). ``window`` > 0 restricts each query to its trailing
+    ``window`` keys (Mistral sliding-window semantics; requires
+    ``causal``) — dead K/V blocks are skipped, so compute is O(T·W).
+    ``lse`` has shape (B*H, T), float32 — the backward residual.
     Falls back to smaller blocks automatically when T < block size.
     """
     b, t, h, d = q.shape
     hkv = k.shape[2]
     _head_groups(h, hkv)
     block_q, block_k = _check_blocks(t, block_q, block_k)
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
 
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     scale = 1.0 / math.sqrt(d)
@@ -193,7 +212,8 @@ def pallas_flash_attention_fwd(
     masked = mask is not None
 
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, scale=scale, causal=causal, masked=masked
+        _flash_kernel, block_k=block_k, scale=scale, causal=causal, masked=masked,
+        window=window,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -232,18 +252,19 @@ def pallas_flash_attention(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    window: int = 0,
 ) -> jax.Array:
     """Causal flash attention over (B, T, H, D); forward only."""
     out, _ = pallas_flash_attention_fwd(
         q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, window=window,
     )
     return out
 
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, *rest,
-    block_k: int, scale: float, causal: bool, masked: bool,
+    block_k: int, scale: float, causal: bool, masked: bool, window: int = 0,
 ):
     """dQ for one q-block, streaming K/V (same schedule as the forward).
 
@@ -268,9 +289,14 @@ def _bwd_dq_kernel(
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     num_kv = seq_len // block_k
+    start_kv = 0
     if causal:
         num_kv_live = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
         num_kv = jnp.minimum(num_kv, num_kv_live)
+    if window:
+        start_kv = jax.lax.div(
+            jnp.maximum(qi * block_q - window + 1, 0), block_k
+        )
 
     def body(kb, dq_acc):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -284,7 +310,10 @@ def _bwd_dq_kernel(
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            live = q_pos >= k_pos
+            if window:
+                live &= q_pos - k_pos < window
+            s = jnp.where(live, s, _NEG_INF)
         if masked:
             m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
             s = jnp.where(m_blk[None, :] != 0, s, _NEG_INF)
@@ -302,14 +331,14 @@ def _bwd_dq_kernel(
         )
 
     dq = jax.lax.fori_loop(
-        0, num_kv, body, jnp.zeros((block_q, head_dim), jnp.float32)
+        start_kv, num_kv, body, jnp.zeros((block_q, head_dim), jnp.float32)
     )
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, *rest,
-    block_q: int, scale: float, causal: bool, masked: bool,
+    block_q: int, scale: float, causal: bool, masked: bool, window: int = 0,
 ):
     """dK/dV for one (kv-head, k-block, group-member) grid point, streaming
     that query head's Q/dO/L/D from the causal diagonal down.
@@ -344,6 +373,11 @@ def _bwd_dkdv_kernel(
     if causal:
         # Q blocks strictly above the diagonal see none of this k-block.
         start_q = jax.lax.div(ki * block_k, block_q)
+    if window:
+        # The last query that can see this k-block sits at
+        # k_pos_max + window - 1; later q blocks are dead.
+        last_q = ki * block_k + block_k - 1 + window - 1
+        num_q = jnp.minimum(num_q, jax.lax.div(last_q, block_q) + 1)
 
     def body(qb, carry):
         dk_acc, dv_acc = carry
@@ -360,7 +394,10 @@ def _bwd_dkdv_kernel(
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            live = q_pos >= k_pos
+            if window:
+                live &= q_pos - k_pos < window
+            s = jnp.where(live, s, _NEG_INF)
         if masked:
             s = jnp.where(key_live[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])  # (BQ, BK)
@@ -399,7 +436,7 @@ def _bwd_dkdv_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window")
 )
 def pallas_flash_attention_bwd(
     q: jax.Array,
@@ -414,6 +451,7 @@ def pallas_flash_attention_bwd(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused flash-attention backward: ``(dq, dk, dv)`` for (B, T, H, D) q.
 
@@ -428,6 +466,10 @@ def pallas_flash_attention_bwd(
     hkv = k.shape[2]
     group = _head_groups(h, hkv)
     block_q, block_k = _check_blocks(t, block_q, block_k)
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
 
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     of, gf = _fold(out), _fold(g)
@@ -456,7 +498,8 @@ def pallas_flash_attention_bwd(
         dq_operands.append(mask_arr)
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal, masked=masked
+            _bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal,
+            masked=masked, window=window,
         ),
         grid=(b * h, t // block_q),
         in_specs=seq_specs,
@@ -493,7 +536,7 @@ def pallas_flash_attention_bwd(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, block_q=block_q, scale=scale, causal=causal,
-            masked=masked,
+            masked=masked, window=window,
         ),
         grid=(b * hkv, t // block_k, group),
         in_specs=kv_specs,

@@ -46,6 +46,10 @@ DEFAULT_LOGICAL_AXIS_RULES = (
     ("kv", None),
     ("qkv", None),
     ("position", None),
+    # Norm scales (models/llama.py RMSNorm): replicated — a (D,) vector
+    # gains nothing from fsdp and an embed→fsdp mapping forces an
+    # inefficient embed-wise grad reshard for the dscale reduction.
+    ("norm", None),
     ("expert", "expert"),
     # Stacked-layer params (models/gpt_pipeline.py): the leading layer dim
     # shards over pipeline stages; the per-layer dims reuse the standard

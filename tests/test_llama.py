@@ -7,6 +7,8 @@ HF transformers' torch Llama, the family's ground truth (the analogue of
 tests/test_torch_parity.py pinning the optimizer against torch).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -280,50 +282,13 @@ class TestHFParity:
         ours = _model(n_kv_heads=2)
         p = _params(ours)
 
-        def t2j(w):
-            return jnp.asarray(w.detach().numpy())
+        # Port through the LIBRARY converter (interop/llama_hf.py) — these
+        # parity tests are what pin its layout transforms numerically.
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
 
-        dh = D // H
-        sd = hf.state_dict()
-        new = {
-            "token_embedding": {"embedding": t2j(sd["model.embed_tokens.weight"])},
-            "norm_f": {"scale": t2j(sd["model.norm.weight"])},
-            "lm_head": {"kernel": t2j(sd["lm_head.weight"]).T},
-        }
-        for i in range(2):
-            pre = f"model.layers.{i}."
-            kv = jnp.stack(
-                [
-                    t2j(sd[pre + "self_attn.k_proj.weight"]).T.reshape(D, 2, dh),
-                    t2j(sd[pre + "self_attn.v_proj.weight"]).T.reshape(D, 2, dh),
-                ],
-                axis=1,
-            )  # (D, 2, Hkv, dh)
-            new[f"block_{i}"] = {
-                "attn_norm": {"scale": t2j(sd[pre + "input_layernorm.weight"])},
-                "mlp_norm": {
-                    "scale": t2j(sd[pre + "post_attention_layernorm.weight"])
-                },
-                "attn": {
-                    "q_proj": {
-                        "kernel": t2j(sd[pre + "self_attn.q_proj.weight"]).T.reshape(
-                            D, H, dh
-                        )
-                    },
-                    "kv_proj": {"kernel": kv},
-                    "out_proj": {
-                        "kernel": t2j(sd[pre + "self_attn.o_proj.weight"]).T.reshape(
-                            H, dh, D
-                        )
-                    },
-                },
-                "mlp_gate": {"kernel": t2j(sd[pre + "mlp.gate_proj.weight"]).T},
-                "mlp_up": {"kernel": t2j(sd[pre + "mlp.up_proj.weight"]).T},
-                "mlp_down": {"kernel": t2j(sd[pre + "mlp.down_proj.weight"]).T},
-            }
-        chex_tree_shapes = jax.tree.map(jnp.shape, p)
-        ported_shapes = jax.tree.map(jnp.shape, new)
-        assert chex_tree_shapes == ported_shapes
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        new = llama_params_from_hf_state_dict(sd, p)
+        assert jax.tree.map(jnp.shape, p) == jax.tree.map(jnp.shape, new)
         return hf, ours, new
 
     def test_logits_match(self, pair):
@@ -367,3 +332,211 @@ class TestHFParity:
         np.testing.assert_allclose(
             np.asarray(logits)[:, -1], want, atol=2e-4
         )
+
+
+class TestSlidingWindowModel:
+    """model.extra.sliding_window end to end (the Mistral architecture:
+    llama + window)."""
+
+    def test_cached_decode_matches_nocache(self):
+        from llmtrain_tpu.generation import generate
+
+        m = _model(n_kv_heads=2, sliding_window=4)
+        p = _params(m)
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+        a = generate(m, p, prompt, max_new_tokens=8, temperature=0.0,
+                     use_cache=True)
+        b = generate(m, p, prompt, max_new_tokens=8, temperature=0.0,
+                     use_cache=False)
+        assert a.tolist() == b.tolist()
+
+    def test_window_changes_logits_beyond_window(self):
+        """Token 0 is outside position 6's window of 4 — with ONE layer
+        (stacked windows compound the receptive field by W-1 per layer),
+        perturbing it must not change position 6's logits, and must
+        change them under full attention."""
+        win = _model(n_layers=1, sliding_window=4)
+        p = _params(win)
+        a = jnp.asarray([[5, 1, 2, 3, 4, 5, 6, 7]])
+        b = jnp.asarray([[9, 1, 2, 3, 4, 5, 6, 7]])
+        la = win.apply({"params": p}, a, deterministic=True)
+        lb = win.apply({"params": p}, b, deterministic=True)
+        np.testing.assert_allclose(
+            np.asarray(la)[:, 6:], np.asarray(lb)[:, 6:], atol=1e-5
+        )
+        full = _model(n_layers=1)
+        fa = full.apply({"params": p}, a, deterministic=True)
+        fb = full.apply({"params": p}, b, deterministic=True)
+        assert np.abs(np.asarray(fa)[:, 6:] - np.asarray(fb)[:, 6:]).max() > 1e-4
+
+    def test_adapter_rejects_window_with_ring(self):
+        with pytest.raises(ValueError, match="sliding_window"):
+            base = _cfg(sliding_window=4).model_dump()
+            base["model"]["attention"] = "ring"
+            LlamaAdapter().build_model(RunConfig.model_validate(base))
+
+    def test_hf_mistral_parity(self):
+        """The sliding-window model IS Mistral: logits match HF
+        transformers' torch MistralForCausalLM (same state-dict naming as
+        llama, so the interop converter ports it unchanged)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
+
+        hf_cfg = transformers.MistralConfig(
+            vocab_size=V,
+            hidden_size=D,
+            intermediate_size=F,
+            num_hidden_layers=2,
+            num_attention_heads=H,
+            num_key_value_heads=2,
+            max_position_embeddings=T,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            sliding_window=4,
+            tie_word_embeddings=False,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(1)
+        hf = transformers.MistralForCausalLM(hf_cfg).eval()
+
+        ours = _model(n_kv_heads=2, sliding_window=4)
+        p = _params(ours)
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = llama_params_from_hf_state_dict(sd, p)
+
+        ids = np.asarray([[1, 5, 9, 2, 40, 3, 0, 63, 12, 7, 30, 11]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.from_numpy(ids).long()).logits.numpy()
+        got = np.asarray(
+            ours.apply({"params": params}, jnp.asarray(ids), deterministic=True)
+        )
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+class TestHFInterop:
+    """interop/llama_hf.py structural contract (numerics pinned by
+    TestHFParity, which routes through the same converter)."""
+
+    def _roundtrip(self, **kw):
+        from llmtrain_tpu.interop import (
+            llama_params_from_hf_state_dict,
+            llama_params_to_hf_state_dict,
+        )
+
+        m = _model(**kw)
+        p = _params(m)
+        sd = llama_params_to_hf_state_dict(p)
+        back = llama_params_from_hf_state_dict(sd, p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            p,
+            back,
+        )
+        return sd
+
+    def test_roundtrip_gqa_untied(self):
+        sd = self._roundtrip(n_kv_heads=2)
+        assert sd["model.layers.0.self_attn.k_proj.weight"].shape == (
+            2 * (D // H), D,
+        )
+        assert "lm_head.weight" in sd
+
+    def test_roundtrip_mha_fused(self):
+        sd = self._roundtrip()  # n_kv_heads == n_heads → fused qkv tree
+        assert sd["model.layers.0.self_attn.q_proj.weight"].shape == (D, D)
+
+    def test_roundtrip_tied(self):
+        sd = self._roundtrip(tie_embeddings=True)
+        np.testing.assert_array_equal(
+            sd["lm_head.weight"], sd["model.embed_tokens.weight"]
+        )
+
+    def test_tied_import_tolerates_missing_head(self):
+        """HF safetensors drops shared tensors; a tied template accepts
+        the absence and rejects a DIFFERENT head."""
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
+
+        m = _model(tie_embeddings=True)
+        p = _params(m)
+        from llmtrain_tpu.interop import llama_params_to_hf_state_dict
+
+        sd = llama_params_to_hf_state_dict(p)
+        del sd["lm_head.weight"]
+        llama_params_from_hf_state_dict(sd, p)  # must not raise
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"] + 1.0
+        with pytest.raises(ValueError, match="untied"):
+            llama_params_from_hf_state_dict(sd, p)
+
+    def test_unconsumed_keys_rejected(self):
+        from llmtrain_tpu.interop import (
+            llama_params_from_hf_state_dict,
+            llama_params_to_hf_state_dict,
+        )
+
+        p = _params(_model())
+        sd = llama_params_to_hf_state_dict(p)
+        sd["model.layers.9.mlp.gate_proj.weight"] = sd[
+            "model.layers.0.mlp.gate_proj.weight"
+        ]
+        with pytest.raises(ValueError, match="cannot hold"):
+            llama_params_from_hf_state_dict(sd, p)
+
+    def test_rotary_buffers_ignored(self):
+        from llmtrain_tpu.interop import (
+            llama_params_from_hf_state_dict,
+            llama_params_to_hf_state_dict,
+        )
+
+        p = _params(_model())
+        sd = llama_params_to_hf_state_dict(p)
+        sd["model.layers.0.self_attn.rotary_emb.inv_freq"] = np.ones(4)
+        llama_params_from_hf_state_dict(sd, p)  # must not raise
+
+    def test_gpt_tree_rejected(self):
+        from llmtrain_tpu.interop import llama_params_to_hf_state_dict
+        from llmtrain_tpu.models.gpt import GPT
+
+        g = GPT(
+            vocab_size=V, block_size=T, d_model=D, n_layers=1, n_heads=H,
+            d_ff=F, dropout=0.0,
+        )
+        gp = _params(g)
+        with pytest.raises(ValueError, match="llama"):
+            llama_params_to_hf_state_dict(gp)
+
+    def test_cli_export_import_roundtrip(self, tmp_path):
+        """llama checkpoints export as HF state dicts and re-import to a
+        resumable step-0 checkpoint through the real CLI."""
+        import subprocess
+        import sys
+
+        import yaml
+
+        torch = pytest.importorskip("torch")
+        cfg = _cfg(_max_steps=4).model_dump()
+        cfg["trainer"]["save_every_steps"] = 4
+        (tmp_path / "llama.yaml").write_text(yaml.safe_dump(cfg))
+
+        def run(*args):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            return subprocess.run(
+                [sys.executable, "-m", "llmtrain_tpu", *args],
+                capture_output=True, text=True, cwd=tmp_path, env=env,
+                timeout=420,
+            )
+
+        first = run("train", "--config", "llama.yaml", "--json",
+                    "--run-id", "rl1")
+        assert first.returncode == 0, first.stderr
+        exp = run("export-checkpoint", "--config", "llama.yaml", "--from",
+                  "rl1", "--output", "out.pt", "--json")
+        assert exp.returncode == 0, exp.stderr
+        sd = torch.load(tmp_path / "out.pt", weights_only=True)
+        assert "model.embed_tokens.weight" in sd
+        imp = run("import-checkpoint", "--config", "llama.yaml", "--input",
+                  "out.pt", "--output", "imported", "--json")
+        assert imp.returncode == 0, imp.stderr
+        assert (tmp_path / "imported" / "step_000000.ckpt").exists()

@@ -328,6 +328,136 @@ class TestSequenceParallelMasks:
             )
 
 
+class TestSlidingWindow:
+    """Mistral-style sliding-window masking across the stack: dense
+    (full-matrix reference), blockwise (mask-only), Pallas interpret
+    (skip-block), and the flash dispatch fallback — all must agree."""
+
+    def _naive_window_ref(self, q, k, v, window):
+        import math
+
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        t = q.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        pos = jnp.arange(t)
+        live = (pos[:, None] >= pos[None, :]) & (
+            pos[:, None] - pos[None, :] < window
+        )
+        s = jnp.where(live[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def test_dense_matches_naive(self):
+        q, k, v = _qkv(t=32)
+        out = dense_attention(q, k, v, attention_mask=None, window=5)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._naive_window_ref(q, k, v, 5)),
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("window", [1, 7, 8, 13, 32, 100])
+    def test_blockwise_matches_dense(self, window):
+        """Window edges off/on chunk boundaries, window == 1 (self only),
+        window >= T (== full causal)."""
+        q, k, v = _qkv(t=32, seed=41)
+        out = blockwise_attention(
+            q, k, v, causal=True, q_chunk=8, kv_chunk=8, window=window
+        )
+        ref = dense_attention(q, k, v, attention_mask=None, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("window", [1, 7, 8, 13, 32, 100])
+    def test_pallas_fwd_matches_dense(self, window):
+        q, k, v = _qkv(t=32, seed=42)
+        out = pallas_flash_attention(
+            q, k, v, block_q=8, block_k=8, interpret=True, window=window
+        )
+        ref = dense_attention(q, k, v, attention_mask=None, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("window", [7, 16])
+    def test_pallas_bwd_matches_autodiff(self, window):
+        from llmtrain_tpu.ops.pallas_attention import (
+            pallas_flash_attention_bwd,
+            pallas_flash_attention_fwd,
+        )
+
+        q, k, v = _qkv(t=32, seed=43)
+        g = jax.random.normal(jax.random.key(44), q.shape, jnp.float32)
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, block_q=8, block_k=8, interpret=True, window=window
+        )
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, block_q=8, block_k=8, interpret=True,
+            window=window,
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(
+                dense_attention(q, k, v, attention_mask=None, window=window) * g
+            )
+
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
+
+    def test_window_with_padding_mask(self):
+        """Sliding window and key-padding combine in one kernel."""
+        q, k, v = _qkv(b=3, t=32, seed=45)
+        mask = _suffix_mask(3, 32, seed=46)
+        out = pallas_flash_attention(
+            q, k, v, mask, block_q=8, block_k=8, interpret=True, window=9
+        )
+        ref = dense_attention(q, k, v, attention_mask=mask, window=9)
+        np.testing.assert_allclose(_valid(out, mask), _valid(ref, mask), atol=1e-5)
+
+    def test_window_with_gqa(self):
+        """Sliding window over narrow grouped-query K/V."""
+        ks = jax.random.split(jax.random.key(47), 3)
+        q = jax.random.normal(ks[0], (2, 32, 4, 8), jnp.float32)
+        kn = jax.random.normal(ks[1], (2, 32, 2, 8), jnp.float32)
+        vn = jax.random.normal(ks[2], (2, 32, 2, 8), jnp.float32)
+        out = pallas_flash_attention(
+            q, kn, vn, block_q=8, block_k=8, interpret=True, window=11
+        )
+        kw, vw = jnp.repeat(kn, 2, axis=2), jnp.repeat(vn, 2, axis=2)
+        ref = dense_attention(q, kw, vw, attention_mask=None, window=11)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_dispatch_fallback_grads(self):
+        """flash_attention(window=...) differentiates through the
+        blockwise fallback and matches dense-window autodiff."""
+        q, k, v = _qkv(t=16, seed=48)
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, window=6).sum()
+
+        def loss_dense(q, k, v):
+            return dense_attention(q, k, v, attention_mask=None, window=6).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(t=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=4)
+
+    def test_negative_window_rejected(self):
+        """A negative window would silently mask EVERY key (uniform-
+        average garbage) — the ops layer rejects it."""
+        q, k, v = _qkv(t=16)
+        with pytest.raises(ValueError, match=">= 0"):
+            flash_attention(q, k, v, window=-1)
+        with pytest.raises(ValueError, match=">= 0"):
+            blockwise_attention(q, k, v, causal=True, window=-1)
+        with pytest.raises(ValueError, match=">= 0"):
+            pallas_flash_attention(q, k, v, interpret=True, window=-1)
+
+
 class TestGQAKernels:
     """Native grouped-query attention: narrow (B, T, Hkv, D) K/V through
     the Pallas kernels with in-kernel group mapping — no jnp.repeat."""

@@ -62,6 +62,44 @@ def test_bench_interleave_smoke():
     assert {r.get("virtual_chunks") for r in lines if "virtual_chunks" in r} == {1, 2}
 
 
+def test_bench_family_smoke():
+    proc = _run(["tools/bench_family.py", "--cpu-smoke", "--steps", "1"])
+    assert proc.returncode == 0, proc.stderr
+    rows = [json.loads(x) for x in proc.stdout.splitlines() if x.strip()]
+    assert {r.get("family") for r in rows} == {"gpt", "llama"}
+    assert all("error" not in r and r["tokens_per_sec"] > 0 for r in rows)
+
+
+def test_interleave_attribution_smoke():
+    proc = _run(
+        ["tools/bench_interleave.py", "--no-trainer", "--attribute",
+         "--repeats", "2"],
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.splitlines()[-1])["attribution"]
+    assert row["phases"]["v1"]["ticks"] == 7
+    assert row["phases"]["v2"]["ticks"] == 11
+    assert row["predicted_compute_ratio_v2_v1"] == pytest.approx(11 / 14, abs=1e-3)
+
+
+def test_phase2_script_aborts_cleanly_without_tpu():
+    """The phase-2 runbook's compile-verifying probe must fail fast when
+    no TPU backend exists."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        ["bash", "tools/run_chip_phase2.sh", "/tmp/chipp2-test"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 1
+    assert "unreachable" in proc.stderr
+
+
 def test_chip_evidence_script_aborts_cleanly_without_tpu():
     """The runbook's probe must fail fast (not hang) when no TPU backend
     exists — forced here by pinning the probe subprocess to CPU."""

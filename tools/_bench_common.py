@@ -25,14 +25,18 @@ import numpy as np
 
 
 def build_train_cell(cfg: Any) -> tuple[Any, Any, int]:
-    """(jitted step_fn, initial state, param count) for a RunConfig."""
+    """(jitted step_fn, initial state, param count) for a RunConfig.
+
+    The adapter comes from the registry (cfg.model.name), so the same
+    cell harness measures any registered family (gpt, llama, ...)."""
     from flax.linen import meta as nn_meta
 
-    from llmtrain_tpu.models.gpt import GPTAdapter
+    from llmtrain_tpu.registry import get_model_adapter, initialize_registries
     from llmtrain_tpu.training.optimizer import build_optimizer
     from llmtrain_tpu.training.train_step import create_train_state, make_train_step
 
-    adapter = GPTAdapter()
+    initialize_registries()
+    adapter = get_model_adapter(cfg.model.name)()
     model = adapter.build_model(cfg)
     tx = build_optimizer(cfg.trainer)
     params = nn_meta.unbox(adapter.init_params(model, cfg, jax.random.key(0)))

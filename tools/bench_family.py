@@ -1,0 +1,124 @@
+"""Model-family throughput cells: gpt vs llama at matched scale.
+
+The llama family (models/llama.py) shares the attention kernels and the
+train step with gpt but differs where it costs: SwiGLU (3 MLP matmuls,
+narrower d_ff for matched params), RMSNorm (no mean/bias), RoPE (two
+elementwise rotations per layer vs one embedding add), untied head.
+This tool measures whether those trades are throughput-neutral on chip:
+one train cell per family at GPT-2-small-class size (d_ff 3072 GELU vs
+2048 SwiGLU ≈ matched MLP params/FLOPs), same T/batch/loss path.
+
+Usage (repo root):
+
+    python tools/bench_family.py                 # TPU cells
+    JAX_PLATFORMS=cpu python tools/bench_family.py --cpu-smoke
+
+Emits one JSON line per family.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _cell(family: str, *, cpu_smoke: bool, steps: int, batch: int) -> dict:
+    from _bench_common import build_train_cell, make_batch, measure_cell
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.utils.hw import mfu as compute_mfu
+
+    if cpu_smoke:
+        dims = dict(d_model=64, n_layers=2, n_heads=4, vocab_size=256)
+        seq = 128
+        d_ff = 128 if family == "gpt" else 88
+    else:
+        dims = dict(d_model=768, n_layers=12, n_heads=12, vocab_size=50257)
+        seq = 512
+        # Matched MLP params: GELU 2·d·3072 ≈ SwiGLU 3·d·2048.
+        d_ff = 3072 if family == "gpt" else 2048
+    extra: dict = {"tokenizer": "byte"}
+    if family == "llama":
+        extra["n_kv_heads"] = dims["n_heads"] // 3 if cpu_smoke else 4
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": f"fam-{family}", "device": "cpu" if cpu_smoke else "tpu"},
+            "model": {
+                "name": family,
+                "block_size": seq,
+                "d_ff": d_ff,
+                "dropout": 0.0,
+                "dtype": "float32" if cpu_smoke else "bfloat16",
+                "attention": "flash",
+                "extra": extra,
+                **dims,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": steps,
+                "micro_batch_size": batch,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+                "log_every_steps": 10_000,
+                "eval_every_steps": 10_000,
+                "save_every_steps": 10_000,
+            },
+            "mlflow": {"enabled": False},
+        }
+    )
+    step_fn, state, n_params = build_train_cell(cfg)
+    batch_dict = make_batch(batch, seq, dims["vocab_size"])
+    m = measure_cell(step_fn, state, batch_dict, steps)
+    toks = batch * seq / m["step_time_s"]
+    return {
+        "family": family,
+        "backend": jax.default_backend(),
+        "params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "step_time_ms": round(m["step_time_s"] * 1e3, 2),
+        "tokens_per_sec": round(toks, 1),
+        "mfu": round(
+            compute_mfu(
+                toks,
+                n_params=n_params,
+                n_layers=dims["n_layers"],
+                seq_len=seq,
+                d_model=dims["d_model"],
+            ),
+            4,
+        ),
+        "compile_s": round(m["compile_s"], 1),
+        "loss": m["loss"],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--families", default="gpt,llama")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=0, help="0 = auto per mode")
+    ap.add_argument("--cpu-smoke", action="store_true")
+    args = ap.parse_args()
+    batch = args.batch or (4 if args.cpu_smoke else 64)
+    steps = min(args.steps, 3) if args.cpu_smoke else args.steps
+    for family in args.families.split(","):
+        try:
+            print(json.dumps(_cell(family.strip(), cpu_smoke=args.cpu_smoke,
+                                   steps=steps, batch=batch)), flush=True)
+        except Exception as exc:  # noqa: BLE001 — per-cell isolation
+            print(json.dumps({"family": family, "error": str(exc)[:500]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,7 @@ def _peak_bytes() -> float:
 
 
 def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
-          steps: int) -> dict:
+          steps: int, window: int = 0) -> dict:
     from _bench_common import build_train_cell, make_batch, measure_cell
     from llmtrain_tpu.config.schemas import RunConfig
     from llmtrain_tpu.utils.hw import mfu as compute_mfu
@@ -61,6 +61,7 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
                     "tokenizer": "byte",
                     "loss_impl": "chunked_ce",
                     "assume_packed": True,
+                    **({"sliding_window": window} if window else {}),
                 },
                 **dims,
             },
@@ -86,6 +87,7 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
         "seq": seq,
         "batch": batch,
         "attention": attention,
+        "window": window,
         "backend": jax.default_backend(),
         "step_time_s": round(step_time, 4),
         "tokens_per_sec": round(tokens_per_sec, 1),
@@ -106,13 +108,18 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--attention", default="flash")
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument(
+        "--window", type=int, default=0,
+        help="sliding-window size (0 = full causal); the O(T*W) cell",
+    )
     ap.add_argument("--cpu-smoke", action="store_true")
     args = ap.parse_args()
 
     for seq in (int(s) for s in args.seqs.split(",")):
         try:
             row = _cell(seq, args.batch, attention=args.attention,
-                        cpu_smoke=args.cpu_smoke, steps=args.steps)
+                        cpu_smoke=args.cpu_smoke, steps=args.steps,
+                        window=args.window)
         except Exception as exc:  # noqa: BLE001 — report OOM etc. per cell
             row = {"seq": seq, "batch": args.batch, "error": str(exc)[:200]}
         print(json.dumps(row), flush=True)

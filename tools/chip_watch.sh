@@ -16,8 +16,10 @@ PROBES="${CHIP_WATCH_PROBES:-200}"
 SLEEP="${CHIP_WATCH_SLEEP:-120}"
 
 for i in $(seq 1 "$PROBES"); do
-    if timeout 90 python -c 'import jax; assert jax.default_backend() == "tpu"' \
-        >/dev/null 2>&1; then
+    # tools/tpu_probe.py (shared with the runbooks): backend init +
+    # compile + sync, so a dead remote_compile helper doesn't arm a
+    # runbook whose every step hangs (r4).
+    if timeout 180 python tools/tpu_probe.py >/dev/null 2>&1; then
         echo "[chip-watch] tunnel live at $(date -u +%H:%M:%S); running: $CMD"
         eval "$CMD"
         rc=$?

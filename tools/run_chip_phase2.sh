@@ -13,34 +13,42 @@ mkdir -p "$OUT"
 
 log() { echo "[chip-p2] $*" >&2; }
 
-log "probing TPU backend (240s timeout)..."
-if ! timeout 240 python -c "import jax; assert jax.default_backend() == 'tpu'" \
-    >"$OUT/probe.log" 2>&1; then
-    log "TPU backend unreachable — aborting (see $OUT/probe.log)"
+log "probing TPU backend + compile helper (240s timeout)..."
+# tools/tpu_probe.py: backend init + tiny jitted matmul + device_get
+# sync — a dead remote_compile helper fails here instead of hanging
+# every armed step to its watchdog (r4 incident).
+if ! timeout 240 python tools/tpu_probe.py >"$OUT/probe.log" 2>&1; then
+    log "TPU backend or compile helper unreachable — aborting (see $OUT/probe.log)"
     exit 1
 fi
-log "TPU live."
+log "TPU live (compile path verified)."
 
-log "1/7 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
+log "1/8 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
 timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
     >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
 tail -2 "$OUT/tpu_compiled.log" || true
 
-log "2/7 masked-vs-packed A/B + GQA train deltas..."
+log "2/8 masked-vs-packed A/B + GQA train deltas..."
 timeout 3000 python tools/bench_mask_ab.py \
     >"$OUT/mask_ab.json" 2>"$OUT/mask_ab.log" || log "mask A/B failed/partial"
 tail -1 "$OUT/mask_ab.json" || true
 
-log "3/7 long-context sweep (fixed per-step sync; retry 16k/32k)..."
+log "3/8 long-context sweep (fixed per-step sync; retry 16k/32k)..."
 timeout 3600 python tools/bench_longctx.py --seqs 4096,8192,16384,32768 \
     >"$OUT/longctx.json" 2>"$OUT/longctx.log" || log "longctx failed/partial"
 
-log "4/7 decode attribution (layers/vocab/sampler/bf16-cast ablations)..."
+log "3b/8 sliding-window long-context cell (O(T·W) vs full causal)..."
+timeout 1500 python tools/bench_longctx.py --seqs 8192,16384 --window 1024 \
+    >"$OUT/longctx_window.json" 2>"$OUT/longctx_window.log" \
+    || log "windowed longctx failed/partial"
+tail -2 "$OUT/longctx_window.json" || true
+
+log "4/8 decode attribution (layers/vocab/sampler/bf16-cast ablations)..."
 timeout 2400 python tools/diag_decode.py --batches 1,8,32 --kv-heads 0,4 \
     >"$OUT/diag_decode.json" 2>"$OUT/diag_decode.log" \
     || log "decode diag failed/partial"
 
-log "5/7 bench auto-sweep with room to climb (deadline 1500s)..."
+log "5/8 bench auto-sweep with room to climb (deadline 1500s)..."
 # TPU_TIMEOUT must rise with DEADLINE_SEC: the parent watchdog kills the
 # child at TPU_TIMEOUT regardless of the child's sweep budget.
 timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=1600 \
@@ -48,13 +56,18 @@ timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=160
     >"$OUT/bench_sweep.json" 2>"$OUT/bench_sweep.log" || log "bench sweep failed"
 tail -1 "$OUT/bench_sweep.json" || true
 
-log "6/7 chunked-CE batch-128 cell (the HBM-freed retune)..."
+log "6/8 chunked-CE batch-128 cell (the HBM-freed retune)..."
 timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked \
     LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
     >"$OUT/bench_c128.json" 2>"$OUT/bench_c128.log" || log "c128 cell failed"
 tail -1 "$OUT/bench_c128.json" || true
 
-log "7/7 BPE headline train (tokenizer already at runs/pytok8k.json)..."
+log "7/8 model-family cells: gpt vs llama at matched scale..."
+timeout 1200 python tools/bench_family.py \
+    >"$OUT/family.json" 2>"$OUT/family.log" || log "family cells failed/partial"
+tail -2 "$OUT/family.json" || true
+
+log "8/8 BPE headline train (tokenizer already at runs/pytok8k.json)..."
 if [ -f runs/pytok8k.json ]; then
     timeout 5400 python -m llmtrain_tpu train \
         --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \

@@ -1,0 +1,40 @@
+"""TPU liveness probe: backend init + compile + execute + host sync.
+
+The single probe both chip gates use (tools/chip_watch.sh,
+tools/run_chip_evidence.sh, tools/run_chip_phase2.sh), so a probe
+hardening lands once. Backend init alone is NOT enough — r4 hit a
+window where the backend came up but the tunnel's remote_compile
+helper was dead (HTTP 500 / blocked sockets) and every armed step then
+hung to its watchdog. Compiling and device_get-syncing a tiny jitted
+matmul exercises the full path.
+
+Exit 0 iff the chip is usable; nonzero (with a one-line reason on
+stderr) otherwise. Callers wrap it in their own `timeout`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() != "tpu":
+            print(f"backend is {jax.default_backend()!r}, not tpu", file=sys.stderr)
+            return 1
+        x = jnp.ones((128, 128))
+        got = float(jax.device_get(jax.jit(lambda a: a @ a)(x)[0, 0]))
+        if got != 128.0:
+            print(f"compile probe computed {got}, expected 128.0", file=sys.stderr)
+            return 1
+        return 0
+    except Exception as exc:  # noqa: BLE001 — probe boundary
+        print(f"probe failed: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
