@@ -47,9 +47,15 @@ _ROTARY_BUFFER_RE = re.compile(r"(^|\.)rotary_emb\.inv_freq$")
 
 
 def is_llama_tree(params: Params) -> bool:
-    """True for a models/llama.py param tree (SwiGLU block markers)."""
+    """True for a models/llama.py param tree.
+
+    Keys off ``attn_norm`` — the RMSNorm marker only llama blocks carry
+    (GPT blocks use ``ln_1``/``ln_2``) — so dense AND MoE (llama_moe)
+    trees both dispatch here; the converter then raises its own accurate
+    error for the MoE layout it cannot express in HF-Llama naming.
+    """
     blk = params.get("block_0") if hasattr(params, "get") else None
-    return blk is not None and "mlp_gate" in blk and "attn_norm" in blk
+    return blk is not None and "attn_norm" in blk
 
 
 def _np(a) -> np.ndarray:
@@ -72,6 +78,12 @@ def llama_params_to_hf_state_dict(params: Params) -> dict[str, np.ndarray]:
     i = 0
     while f"block_{i}" in params:
         p = params[f"block_{i}"]
+        if "moe_mlp" in p:
+            raise ValueError(
+                "Mixture-of-Experts checkpoints (model.name llama_moe) "
+                "have no counterpart in the HF LlamaForCausalLM state-dict "
+                "layout — export is only supported for dense llama models"
+            )
         if "mlp_gate" not in p:
             raise ValueError(
                 f"block_{i} has no mlp_gate; not a models/llama.py tree"

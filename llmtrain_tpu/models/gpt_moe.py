@@ -39,14 +39,19 @@ class GPTMoEAdapter(GPTAdapter):
     known_extra_keys = GPTAdapter.known_extra_keys | frozenset(
         {"n_experts", "capacity_factor", "moe_aux_weight", "router_top_k"}
     )
+    # Subclass hooks so the MoE machinery (build + aux-loss fold) serves
+    # other families too (models/llama.py's LlamaMoEAdapter).
+    _moe_name = "gpt_moe"
+    _dense_name = "gpt"
 
     def build_model(self, cfg: RunConfig):
         extra = cfg.model.extra
         n_experts = int(extra.get("n_experts", 0))
         if n_experts < 2:
             raise ValueError(
-                "gpt_moe requires model.extra.n_experts >= 2 "
-                f"(got {n_experts}); use model.name 'gpt' for a dense MLP"
+                f"{self._moe_name} requires model.extra.n_experts >= 2 "
+                f"(got {n_experts}); use model.name {self._dense_name!r} "
+                "for a dense MLP"
             )
         base = super().build_model(cfg)
         return base.clone(

@@ -42,6 +42,7 @@ from .gpt import (
     GPTAdapter,
     _scaled_init,
 )
+from .gpt_moe import GPTMoEAdapter as _GPTMoEAdapter
 
 
 class RMSNorm(nn.Module):
@@ -87,6 +88,12 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
+    # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
+    # mlp_type="swiglu" — the Mixtral layout); 0 = dense SwiGLU.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(
@@ -124,31 +131,48 @@ class LlamaBlock(nn.Module):
         )(h, attention_mask, deterministic=deterministic)
 
         h = nn.with_logical_constraint(RMSNorm(name="mlp_norm", **norm_kw)(x), act)
-        dense_kw = dict(
-            use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
-        )
-        gate = nn.Dense(
-            self.d_ff,
-            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
-            name="mlp_gate",
-            **dense_kw,
-        )(h)
-        up = nn.Dense(
-            self.d_ff,
-            kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
-            name="mlp_up",
-            **dense_kw,
-        )(h)
-        h = nn.silu(gate) * up
-        h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
-        h = nn.Dense(
-            self.d_model,
-            kernel_init=nn.with_logical_partitioning(
-                _scaled_init(self.n_layers), ("mlp", "embed")
-            ),
-            name="mlp_down",
-            **dense_kw,
-        )(h)
+        if self.n_experts > 0:
+            from .moe import MoEMLP
+
+            h = MoEMLP(
+                d_model=self.d_model,
+                d_ff=self.d_ff,
+                n_experts=self.n_experts,
+                n_layers=self.n_layers,
+                capacity_factor=self.capacity_factor,
+                aux_loss_weight=self.moe_aux_weight,
+                router_top_k=self.router_top_k,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                mlp_type="swiglu",
+                name="moe_mlp",
+            )(h)
+        else:
+            dense_kw = dict(
+                use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+            )
+            gate = nn.Dense(
+                self.d_ff,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
+                name="mlp_gate",
+                **dense_kw,
+            )(h)
+            up = nn.Dense(
+                self.d_ff,
+                kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
+                name="mlp_up",
+                **dense_kw,
+            )(h)
+            h = nn.silu(gate) * up
+            h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
+            h = nn.Dense(
+                self.d_model,
+                kernel_init=nn.with_logical_partitioning(
+                    _scaled_init(self.n_layers), ("mlp", "embed")
+                ),
+                name="mlp_down",
+                **dense_kw,
+            )(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
         return nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
@@ -182,6 +206,12 @@ class Llama(nn.Module):
     # Sliding-window attention (model.extra.sliding_window, the Mistral
     # architecture knob): O(T·W) attention on the flash path.
     sliding_window: int = 0
+    # Mixture-of-Experts with SwiGLU experts (model.name llama_moe — the
+    # Mixtral architecture); 0 = dense SwiGLU MLPs.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    router_top_k: int = 1
 
     def for_decoding(self, cache_len: int | None = None) -> "Llama":
         """Clone configured for cached autoregressive decoding (same
@@ -253,6 +283,10 @@ class Llama(nn.Module):
                 rope_theta=self.rope_theta,
                 rms_norm_eps=self.rms_norm_eps,
                 sliding_window=self.sliding_window,
+                n_experts=self.n_experts,
+                capacity_factor=self.capacity_factor,
+                moe_aux_weight=self.moe_aux_weight,
+                router_top_k=self.router_top_k,
                 name=f"block_{layer}",
             )(x, attention_mask, deterministic)
 
@@ -346,4 +380,23 @@ class LlamaAdapter(GPTAdapter):
         )
 
 
-__all__ = ["Llama", "LlamaBlock", "RMSNorm", "LlamaAdapter"]
+@register_model("llama_moe")
+class LlamaMoEAdapter(_GPTMoEAdapter, LlamaAdapter):
+    """Mixtral-class adapter: the llama family + SwiGLU-expert MoE.
+
+    Cooperative MRO does the composition: ``GPTMoEAdapter.build_model``
+    validates/clones the MoE knobs and its ``compute_loss_components``
+    folds the sown load-balance aux loss; ``super().build_model`` resolves
+    to ``LlamaAdapter.build_model``, so the trunk is the Llama module
+    (whose blocks route the MLP through ``MoEMLP(mlp_type="swiglu")``).
+    With ``model.extra.sliding_window`` this is the full Mixtral layout.
+    """
+
+    known_extra_keys = (
+        _GPTMoEAdapter.known_extra_keys | LlamaAdapter.known_extra_keys
+    )
+    _moe_name = "llama_moe"
+    _dense_name = "llama"
+
+
+__all__ = ["Llama", "LlamaBlock", "RMSNorm", "LlamaAdapter", "LlamaMoEAdapter"]

@@ -56,6 +56,10 @@ class MoEMLP(nn.Module):
     router_top_k: int = 1
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    # Expert MLP flavor: "gelu" (GPT family, biased two-matmul MLP) or
+    # "swiglu" (Mixtral/llama family: silu(x·wg) * (x·wu) → wo, bias-free
+    # — the same block shape as models/llama.py's dense SwiGLU).
+    mlp_type: str = "gelu"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -140,39 +144,72 @@ class MoEMLP(nn.Module):
             expert_in, ("act_expert", "act_expert_group", None, "act_embed")
         )
 
-        wi = self.param(
-            "wi",
-            nn.with_logical_partitioning(_DENSE_INIT, ("expert", "embed", "mlp")),
-            (n_exp, d_model, self.d_ff),
-            self.param_dtype,
-        )
-        bi = self.param(
-            "bi",
-            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "mlp")),
-            (n_exp, self.d_ff),
-            self.param_dtype,
-        )
-        wo = self.param(
-            "wo",
-            nn.with_logical_partitioning(
-                _scaled_init(self.n_layers), ("expert", "mlp", "embed")
-            ),
-            (n_exp, self.d_ff, d_model),
-            self.param_dtype,
-        )
-        bo = self.param(
-            "bo",
-            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "embed")),
-            (n_exp, d_model),
-            self.param_dtype,
-        )
+        if self.mlp_type == "swiglu":
+            wg = self.param(
+                "wg",
+                nn.with_logical_partitioning(_DENSE_INIT, ("expert", "embed", "mlp")),
+                (n_exp, d_model, self.d_ff),
+                self.param_dtype,
+            )
+            wu = self.param(
+                "wu",
+                nn.with_logical_partitioning(_DENSE_INIT, ("expert", "embed", "mlp")),
+                (n_exp, d_model, self.d_ff),
+                self.param_dtype,
+            )
+            wo = self.param(
+                "wo",
+                nn.with_logical_partitioning(
+                    _scaled_init(self.n_layers), ("expert", "mlp", "embed")
+                ),
+                (n_exp, self.d_ff, d_model),
+                self.param_dtype,
+            )
+            gate = jnp.einsum("ebcd,edf->ebcf", expert_in, wg.astype(self.dtype))
+            up = jnp.einsum("ebcd,edf->ebcf", expert_in, wu.astype(self.dtype))
+            h = nn.silu(gate) * up
+            h = nn.with_logical_constraint(
+                h, ("act_expert", "act_expert_group", None, "act_mlp")
+            )
+            expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+        elif self.mlp_type == "gelu":
+            wi = self.param(
+                "wi",
+                nn.with_logical_partitioning(_DENSE_INIT, ("expert", "embed", "mlp")),
+                (n_exp, d_model, self.d_ff),
+                self.param_dtype,
+            )
+            bi = self.param(
+                "bi",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "mlp")),
+                (n_exp, self.d_ff),
+                self.param_dtype,
+            )
+            wo = self.param(
+                "wo",
+                nn.with_logical_partitioning(
+                    _scaled_init(self.n_layers), ("expert", "mlp", "embed")
+                ),
+                (n_exp, self.d_ff, d_model),
+                self.param_dtype,
+            )
+            bo = self.param(
+                "bo",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "embed")),
+                (n_exp, d_model),
+                self.param_dtype,
+            )
 
-        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(self.dtype))
-        h = h + bi.astype(self.dtype)[:, None, None, :]
-        h = nn.with_logical_constraint(h, ("act_expert", "act_expert_group", None, "act_mlp"))
-        h = nn.gelu(h, approximate=False)
-        expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
-        expert_out = expert_out + bo.astype(self.dtype)[:, None, None, :]
+            h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(self.dtype))
+            h = h + bi.astype(self.dtype)[:, None, None, :]
+            h = nn.with_logical_constraint(h, ("act_expert", "act_expert_group", None, "act_mlp"))
+            h = nn.gelu(h, approximate=False)
+            expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+            expert_out = expert_out + bo.astype(self.dtype)[:, None, None, :]
+        else:
+            raise ValueError(
+                f"mlp_type {self.mlp_type!r} unknown; expected 'gelu' or 'swiglu'"
+            )
         expert_out = nn.with_logical_constraint(
             expert_out, ("act_expert", "act_expert_group", None, "act_embed")
         )

@@ -414,6 +414,62 @@ class TestSlidingWindowModel:
         np.testing.assert_allclose(got, want, atol=2e-4)
 
 
+class TestLlamaMoE:
+    """llama_moe (Mixtral-class): SwiGLU experts on the llama trunk."""
+
+    def _cfg(self, **extra):
+        base = _cfg(
+            n_experts=4, router_top_k=2, n_kv_heads=2, **extra
+        ).model_dump()
+        base["model"]["name"] = "llama_moe"
+        return RunConfig.model_validate(base)
+
+    def test_requires_n_experts(self):
+        from llmtrain_tpu.models.llama import LlamaMoEAdapter
+
+        cfg = _cfg().model_dump()
+        cfg["model"]["name"] = "llama_moe"
+        with pytest.raises(ValueError, match="llama_moe requires"):
+            LlamaMoEAdapter().build_model(RunConfig.model_validate(cfg))
+
+    def test_builds_llama_with_swiglu_experts(self):
+        from llmtrain_tpu.models.llama import LlamaMoEAdapter
+
+        m = LlamaMoEAdapter().build_model(self._cfg(sliding_window=8))
+        assert type(m).__name__ == "Llama"
+        assert m.n_experts == 4 and m.sliding_window == 8
+        p = _params(m)
+        moe = p["block_0"]["moe_mlp"]
+        assert set(moe) == {"router", "wg", "wu", "wo"}
+        assert "mlp_gate" not in p["block_0"]
+
+    def test_objective_includes_aux_and_loss_decreases(self):
+        initialize_registries()
+        res = Trainer(self._cfg(), None, NullTracker(), None).fit()
+        assert res.final_loss < res.first_step_loss
+
+    def test_expert_parallel_mesh_runs(self):
+        initialize_registries()
+        cfg = self._cfg(_mesh={"expert": 2, "data": 4}, _max_steps=2)
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert np.isfinite(res.final_loss)
+
+    def test_chunked_ce_composes(self):
+        from llmtrain_tpu.models.llama import LlamaMoEAdapter
+
+        ad = LlamaMoEAdapter()
+        cfg = self._cfg(loss_impl="chunked_ce", ce_chunk=16)
+        m = ad.build_model(cfg)
+        p = _params(m)
+        ids = jax.random.randint(jax.random.key(60), (2, T), 0, V)
+        batch = {
+            "input_ids": ids, "labels": ids,
+            "attention_mask": jnp.ones_like(ids),
+        }
+        ls, nt = ad.compute_loss_components(m, p, batch)
+        assert np.isfinite(np.asarray(ls)).all()
+
+
 class TestHFInterop:
     """interop/llama_hf.py structural contract (numerics pinned by
     TestHFParity, which routes through the same converter)."""
@@ -494,6 +550,23 @@ class TestHFInterop:
         sd = llama_params_to_hf_state_dict(p)
         sd["model.layers.0.self_attn.rotary_emb.inv_freq"] = np.ones(4)
         llama_params_from_hf_state_dict(sd, p)  # must not raise
+
+    def test_moe_tree_dispatches_here_and_rejects_cleanly(self):
+        """llama_moe trees are llama trees (is_llama_tree keys on
+        attn_norm), and the converter names the real limitation."""
+        from llmtrain_tpu.interop import (
+            is_llama_tree,
+            llama_params_to_hf_state_dict,
+        )
+        from llmtrain_tpu.models.llama import LlamaMoEAdapter
+
+        base = _cfg(n_experts=4, n_kv_heads=2).model_dump()
+        base["model"]["name"] = "llama_moe"
+        m = LlamaMoEAdapter().build_model(RunConfig.model_validate(base))
+        p = _params(m)
+        assert is_llama_tree(p)
+        with pytest.raises(ValueError, match="llama_moe"):
+            llama_params_to_hf_state_dict(p)
 
     def test_gpt_tree_rejected(self):
         from llmtrain_tpu.interop import llama_params_to_hf_state_dict

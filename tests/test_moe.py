@@ -63,6 +63,37 @@ class TestMoEMLP:
                 expected[b, t] = gates[b, t, e] * (h @ wo[e] + bo[e])
         np.testing.assert_allclose(out, expected, atol=1e-5)
 
+    def test_swiglu_matches_per_token_expert_computation(self):
+        """mlp_type='swiglu' (Mixtral experts): silu(x·wg)*(x·wu)·wo,
+        bias-free — per-token equivalence like the gelu test above."""
+        m = _moe(n_experts=4, capacity_factor=8.0).clone(mlp_type="swiglu")
+        x = jax.random.normal(jax.random.key(12), (2, 8, 16))
+        params = m.init(jax.random.key(13), x)["params"]
+        out = np.asarray(m.apply({"params": params}, x))
+
+        from flax.linen import meta as nn_meta
+
+        p = nn_meta.unbox(params)
+        assert set(p) == {"router", "wg", "wu", "wo"}  # no biases
+        logits = np.asarray(x) @ np.asarray(p["router"]["kernel"])
+        gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        wg, wu, wo = np.asarray(p["wg"]), np.asarray(p["wu"]), np.asarray(p["wo"])
+
+        expected = np.zeros_like(out)
+        for b in range(x.shape[0]):
+            for t in range(x.shape[1]):
+                e = int(gates[b, t].argmax())
+                xe = np.asarray(x)[b, t]
+                h = np.asarray(jax.nn.silu(jnp.asarray(xe @ wg[e]))) * (xe @ wu[e])
+                expected[b, t] = gates[b, t, e] * (h @ wo[e])
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_unknown_mlp_type_raises(self):
+        m = _moe().clone(mlp_type="relu")
+        x = jax.random.normal(jax.random.key(14), (1, 8, 16))
+        with pytest.raises(ValueError, match="mlp_type"):
+            m.init(jax.random.key(15), x)
+
     def test_capacity_drops_tokens_to_zero(self):
         """capacity_factor small enough that an oversubscribed expert drops
         tokens: dropped positions produce exactly 0 (residual carries them)."""
