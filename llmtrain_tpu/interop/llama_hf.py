@@ -173,6 +173,12 @@ def llama_params_from_hf_state_dict(sd: dict[str, Any], template: Params) -> Par
     i = 0
     while f"block_{i}" in template:
         t = template[f"block_{i}"]
+        if "moe_mlp" in t:
+            raise ValueError(
+                "Mixture-of-Experts configs (model.name llama_moe) have no "
+                "counterpart in the HF LlamaForCausalLM state-dict layout — "
+                "import is only supported for dense llama models"
+            )
         att_t = t["attn"]
         pre = f"model.layers.{i}."
         if "qkv_proj" in att_t:

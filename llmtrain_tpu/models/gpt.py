@@ -269,25 +269,39 @@ class CausalSelfAttention(nn.Module):
         """Cached causal attention: write k/v at the cursor, read the prefix.
 
         q/k/v: (B, T, H, Dh) with T = tokens appended this call. The cache
-        holds ``cache_len`` positions; rows must share one sequence length
-        (generation batches rectangular prompts, generation.py:111-120).
+        holds ``cache_len`` positions — or, under a sliding window, a
+        ROLLING buffer of ``min(cache_len, window)`` slots (the Mistral
+        serving layout): slot ``pos % C`` holds position ``pos``, so
+        per-layer KV memory is O(window) however long the generation. A
+        per-slot position buffer (stored as position+1 so the zero-init
+        cache means "empty") drives the mask instead of slot order.
+
+        Rolling-prefill caveat: a prompt longer than the window writes
+        only its last C keys, so logits at INTERIOR prefill positions
+        (whose windows reach dropped keys) are approximate — harmless for
+        generation, which samples from the final position only; its
+        window is exactly the kept set. Rows must share one sequence
+        length (generation batches rectangular prompts,
+        generation.py:111-120).
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True requires cache_len > 0 (the block size)")
         batch, t, n_heads, head_dim = q.shape
         kv_width = k.shape[2]  # n_kv_heads under GQA, else n_heads
+        rolling = bool(self.sliding_window) and self.sliding_window < self.cache_len
+        cap = min(self.cache_len, self.sliding_window) if rolling else self.cache_len
         cached_key = self.variable(
             "cache",
             "cached_key",
             jnp.zeros,
-            (batch, self.cache_len, kv_width, head_dim),
+            (batch, cap, kv_width, head_dim),
             k.dtype,
         )
         cached_value = self.variable(
             "cache",
             "cached_value",
             jnp.zeros,
-            (batch, self.cache_len, kv_width, head_dim),
+            (batch, cap, kv_width, head_dim),
             v.dtype,
         )
         cache_index = self.variable(
@@ -304,12 +318,34 @@ class CausalSelfAttention(nn.Module):
             q, k = apply_rope(
                 q, k, idx + jnp.arange(t), theta=self.rope_theta
             )
-        cached_key.value = jax.lax.dynamic_update_slice(
-            cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
-        )
-        cached_value.value = jax.lax.dynamic_update_slice(
-            cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0)
-        )
+        if rolling:
+            # Slot position+1 per slot; 0 = never written (zero-init safe —
+            # generation.py zeroes the cache tree from an eval_shape trace).
+            cached_pos1 = self.variable(
+                "cache", "cached_pos1", jnp.zeros, (cap,), jnp.int32
+            )
+            # Only the LAST `cap` tokens of this call can survive the ring;
+            # t and cap are static, so this is a static slice. Writing at
+            # most `cap` tokens keeps the scatter indices duplicate-free.
+            keep = min(t, cap)
+            pos = idx + t - keep + jnp.arange(keep)  # absolute positions kept
+            slots = pos % cap
+            cached_key.value = cached_key.value.at[:, slots].set(
+                k[:, t - keep :].astype(cached_key.value.dtype)
+            )
+            cached_value.value = cached_value.value.at[:, slots].set(
+                v[:, t - keep :].astype(cached_value.value.dtype)
+            )
+            cached_pos1.value = cached_pos1.value.at[slots].set(pos + 1)
+            col_pos = cached_pos1.value - 1  # (C,): -1 = empty slot
+        else:
+            cached_key.value = jax.lax.dynamic_update_slice(
+                cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
+            )
+            cached_value.value = jax.lax.dynamic_update_slice(
+                cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0)
+            )
+            col_pos = None
         cache_index.value = idx + t
 
         keys, values = cached_key.value, cached_value.value
@@ -324,15 +360,22 @@ class CausalSelfAttention(nn.Module):
         qg = q.reshape(batch, t, kv_width, g, head_dim)
         scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys) * scale
         scores = scores.astype(jnp.float32)
-        # Query at absolute position idx+i may see cache slots <= idx+i
-        # (and, under a sliding window, slots > idx+i - window). The cache
-        # stays full-length — windowed decode bounds the attention read,
-        # not the cache memory (a ring-buffer cache is a future win).
-        col = jnp.arange(self.cache_len)[None, None, None, None, :]
         row = (idx + jnp.arange(t))[None, None, None, :, None]
-        live = col <= row
-        if self.sliding_window:
-            live = live & (row - col < self.sliding_window)
+        if rolling:
+            # Mask by each slot's ABSOLUTE position (slot order is ring
+            # order, not sequence order): live iff written, causal, and
+            # within the window.
+            col = col_pos[None, None, None, None, :]
+            live = (col >= 0) & (col <= row) & (row - col < self.sliding_window)
+        else:
+            # Query at absolute position idx+i may see cache slots <= idx+i
+            # (and, under a window >= cache_len, the window constraint —
+            # kept for exactness even though it can only bind when the
+            # model's block_size exceeds the window).
+            col = jnp.arange(cap)[None, None, None, None, :]
+            live = col <= row
+            if self.sliding_window:
+                live = live & (row - col < self.sliding_window)
         scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)

@@ -369,6 +369,46 @@ class TestSlidingWindowModel:
         fb = full.apply({"params": p}, b, deterministic=True)
         assert np.abs(np.asarray(fa)[:, 6:] - np.asarray(fb)[:, 6:]).max() > 1e-4
 
+    def test_rolling_cache_is_window_sized(self):
+        """window < cache_len → the KV cache is a min(cache_len, W)-slot
+        ring with a per-slot position buffer: O(W) serving memory."""
+        m = _model(n_kv_heads=2, sliding_window=4).for_decoding(cache_len=16)
+        state = m.init(
+            jax.random.key(0), jnp.zeros((1, 2), jnp.int32), deterministic=True
+        )
+        cache = nn_meta.unbox(state["cache"])["block_0"]["attn"]
+        assert cache["cached_key"].shape == (1, 4, 2, D // H)
+        assert cache["cached_pos1"].shape == (4,)
+
+    def test_rolling_decode_matches_nocache_through_wraps(self):
+        """Generations several times longer than the ring: every wrap
+        must keep greedy decode identical to the uncached path."""
+        from llmtrain_tpu.generation import generate
+
+        m = _model(n_kv_heads=2, sliding_window=4)
+        p = _params(m)
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+        a = generate(m, p, prompt, max_new_tokens=12, temperature=0.0,
+                     use_cache=True)
+        b = generate(m, p, prompt, max_new_tokens=12, temperature=0.0,
+                     use_cache=False)
+        assert a.tolist() == b.tolist()
+
+    def test_rolling_prefill_longer_than_window_matches_nocache(self):
+        """Prompt (10) > window (4): the ring keeps only the last 4
+        prefill keys — the sampled continuation must still match the
+        uncached path exactly (only final-position logits are sampled)."""
+        from llmtrain_tpu.generation import generate
+
+        m = _model(sliding_window=4)
+        p = _params(m)
+        prompt = np.arange(1, 11, dtype=np.int32)[None, :]
+        a = generate(m, p, prompt, max_new_tokens=5, temperature=0.0,
+                     use_cache=True)
+        b = generate(m, p, prompt, max_new_tokens=5, temperature=0.0,
+                     use_cache=False)
+        assert a.tolist() == b.tolist()
+
     def test_adapter_rejects_window_with_ring(self):
         with pytest.raises(ValueError, match="sliding_window"):
             base = _cfg(sliding_window=4).model_dump()
@@ -447,6 +487,24 @@ class TestLlamaMoE:
         initialize_registries()
         res = Trainer(self._cfg(), None, NullTracker(), None).fit()
         assert res.final_loss < res.first_step_loss
+
+    def test_aux_loss_is_in_the_objective(self):
+        """Zero aux weight → strictly smaller objective with the same
+        params/routing: the MRO must resolve compute_loss_components to
+        the MoE adapter's aux-folding path, not the dense one."""
+        from llmtrain_tpu.models.llama import LlamaMoEAdapter
+
+        ad = LlamaMoEAdapter()
+        cfg = self._cfg()
+        m = ad.build_model(cfg)
+        p = _params(m)
+        ids = jax.random.randint(jax.random.key(61), (2, T), 0, V)
+        batch = {"input_ids": ids, "labels": ids}
+        with_aux, _ = ad.compute_loss_components(m, p, batch)
+        without, _ = ad.compute_loss_components(
+            m.clone(moe_aux_weight=0.0), p, batch
+        )
+        assert float(jnp.sum(with_aux)) > float(jnp.sum(without))
 
     def test_expert_parallel_mesh_runs(self):
         initialize_registries()
@@ -567,6 +625,11 @@ class TestHFInterop:
         assert is_llama_tree(p)
         with pytest.raises(ValueError, match="llama_moe"):
             llama_params_to_hf_state_dict(p)
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
+
+        dense_sd = llama_params_to_hf_state_dict(_params(_model(n_kv_heads=2)))
+        with pytest.raises(ValueError, match="llama_moe"):
+            llama_params_from_hf_state_dict(dense_sd, p)
 
     def test_gpt_tree_rejected(self):
         from llmtrain_tpu.interop import llama_params_to_hf_state_dict
