@@ -13,9 +13,10 @@ from __future__ import annotations
 
 from ..config.schemas import RunConfig
 
-# Knobs read from trainer.extra (training/trainer.py, training/checkpoint.py).
+# Knobs read from trainer.extra (training/trainer.py, training/checkpoint.py,
+# training/optimizer.py).
 TRAINER_EXTRA_KEYS = frozenset(
-    {"keep_last_k", "profile_start_step", "profile_num_steps"}
+    {"keep_last_k", "profile_start_step", "profile_num_steps", "optimizer"}
 )
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -94,10 +95,34 @@ def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_R
     """NamedShardings for a pytree whose leaves may carry logical metadata.
 
     Leaves without metadata (e.g. the dummy model, optimizer scalars) get
-    fully-replicated shardings.
+    fully-replicated shardings. So do leaves that inherited a param's
+    logical names but not its shape — optimizers that reduce over param
+    dims (optax.adafactor's factored ``v_row``/``v_col``, rank reduced by
+    one, and its shape-(1,) placeholders) carry the full spec through the
+    flax boxes, and applying it to the reduced array is a pjit error.
+    The repair is deliberately NARROW (spec longer than the rank, or a
+     1-element leaf): a full-rank param whose dim the mesh axis doesn't
+    divide still fails loudly at jit time instead of silently losing its
+    sharding.
     """
     logical_spec = nn.get_partition_spec(abstract_tree)
-    return nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+
+    def finalize(sharding: Any, leaf: Any) -> Any:
+        value = nn.meta.unbox(leaf)
+        shape = getattr(value, "shape", None)
+        if shape is None or not isinstance(sharding, NamedSharding):
+            return sharding
+        if len(sharding.spec) > len(shape) or tuple(shape) == (1,):
+            return replicated(mesh)
+        return sharding
+
+    return jax.tree.map(
+        finalize,
+        shardings,
+        abstract_tree,
+        is_leaf=lambda s: isinstance(s, NamedSharding),
+    )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -40,18 +40,78 @@ def lr_schedule(cfg: TrainerConfig) -> optax.Schedule:
 
 
 def build_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
-    """clip-by-global-norm → AdamW with the warmup-cosine schedule.
+    """clip-by-global-norm → {AdamW | Adafactor} with the warmup-cosine
+    schedule.
 
-    AdamW hyperparams match torch defaults (betas 0.9/0.999, eps 1e-8) so the
-    optimizer trajectory is comparable to the reference.
+    ``trainer.extra.optimizer`` selects the update rule:
+
+    * ``"adamw"`` (default) — hyperparams match torch defaults (betas
+      0.9/0.999, eps 1e-8) so the optimizer trajectory is comparable to
+      the reference (tests/test_torch_parity.py pins it).
+    * ``"adafactor"`` — the TPU-classic memory-efficient optimizer: the
+      second moment is stored FACTORED (row+column running averages,
+      O(n+m) per (n, m) matrix instead of O(n·m)) and first-moment
+      momentum is off, cutting optimizer state from 2x params (AdamW) to
+      ~per-row/column vectors. The right trade when params (not
+      activations) bound HBM — e.g. large-vocab embeddings under FSDP.
+      ``weight_decay`` keeps AdamW's decoupled semantics — the decay is
+      scaled by the CURRENT scheduled lr (optax.adafactor's own
+      ``weight_decay_rate`` would apply ``wd*param`` per step unscaled:
+      the schema default 0.1 would shrink params 10%/step and destroy
+      training). ``max_grad_norm`` still applies (outer clip).
     """
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adamw(
-            learning_rate=lr_schedule(cfg),
+    name = str(cfg.extra.get("optimizer", "adamw"))
+    schedule = lr_schedule(cfg)
+    if name == "adamw":
+        opt = optax.adamw(
+            learning_rate=schedule,
             b1=0.9,
             b2=0.999,
             eps=1e-8,
             weight_decay=cfg.weight_decay,
-        ),
-    )
+        )
+    elif name == "adafactor":
+        opt = optax.adafactor(
+            learning_rate=schedule,
+            multiply_by_parameter_scale=False,
+            clipping_threshold=1.0,
+            weight_decay_rate=None,
+        )
+        if cfg.weight_decay:
+            opt = optax.chain(
+                opt, _scheduled_decoupled_decay(cfg.weight_decay, schedule)
+            )
+    else:
+        raise ValueError(
+            f"trainer.extra.optimizer {name!r} unknown; expected 'adamw' "
+            "or 'adafactor'"
+        )
+    return optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm), opt)
+
+
+def _scheduled_decoupled_decay(
+    weight_decay: float, schedule: optax.Schedule
+) -> optax.GradientTransformation:
+    """AdamW-style decoupled weight decay: ``-lr(t) * wd * param`` added
+    to the (already lr-scaled) updates — matching how optax.adamw scales
+    its decay by the schedule, so the trainer's ``weight_decay`` value
+    means the same thing under both optimizers."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        del params
+        return optax.ScaleByScheduleState(count=jnp.zeros([], jnp.int32))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("weight decay needs params in the update call")
+        lr = schedule(state.count)
+        updates = jax.tree.map(
+            lambda u, p: u - lr * weight_decay * p, updates, params
+        )
+        return updates, optax.ScaleByScheduleState(
+            count=optax.safe_int32_increment(state.count)
+        )
+
+    return optax.GradientTransformation(init, update)
