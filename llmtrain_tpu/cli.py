@@ -132,6 +132,23 @@ def build_parser() -> argparse.ArgumentParser:
         "bound; tools/diag_decode.py attribution); 'param' keeps the "
         "checkpoint's master precision",
     )
+    gen.add_argument(
+        "--draft-config",
+        default=None,
+        help="YAML config of a DRAFT model for speculative decoding "
+        "(requires --draft-from; same tokenizer/vocab as the target)",
+    )
+    gen.add_argument(
+        "--draft-from",
+        default=None,
+        help="checkpoint file, dir, or run id for the draft model's params",
+    )
+    gen.add_argument(
+        "--gamma",
+        type=int,
+        default=4,
+        help="speculative lookahead: draft tokens proposed per target forward",
+    )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
     evalp = sub.add_parser(
@@ -617,6 +634,68 @@ def _handle_eval(args: argparse.Namespace) -> int:
         return EXIT_TRAIN_FAILURE
 
 
+def _prepare_decode_model(model, params, decode_param_dtype: str, logger, label=""):
+    """Inference-load post-processing shared by the target and draft paths.
+
+    * Pipeline-trained runs decode through the equivalent plain GPT
+      (interop/pipeline_convert.py — same math), which has the KV-cache
+      path; the stacked model would fall back to the windowed re-forward
+      loop. The rebuild keeps the validated attention impl so a flash
+      config doesn't revert to dense and materialize (T, T).
+    * ``decode_param_dtype == "compute"`` casts floating params to the
+      model compute dtype — decode is weight-bandwidth bound and a bf16
+      model reading f32 weights pays 2x the bytes (tools/diag_decode.py).
+      Models without a dtype/param_dtype split (e.g. dummy_gpt) have
+      nothing to cast.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .interop import is_pipeline_tree, pipeline_params_to_gpt
+
+    if is_pipeline_tree(params):
+        from .models.gpt import GPT
+
+        params = pipeline_params_to_gpt(params)
+        model = GPT(
+            vocab_size=model.vocab_size,
+            block_size=model.block_size,
+            d_model=model.d_model,
+            n_layers=model.n_layers,
+            n_heads=model.n_heads,
+            d_ff=model.d_ff,
+            dropout=0.0,
+            tie_embeddings=model.tie_embeddings,
+            dtype=model.dtype,
+            param_dtype=model.param_dtype,
+            attention=model.attention,
+            n_kv_heads=model.n_kv_heads,
+        )
+        logger.info(
+            "%spipeline checkpoint converted to the gpt tree for KV-cache "
+            "decoding",
+            label,
+        )
+
+    if decode_param_dtype == "compute":
+        if getattr(model, "dtype", None) is not None and (
+            model.dtype != getattr(model, "param_dtype", model.dtype)
+        ):
+            params = jax.tree.map(
+                lambda a: a.astype(model.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                params,
+            )
+            logger.info(
+                "%scast floating params to %s for decode (--decode-param-dtype "
+                "param keeps the checkpoint's master precision)",
+                label,
+                jnp.dtype(model.dtype).name,
+            )
+    return model, params
+
+
 def _handle_generate(args: argparse.Namespace) -> int:
     """First-class serving path: checkpoint → jit-compiled sampling.
 
@@ -635,6 +714,18 @@ def _handle_generate(args: argparse.Namespace) -> int:
     configure_compilation_cache()
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
+
+    # Fail fast on inconsistent speculative flags — before any expensive
+    # model/checkpoint work.
+    if (args.draft_config is None) != (args.draft_from is None):
+        _emit_error("--draft-config and --draft-from must be given together")
+        return EXIT_CONFIG_ERROR
+    if args.draft_config is not None and args.eos_token_id is not None:
+        _emit_error("speculative decoding does not support --eos-token-id")
+        return EXIT_CONFIG_ERROR
+    if args.draft_config is not None and args.gamma < 1:
+        _emit_error(f"--gamma must be >= 1, got {args.gamma}")
+        return EXIT_CONFIG_ERROR
 
     # Fail fast on a bad prompts file — before the expensive registry/
     # tokenizer/model build, and with a clean error instead of a traceback.
@@ -700,68 +791,73 @@ def _handle_generate(args: argparse.Namespace) -> int:
         if any(ids.size == 0 for ids in prompt_batches):
             _emit_error("every prompt must contain at least one token")
             return EXIT_TRAIN_FAILURE
+        if args.draft_config is not None:
+            # Fail fast on a prompt that cannot fit the speculative
+            # buffer — before any checkpoint I/O.
+            longest = max(len(ids) for ids in prompt_batches)
+            need = longest + args.max_new_tokens + args.gamma + 1
+            if need > cfg.model.block_size:
+                _emit_error(
+                    f"prompt+max_new_tokens+gamma ({need}) exceeds the "
+                    f"target model's block_size ({cfg.model.block_size})"
+                )
+                return EXIT_CONFIG_ERROR
 
         ckpt_path, params, step = _load_checkpoint_params(
             cfg, adapter, model, args.from_spec
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
+        model, params = _prepare_decode_model(
+            model, params, args.decode_param_dtype, logger
+        )
 
-        from .interop import is_pipeline_tree, pipeline_params_to_gpt
-
-        if is_pipeline_tree(params):
-            # Pipeline-trained run: decode through the equivalent plain GPT
-            # (interop/pipeline_convert.py — same math), which has the
-            # KV-cache path; the stacked model would fall back to the
-            # windowed re-forward loop.
-            from .models.gpt import GPT
-
-            params = pipeline_params_to_gpt(params)
-            model = GPT(
-                vocab_size=model.vocab_size,
-                block_size=model.block_size,
-                d_model=model.d_model,
-                n_layers=model.n_layers,
-                n_heads=model.n_heads,
-                d_ff=model.d_ff,
-                dropout=0.0,
-                tie_embeddings=model.tie_embeddings,
-                dtype=model.dtype,
-                param_dtype=model.param_dtype,
-                # Keep the validated attention impl: the windowed re-forward
-                # path (outputs beyond block_size) would otherwise revert a
-                # flash config to dense and materialize (T, T).
-                attention=model.attention,
-                n_kv_heads=model.n_kv_heads,
+        # --- speculative decoding: load the draft model, then decode each
+        # prompt via draft-and-verify (speculative.py). Exact w.r.t. the
+        # target: greedy output is bit-identical, sampling follows the
+        # target's distribution.
+        draft = None
+        if args.draft_config is not None:
+            try:
+                draft_cfg, _, _ = load_and_validate_config(args.draft_config)
+            except ConfigLoadError as exc:
+                _emit_error(exc.message, details=exc.details, errors=exc.errors)
+                return EXIT_CONFIG_ERROR
+            draft_adapter = get_model_adapter(draft_cfg.model.name)()
+            draft_model = draft_adapter.build_model(draft_cfg)
+            draft_ckpt, draft_params, draft_step = _load_checkpoint_params(
+                draft_cfg, draft_adapter, draft_model, args.draft_from
             )
             logger.info(
-                "pipeline checkpoint converted to the gpt tree for KV-cache "
-                "decoding"
+                "loaded draft checkpoint %s (step %d)", draft_ckpt, draft_step
             )
-
-        if args.decode_param_dtype == "compute":
-            import jax.numpy as jnp
-
-            # Models without a dtype/param_dtype split (e.g. dummy_gpt)
-            # have nothing to cast.
-            if getattr(model, "dtype", None) is not None and (
-                model.dtype != getattr(model, "param_dtype", model.dtype)
-            ):
-                params = jax.tree.map(
-                    lambda a: a.astype(model.dtype)
-                    if jnp.issubdtype(a.dtype, jnp.floating)
-                    else a,
-                    params,
+            draft_model, draft_params = _prepare_decode_model(
+                draft_model, draft_params, args.decode_param_dtype, logger,
+                label="draft ",
+            )
+            if draft_model.vocab_size != model.vocab_size:
+                _emit_error(
+                    f"draft vocab_size ({draft_model.vocab_size}) != target "
+                    f"vocab_size ({model.vocab_size}) — speculative decoding "
+                    "needs a shared vocabulary"
                 )
-                logger.info(
-                    "cast floating params to %s for decode (--decode-param-dtype "
-                    "param keeps the checkpoint's master precision)",
-                    jnp.dtype(model.dtype).name,
-                )
+                return EXIT_CONFIG_ERROR
+            draft = (draft_model, draft_params)
 
         eos_token_id = args.eos_token_id
         if eos_token_id is None and tokenizer is not None:
             # tiktoken encodings expose the end-of-text id as eot_token.
             eos_token_id = getattr(tokenizer, "eot_token", None)
+        if draft is not None and eos_token_id is not None:
+            # Not silent: a tokenizer-derived EOS means the plain path
+            # would stop early while the speculative path cannot — the
+            # outputs WILL differ past the first EOS.
+            logger.warning(
+                "eos early-stop (token %s) is disabled under speculative "
+                "decoding; output continues past EOS and may differ from a "
+                "plain `generate` run, which stops there",
+                eos_token_id,
+            )
+            eos_token_id = None
 
         # Batch per prompt length: generate() takes a rectangular (B, Tp)
         # batch, so equal-length prompts share ONE compiled decode loop.
@@ -771,19 +867,52 @@ def _handle_generate(args: argparse.Namespace) -> int:
         results: list[dict] = [{} for _ in prompt_batches]
         for tp, idxs in sorted(by_len.items()):
             stacked = np.stack([prompt_batches[i] for i in idxs])
-            out = generate(
-                model,
-                params,
-                stacked,
-                max_new_tokens=args.max_new_tokens,
-                # Fold the length-group in so different groups don't draw
-                # from identical sample streams at each decode step.
-                rng=jax.random.fold_in(jax.random.key(args.seed), tp),
-                temperature=args.temperature,
-                top_k=args.top_k,  # generate() maps <=0 to "disabled"
-                top_p=args.top_p,
-                eos_token_id=eos_token_id,
-            )
+            if draft is not None:
+                from .speculative import speculative_generate
+
+                # speculative_generate is batch-1: decode the group's
+                # rows one at a time (same compiled program per length).
+                rows = [
+                    speculative_generate(
+                        model,
+                        params,
+                        draft[0],
+                        draft[1],
+                        stacked[row : row + 1],
+                        max_new_tokens=args.max_new_tokens,
+                        gamma=args.gamma,
+                        temperature=args.temperature,
+                        top_k=args.top_k if args.top_k > 0 else None,
+                        # generate()'s convention: 0 or 1 disables nucleus.
+                        top_p=(
+                            args.top_p
+                            if args.top_p is not None and 0 < args.top_p < 1
+                            else None
+                        ),
+                        # Two folds (group, then row): collision-free
+                        # streams however large a prompt-length group is.
+                        rng=jax.random.fold_in(
+                            jax.random.fold_in(jax.random.key(args.seed), tp),
+                            row,
+                        ),
+                    )
+                    for row in range(stacked.shape[0])
+                ]
+                out = np.concatenate(rows, axis=0)
+            else:
+                out = generate(
+                    model,
+                    params,
+                    stacked,
+                    max_new_tokens=args.max_new_tokens,
+                    # Fold the length-group in so different groups don't draw
+                    # from identical sample streams at each decode step.
+                    rng=jax.random.fold_in(jax.random.key(args.seed), tp),
+                    temperature=args.temperature,
+                    top_k=args.top_k,  # generate() maps <=0 to "disabled"
+                    top_p=args.top_p,
+                    eos_token_id=eos_token_id,
+                )
             for row, i in enumerate(idxs):
                 output_ids = [int(t) for t in out[row]]
                 results[i] = {

@@ -26,22 +26,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _sample_next(
-    next_logits: jax.Array,  # (B, V) float32
-    rng: jax.Array,
-    i: jax.Array | int,
+def filter_logits(
+    scaled: jax.Array,  # (..., V), already temperature-scaled
     *,
-    temperature: float,
     top_k: int | None,
-    top_p: float | None = None,
+    top_p: float | None,
 ) -> jax.Array:
-    """One sampling decision, shared by both decode paths."""
-    if temperature == 0.0:
-        return jnp.argmax(next_logits, axis=-1)
-    scaled = next_logits / temperature
+    """top-k / nucleus masking (-inf outside the kept set).
+
+    THE single filtering implementation: `_sample_next` below and
+    speculative decoding (speculative.py) both use it — the speculative
+    exactness contract requires the target's plain sampling and both
+    models' speculative distributions to be filtered identically.
+    """
     if top_k is not None:
         k = min(top_k, scaled.shape[-1])
-        kth = jax.lax.top_k(scaled, k)[0][:, -1, None]
+        kth = jax.lax.top_k(scaled, k)[0][..., -1, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     if top_p is not None and top_p < 1.0:
         # Nucleus: keep the smallest prefix of the descending-prob order
@@ -55,6 +55,22 @@ def _sample_next(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
+    return scaled
+
+
+def _sample_next(
+    next_logits: jax.Array,  # (B, V) float32
+    rng: jax.Array,
+    i: jax.Array | int,
+    *,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """One sampling decision, shared by both decode paths."""
+    if temperature == 0.0:
+        return jnp.argmax(next_logits, axis=-1)
+    scaled = filter_logits(next_logits / temperature, top_k=top_k, top_p=top_p)
     return jax.random.categorical(jax.random.fold_in(rng, i), scaled, axis=-1)
 
 

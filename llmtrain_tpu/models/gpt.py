@@ -94,6 +94,13 @@ class CausalSelfAttention(nn.Module):
     # paths; ring/ulysses reject it loudly (a windowed ring schedule is a
     # different algorithm — most hops would carry dead shards).
     sliding_window: int = 0
+    # Extra rolling-cache slots beyond the window (decode only).
+    # Speculative decoding (speculative.py) writes up to gamma+1 positions
+    # that may be ROLLED BACK; in a W-slot ring those writes would evict
+    # live window entries rollback cannot restore. With W+gamma+1 slots
+    # every evicted position is provably outside all future queries'
+    # windows (evicted = p - C <= row - W).
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(
@@ -288,8 +295,9 @@ class CausalSelfAttention(nn.Module):
             raise ValueError("decode=True requires cache_len > 0 (the block size)")
         batch, t, n_heads, head_dim = q.shape
         kv_width = k.shape[2]  # n_kv_heads under GQA, else n_heads
-        rolling = bool(self.sliding_window) and self.sliding_window < self.cache_len
-        cap = min(self.cache_len, self.sliding_window) if rolling else self.cache_len
+        ring = (self.sliding_window + self.ring_slack) if self.sliding_window else 0
+        rolling = bool(ring) and ring < self.cache_len
+        cap = ring if rolling else self.cache_len
         cached_key = self.variable(
             "cache",
             "cached_key",
@@ -441,6 +449,7 @@ class TransformerBlock(nn.Module):
     n_kv_heads: int = 0  # grouped-query attention (see CausalSelfAttention)
     assume_packed: bool = False  # drop the flash mask operand (packed data)
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
+    ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -474,6 +483,7 @@ class TransformerBlock(nn.Module):
             n_kv_heads=self.n_kv_heads,
             assume_packed=self.assume_packed,
             sliding_window=self.sliding_window,
+            ring_slack=self.ring_slack,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -564,20 +574,30 @@ class GPT(nn.Module):
     # attends its trailing W keys — O(T·W) attention compute on the flash
     # path. 0 = full causal.
     sliding_window: int = 0
+    # Extra rolling-cache slots for speculative decode rollback safety
+    # (see CausalSelfAttention.ring_slack); set via for_decoding().
+    ring_slack: int = 0
 
-    def for_decoding(self, cache_len: int | None = None) -> "GPT":
+    def for_decoding(
+        self, cache_len: int | None = None, *, ring_slack: int = 0
+    ) -> "GPT":
         """Clone configured for cached autoregressive decoding.
 
         Same parameter structure (params transfer 1:1); remat is dropped —
         it trades FLOPs for training memory and would re-run cache writes.
         ``cache_len`` sizes the per-layer KV cache to the actual output
         length (capped at ``block_size``) so short generations don't pay
-        O(block_size) HBM and attention per step.
+        O(block_size) HBM and attention per step. ``ring_slack`` widens a
+        windowed model's rolling cache for speculative-rollback safety
+        (speculative.py passes gamma+1).
         """
         if cache_len is None:
             cache_len = self.block_size
         return self.clone(
-            decode=True, remat=False, decode_cache_len=min(cache_len, self.block_size)
+            decode=True,
+            remat=False,
+            decode_cache_len=min(cache_len, self.block_size),
+            ring_slack=ring_slack,
         )
 
     @nn.compact
@@ -657,6 +677,7 @@ class GPT(nn.Module):
                 n_kv_heads=self.n_kv_heads,
                 assume_packed=self.assume_packed,
                 sliding_window=self.sliding_window,
+                ring_slack=self.ring_slack if self.decode else 0,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,

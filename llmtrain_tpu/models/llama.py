@@ -88,6 +88,7 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
+    ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
     # mlp_type="swiglu" — the Mixtral layout); 0 = dense SwiGLU.
     n_experts: int = 0
@@ -127,6 +128,7 @@ class LlamaBlock(nn.Module):
             rope=True,
             rope_theta=self.rope_theta,
             sliding_window=self.sliding_window,
+            ring_slack=self.ring_slack,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -206,6 +208,9 @@ class Llama(nn.Module):
     # Sliding-window attention (model.extra.sliding_window, the Mistral
     # architecture knob): O(T·W) attention on the flash path.
     sliding_window: int = 0
+    # Extra rolling-cache slots for speculative decode rollback safety
+    # (models/gpt.py CausalSelfAttention.ring_slack).
+    ring_slack: int = 0
     # Mixture-of-Experts with SwiGLU experts (model.name llama_moe — the
     # Mixtral architecture); 0 = dense SwiGLU MLPs.
     n_experts: int = 0
@@ -213,13 +218,18 @@ class Llama(nn.Module):
     moe_aux_weight: float = 0.01
     router_top_k: int = 1
 
-    def for_decoding(self, cache_len: int | None = None) -> "Llama":
+    def for_decoding(
+        self, cache_len: int | None = None, *, ring_slack: int = 0
+    ) -> "Llama":
         """Clone configured for cached autoregressive decoding (same
         contract as GPT.for_decoding — generation.py dispatches on it)."""
         if cache_len is None:
             cache_len = self.block_size
         return self.clone(
-            decode=True, remat=False, decode_cache_len=min(cache_len, self.block_size)
+            decode=True,
+            remat=False,
+            decode_cache_len=min(cache_len, self.block_size),
+            ring_slack=ring_slack,
         )
 
     @nn.compact
@@ -283,6 +293,7 @@ class Llama(nn.Module):
                 rope_theta=self.rope_theta,
                 rms_norm_eps=self.rms_norm_eps,
                 sliding_window=self.sliding_window,
+                ring_slack=self.ring_slack if self.decode else 0,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,

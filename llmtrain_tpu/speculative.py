@@ -1,0 +1,309 @@
+"""Speculative decoding: draft-and-verify over two KV-cache models.
+
+Beyond-reference serving capability (the reference generates eagerly per
+token from one model, notebooks/trained_vs_random_completion.ipynb). A
+small DRAFT model proposes ``gamma`` tokens autoregressively; the TARGET
+model scores all of them in ONE forward; the longest agreeing prefix is
+accepted and the first disagreement is replaced by the target's own
+token. Per target forward the decode advances by 1..gamma+1 positions,
+so target-model latency per token drops by up to (gamma+1)x when the
+draft agrees — and the output is EXACT:
+
+* ``temperature == 0``: acceptance is argmax equality, and the result is
+  bit-identical to plain greedy decoding from the target alone (pinned
+  by tests for dense, GQA, rolling-window, and llama models).
+* ``temperature > 0``: standard speculative rejection sampling
+  (Leviathan et al. / Chen et al., PAPERS.md): draft token x with
+  draft prob q(x) and target prob p(x) is accepted w.p. min(1, p/q);
+  on rejection the replacement is drawn from norm(max(p - q, 0)). The
+  marginal distribution of every emitted token equals sampling from the
+  target alone — same temperature/top-k/top-p filtering applied to both
+  models' logits.
+
+TPU-first mechanics: the whole loop is ONE jit program — a
+``lax.while_loop`` whose carry is (token buffer, position, both cache
+pytrees, rng, step counter). Acceptance length is data-dependent, but
+shapes never are: the target always scores gamma+1 positions, the buffer
+write is always gamma+1 wide (garbage beyond the accepted prefix is
+overwritten by later iterations), and cache rollback is CURSOR-ONLY —
+stale K/V slots beyond the cursor are unreachable (causal masking
+excludes positions > query) and are overwritten in order before any
+query can see them, for both the linear and the rolling (windowed)
+cache layouts (models/gpt.py:_decode_attention).
+
+Scope: batch size 1 (per-row acceptance lengths would need per-row
+cursors); ``eos_token_id`` is not supported (an eos-conditioned
+continuation would diverge from the single-model path). Both are
+validated loudly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _set_cursor(cache: Any, value: jax.Array) -> Any:
+    """Return ``cache`` with every cursor leaf set to ``value``.
+
+    Cursor leaves: per-layer ``cache_index`` and GPT's model-level
+    ``position_index`` (models/gpt.py) — scalar int32 counters.
+    """
+
+    def set_leaf(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cache_index", "position_index"):
+            return jnp.asarray(value, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+
+def _filtered_logprobs(
+    logits: jax.Array, *, temperature: float, top_k: int | None, top_p: float | None
+) -> jax.Array:
+    """Log-probs after the SAME temperature/top-k/top-p filter the plain
+    sampler applies — shared implementation (generation.filter_logits),
+    so the exactness contract cannot drift between the two modules."""
+    from .generation import filter_logits
+
+    scaled = filter_logits(
+        logits.astype(jnp.float32) / temperature, top_k=top_k, top_p=top_p
+    )
+    return jax.nn.log_softmax(scaled, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "draft_model", "max_new_tokens", "gamma", "temperature",
+        "top_k", "top_p",
+    ),
+)
+def _speculative_jit(
+    model: Any,
+    params: Any,
+    cache: Any,
+    draft_model: Any,
+    draft_params: Any,
+    draft_cache: Any,
+    prompt: jax.Array,  # (1, Tp)
+    rng: jax.Array,
+    *,
+    max_new_tokens: int,
+    gamma: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+) -> jax.Array:
+    tp = prompt.shape[1]
+    total = tp + max_new_tokens
+    # Room for one full overshooting iteration past `total`.
+    buf = jnp.zeros((1, total + gamma + 1), prompt.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def apply(m, p, c, tokens):
+        logits, mutated = m.apply(
+            {"params": p, "cache": c}, tokens, deterministic=True,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits.astype(jnp.float32)
+
+    # Establish the loop invariant (caches hold tokens 0..n-2, cursor
+    # n-1, with n = tp): prefill both models on the prompt MINUS its
+    # last token, which the first iteration feeds as its context token.
+    if tp > 1:
+        cache, _ = apply(model, params, cache, prompt[:, :-1])
+        draft_cache, _ = apply(
+            draft_model, draft_params, draft_cache, prompt[:, :-1]
+        )
+
+    greedy = temperature == 0.0
+
+    def body(carry):
+        buf, n, cache, draft_cache, it = carry
+        step_rng = jax.random.fold_in(rng, it)
+
+        # --- draft: gamma tokens; sampling mode also carries the FULL
+        # filtered q vector per step (gamma, V) — the rejection-sampling
+        # leftover distribution norm(max(p - q, 0)) needs it.
+        def draft_step(state, j):
+            dcache, tok = state
+            dcache, logits = apply(
+                draft_model, draft_params, dcache, tok[:, None]
+            )
+            logit = logits[:, 0]  # (1, V)
+            if greedy:
+                nxt = jnp.argmax(logit, axis=-1)
+                aux = jnp.zeros((1,))
+            else:
+                lq = _filtered_logprobs(
+                    logit, temperature=temperature, top_k=top_k, top_p=top_p
+                )
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(step_rng, j), lq, axis=-1
+                )
+                aux = lq[0]
+            return (dcache, nxt.astype(tok.dtype)), (nxt[0], aux)
+
+        tok_in = jax.lax.dynamic_slice(buf, (0, n - 1), (1, 1))[:, 0]
+        (draft_cache, _), (drafts, q_aux) = jax.lax.scan(
+            draft_step, (draft_cache, tok_in), jnp.arange(gamma)
+        )  # drafts: (gamma,); q_aux: (gamma, V) logprobs (or (gamma, 1))
+
+        # --- target: ONE forward over [context token, d_0..d_{gamma-1}].
+        seq = jnp.concatenate(
+            [tok_in.astype(buf.dtype), drafts.astype(buf.dtype)]
+        )[None, :]  # (1, gamma+1)
+        cache, t_logits = apply(model, params, cache, seq)  # (1, gamma+1, V)
+
+        if greedy:
+            t_pred = jnp.argmax(t_logits[0], axis=-1)  # (gamma+1,)
+            match = drafts == t_pred[:gamma]
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+            # t_pred[j] == drafts[j] for j < accepted, and t_pred[accepted]
+            # is the correction — one write covers both.
+            out_tokens = t_pred
+        else:
+            lp = _filtered_logprobs(
+                t_logits, temperature=temperature, top_k=top_k, top_p=top_p
+            )[0]  # (gamma+1, V)
+            p_chosen = jnp.take_along_axis(
+                lp[:gamma], drafts[:, None], axis=-1
+            )[:, 0]
+            q_chosen = jnp.take_along_axis(q_aux, drafts[:, None], axis=-1)[:, 0]
+            # Accept d_j w.p. min(1, p/q); a draft token the target filter
+            # removed (p = -inf) is always rejected.
+            uniforms = jax.random.uniform(
+                jax.random.fold_in(step_rng, gamma + 1), (gamma,)
+            )
+            ratio = jnp.exp(jnp.minimum(p_chosen - q_chosen, 0.0))
+            ratio = jnp.where(jnp.isfinite(p_chosen), ratio, 0.0)
+            ok = uniforms < ratio
+            accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+            # Replacement at the first rejection: norm(max(p - q, 0));
+            # padding q with zeros at j = gamma makes the all-accepted
+            # case a fresh draw from p_gamma via the same expression.
+            p_all = jnp.exp(lp)  # (gamma+1, V)
+            q_all = jnp.concatenate(
+                [jnp.exp(q_aux), jnp.zeros((1, q_aux.shape[-1]))], axis=0
+            )
+            leftover = jnp.clip(p_all - q_all, 0.0, None)  # (gamma+1, V)
+            row = leftover[accepted]
+            norm = jnp.sum(row)
+            # norm == 0 only when p <= q everywhere (then rejection had
+            # probability 0); numerical guard falls back to p.
+            row = jnp.where(norm > 0, row / jnp.maximum(norm, 1e-38),
+                            p_all[accepted])
+            correction = jax.random.categorical(
+                jax.random.fold_in(step_rng, gamma + 2),
+                jnp.log(row + 1e-38),
+            ).astype(drafts.dtype)
+            base = jnp.concatenate([drafts, drafts[:1]])  # (gamma+1,)
+            out_tokens = jnp.where(
+                jnp.arange(gamma + 1) == accepted, correction, base
+            )
+
+        # --- write to positions n..n+gamma; only n..n+accepted are valid
+        # (later iterations overwrite the rest); advance by accepted+1.
+        buf = jax.lax.dynamic_update_slice(
+            buf, out_tokens[None].astype(buf.dtype), (0, n)
+        )
+        n_new = n + accepted + 1
+        cache = _set_cursor(cache, n_new - 1)
+        draft_cache = _set_cursor(draft_cache, n_new - 1)
+        return buf, n_new, cache, draft_cache, it + 1
+
+    def cond(carry):
+        _, n, _, _, _ = carry
+        return n < total
+
+    buf, n, _, _, _ = jax.lax.while_loop(
+        cond, body, (buf, jnp.asarray(tp, jnp.int32), cache, draft_cache,
+                     jnp.asarray(0, jnp.int32))
+    )
+    return buf[:, :total]
+
+
+def speculative_generate(
+    model: Any,
+    params: Any,
+    draft_model: Any,
+    draft_params: Any,
+    prompt: np.ndarray | jax.Array,
+    *,
+    max_new_tokens: int,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
+) -> np.ndarray:
+    """Draft-and-verify decode; returns (1, Tp + max_new_tokens) tokens.
+
+    ``model``/``draft_model`` are TRAINING-mode modules exposing
+    ``for_decoding()`` (GPT/Llama families); both must share the
+    tokenizer/vocab. ``gamma`` is the draft lookahead per target forward.
+    """
+    ids = np.asarray(prompt)
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding supports batch size 1, got shape {ids.shape}"
+        )
+    if max_new_tokens <= 0:
+        return ids.copy()
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    for m, label in ((model, "model"), (draft_model, "draft_model")):
+        if not hasattr(m, "for_decoding"):
+            raise ValueError(f"{label} must expose for_decoding() for KV caching")
+    total = ids.shape[1] + max_new_tokens
+    for m, label in ((model, "target"), (draft_model, "draft")):
+        if total + gamma + 1 > m.block_size:
+            raise ValueError(
+                f"prompt+max_new_tokens+gamma ({total + gamma + 1}) exceeds the "
+                f"{label} model's block_size ({m.block_size})"
+            )
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def zero_cache(m):
+        # ring_slack=gamma+1: a windowed model's rolling cache needs the
+        # slack so rolled-back speculative writes cannot evict live
+        # window entries (CausalSelfAttention.ring_slack).
+        dm = m.for_decoding(cache_len=total + gamma + 1, ring_slack=gamma + 1)
+        shapes = jax.eval_shape(
+            lambda: dm.init(
+                jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
+                deterministic=True,
+            )
+        )
+        return dm, jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+        )
+
+    decode_model, cache = zero_cache(model)
+    decode_draft, draft_cache = zero_cache(draft_model)
+    out = _speculative_jit(
+        decode_model,
+        params,
+        cache,
+        decode_draft,
+        draft_params,
+        draft_cache,
+        jnp.asarray(ids),
+        rng,
+        max_new_tokens=max_new_tokens,
+        gamma=gamma,
+        temperature=float(temperature),
+        top_k=top_k,
+        top_p=top_p,
+    )
+    return np.asarray(jax.device_get(out))
+
+
+__all__ = ["speculative_generate"]

@@ -293,6 +293,47 @@ class TestGenerate:
         for proc in (cast, kept):
             assert len(json.loads(proc.stdout)["completion_ids"]) == 3
 
+    def test_speculative_generate_matches_plain_greedy(self, workdir):
+        """--draft-config/--draft-from: greedy speculative output through
+        the CLI is bit-identical to the plain greedy path."""
+        tgt = {
+            **CFG,
+            "model": {
+                "name": "gpt", "block_size": 32, "d_model": 32,
+                "n_layers": 2, "n_heads": 2, "d_ff": 64, "dropout": 0.0,
+                "vocab_size": 32,
+            },
+        }
+        drf = {**tgt, "model": {**tgt["model"], "n_layers": 1, "d_model": 16,
+                                "d_ff": 32}}
+        (workdir / "tgt.yaml").write_text(yaml.safe_dump(tgt))
+        (workdir / "drf.yaml").write_text(yaml.safe_dump(drf))
+        for cfg_name, rid in (("tgt.yaml", "runT"), ("drf.yaml", "runD")):
+            proc = _run(["train", "--config", cfg_name, "--json",
+                         "--run-id", rid], workdir)
+            assert proc.returncode == 0, proc.stderr
+        base = ["generate", "--config", "tgt.yaml", "--from", "runT",
+                "--prompt-ids", "1,2,3", "--max-new-tokens", "8",
+                "--temperature", "0", "--json"]
+        plain = _run(base, workdir)
+        assert plain.returncode == 0, plain.stderr
+        spec = _run([*base, "--draft-config", "drf.yaml", "--draft-from",
+                     "runD", "--gamma", "3"], workdir)
+        assert spec.returncode == 0, spec.stderr
+        assert (
+            json.loads(spec.stdout)["completion_ids"]
+            == json.loads(plain.stdout)["completion_ids"]
+        )
+
+    def test_speculative_flags_must_pair(self, workdir):
+        proc = _run(
+            ["generate", "--config", "config.yaml", "--from", "nope",
+             "--prompt-ids", "1", "--draft-config", "config.yaml"],
+            workdir,
+        )
+        assert proc.returncode == 2
+        assert "together" in proc.stderr
+
     def test_generate_eos_token_stops_early(self, workdir):
         """--eos-token-id is wired through to generate(): once the EOS token
         is produced, the rest of the completion is EOS-filled (ADVICE r1)."""

@@ -1,0 +1,178 @@
+"""Speculative decoding (llmtrain_tpu/speculative.py).
+
+The exactness contract IS the test strategy: greedy speculative output
+must be bit-identical to plain greedy decoding from the target alone —
+for any draft model, any gamma, any family/cache layout — and sampled
+speculative output must follow the target's sampling distribution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.generation import generate
+from llmtrain_tpu.models.gpt import GPT
+from llmtrain_tpu.models.llama import Llama
+from llmtrain_tpu.speculative import speculative_generate
+
+V = 32
+
+
+def _gpt(n_layers=2, d_model=32, seed=0, **kw):
+    m = GPT(
+        vocab_size=V, block_size=64, d_model=d_model, n_layers=n_layers,
+        n_heads=4, d_ff=2 * d_model, dropout=0.0, **kw,
+    )
+    p = nn_meta.unbox(
+        m.init(jax.random.key(seed), jnp.zeros((1, 4), jnp.int32),
+               deterministic=True)["params"]
+    )
+    return m, p
+
+
+def _llama(n_layers=2, d_model=32, seed=0, **kw):
+    m = Llama(
+        vocab_size=V, block_size=64, d_model=d_model, n_layers=n_layers,
+        n_heads=4, d_ff=3 * d_model, dropout=0.0, **kw,
+    )
+    p = nn_meta.unbox(
+        m.init(jax.random.key(seed), jnp.zeros((1, 4), jnp.int32),
+               deterministic=True)["params"]
+    )
+    return m, p
+
+
+PROMPT = np.asarray([[3, 1, 4]], np.int32)
+
+
+class TestGreedyExactness:
+    def test_self_draft_matches_plain(self):
+        """Draft == target: every proposal accepted, output identical."""
+        m, p = _gpt()
+        want = generate(m, p, PROMPT, max_new_tokens=10, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, m, p, PROMPT, max_new_tokens=10,
+                                   gamma=4)
+        assert got.tolist() == want.tolist()
+
+    def test_weak_draft_matches_plain(self):
+        """A differently-initialized draft disagrees often — the output
+        must STILL equal the target's own greedy decode."""
+        m, p = _gpt(seed=0)
+        d, dp = _gpt(n_layers=1, seed=7)
+        want = generate(m, p, PROMPT, max_new_tokens=12, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, PROMPT, max_new_tokens=12,
+                                   gamma=4)
+        assert got.tolist() == want.tolist()
+
+    @pytest.mark.parametrize("gamma", [1, 2, 3, 5])
+    def test_gamma_invariance(self, gamma):
+        m, p = _gpt(seed=1)
+        d, dp = _gpt(n_layers=1, seed=9)
+        want = generate(m, p, PROMPT, max_new_tokens=9, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, PROMPT, max_new_tokens=9,
+                                   gamma=gamma)
+        assert got.tolist() == want.tolist()
+
+    def test_single_token_prompt(self):
+        """tp == 1 skips prefill (the cursor invariant's edge case)."""
+        m, p = _gpt(seed=2)
+        d, dp = _gpt(n_layers=1, seed=3)
+        prompt = np.asarray([[5]], np.int32)
+        want = generate(m, p, prompt, max_new_tokens=8, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, prompt, max_new_tokens=8,
+                                   gamma=3)
+        assert got.tolist() == want.tolist()
+
+    def test_gqa_target(self):
+        m, p = _gpt(seed=4, n_kv_heads=2)
+        d, dp = _gpt(n_layers=1, seed=5)
+        want = generate(m, p, PROMPT, max_new_tokens=10, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, PROMPT, max_new_tokens=10,
+                                   gamma=4)
+        assert got.tolist() == want.tolist()
+
+    def test_llama_rolling_window_target(self):
+        """Windowed llama target: the ROLLING cache's cursor rollback and
+        stale-slot semantics hold under speculative rejection."""
+        m, p = _llama(seed=6, sliding_window=5, n_kv_heads=2)
+        d, dp = _llama(n_layers=1, seed=8, sliding_window=5)
+        want = generate(m, p, PROMPT, max_new_tokens=14, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, PROMPT, max_new_tokens=14,
+                                   gamma=3)
+        assert got.tolist() == want.tolist()
+
+
+class TestSamplingDistribution:
+    def test_marginal_matches_analytic_target(self):
+        """First sampled token over many seeds vs the ANALYTIC filtered
+        target distribution (top-k=4 concentrates the mass, so noise-only
+        TV at n=600 is ~0.03 while a biased acceptance rule would show
+        up an order of magnitude larger)."""
+        from llmtrain_tpu.speculative import _filtered_logprobs
+
+        m, p = _gpt(seed=10, n_layers=1, d_model=16)
+        d, dp = _gpt(seed=11, n_layers=1, d_model=16)
+        n = 600
+
+        logits = m.apply({"params": p}, jnp.asarray(PROMPT), deterministic=True)
+        analytic = np.exp(
+            np.asarray(
+                _filtered_logprobs(
+                    logits[:, -1].astype(jnp.float32),
+                    temperature=1.0, top_k=4, top_p=None,
+                )[0]
+            )
+        )
+
+        counts = np.zeros(V)
+        for s in range(n):
+            out = speculative_generate(
+                m, p, d, dp, PROMPT, max_new_tokens=1, gamma=2,
+                temperature=1.0, top_k=4, rng=jax.random.key(s),
+            )
+            counts[int(out[0, PROMPT.shape[1]])] += 1
+        tv = 0.5 * np.abs(counts / n - analytic).sum()
+        assert tv < 0.08, f"total variation vs analytic {tv:.3f}"
+
+    def test_topk_topp_compose(self):
+        """Filtered sampling runs and emits only in-vocab tokens."""
+        m, p = _gpt(seed=12)
+        d, dp = _gpt(n_layers=1, seed=13)
+        out = speculative_generate(
+            m, p, d, dp, PROMPT, max_new_tokens=8, gamma=3,
+            temperature=0.8, top_k=8, top_p=0.9, rng=jax.random.key(0),
+        )
+        assert out.shape == (1, PROMPT.shape[1] + 8)
+        assert ((out >= 0) & (out < V)).all()
+
+
+class TestValidation:
+    def test_batch_one_only(self):
+        m, p = _gpt()
+        two = np.tile(PROMPT, (2, 1))
+        with pytest.raises(ValueError, match="batch size 1"):
+            speculative_generate(m, p, m, p, two, max_new_tokens=4)
+
+    def test_gamma_positive(self):
+        m, p = _gpt()
+        with pytest.raises(ValueError, match="gamma"):
+            speculative_generate(m, p, m, p, PROMPT, max_new_tokens=4, gamma=0)
+
+    def test_block_size_overflow(self):
+        m, p = _gpt()
+        with pytest.raises(ValueError, match="block_size"):
+            speculative_generate(m, p, m, p, PROMPT, max_new_tokens=100,
+                                 gamma=4)
+
+    def test_zero_new_tokens_returns_prompt(self):
+        m, p = _gpt()
+        out = speculative_generate(m, p, m, p, PROMPT, max_new_tokens=0)
+        assert out.tolist() == PROMPT.tolist()
