@@ -822,6 +822,15 @@ def _handle_generate(args: argparse.Namespace) -> int:
             except ConfigLoadError as exc:
                 _emit_error(exc.message, details=exc.details, errors=exc.errors)
                 return EXIT_CONFIG_ERROR
+            # Same fail-fast bound as the target's, BEFORE checkpoint I/O.
+            longest = max(len(ids) for ids in prompt_batches)
+            need = longest + args.max_new_tokens + args.gamma + 1
+            if need > draft_cfg.model.block_size:
+                _emit_error(
+                    f"prompt+max_new_tokens+gamma ({need}) exceeds the "
+                    f"draft model's block_size ({draft_cfg.model.block_size})"
+                )
+                return EXIT_CONFIG_ERROR
             draft_adapter = get_model_adapter(draft_cfg.model.name)()
             draft_model = draft_adapter.build_model(draft_cfg)
             draft_ckpt, draft_params, draft_step = _load_checkpoint_params(

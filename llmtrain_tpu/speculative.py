@@ -151,9 +151,18 @@ def _speculative_jit(
             return (dcache, nxt.astype(tok.dtype)), (nxt[0], aux)
 
         tok_in = jax.lax.dynamic_slice(buf, (0, n - 1), (1, 1))[:, 0]
-        (draft_cache, _), (drafts, q_aux) = jax.lax.scan(
+        (draft_cache, last_tok), (drafts, q_aux) = jax.lax.scan(
             draft_step, (draft_cache, tok_in), jnp.arange(gamma)
         )  # drafts: (gamma,); q_aux: (gamma, V) logprobs (or (gamma, 1))
+        # One extra draft forward feeds d_{gamma-1} so its K/V exists in
+        # the draft cache: without it a fully-accepted iteration advances
+        # the cursor past a position that was never written, leaving a
+        # PERMANENT zero-K/V hole every later draft query attends —
+        # output stays exact (acceptance uses the actual q) but the
+        # acceptance rate decays. Logits are discarded.
+        draft_cache, _ = apply(
+            draft_model, draft_params, draft_cache, last_tok[:, None]
+        )
 
         # --- target: ONE forward over [context token, d_0..d_{gamma-1}].
         seq = jnp.concatenate(
@@ -222,11 +231,11 @@ def _speculative_jit(
         _, n, _, _, _ = carry
         return n < total
 
-    buf, n, _, _, _ = jax.lax.while_loop(
+    buf, n, _, _, iterations = jax.lax.while_loop(
         cond, body, (buf, jnp.asarray(tp, jnp.int32), cache, draft_cache,
                      jnp.asarray(0, jnp.int32))
     )
-    return buf[:, :total]
+    return buf[:, :total], iterations
 
 
 def speculative_generate(
@@ -242,12 +251,17 @@ def speculative_generate(
     top_k: int | None = None,
     top_p: float | None = None,
     rng: jax.Array | None = None,
-) -> np.ndarray:
+    return_stats: bool = False,
+) -> np.ndarray | tuple[np.ndarray, dict]:
     """Draft-and-verify decode; returns (1, Tp + max_new_tokens) tokens.
 
     ``model``/``draft_model`` are TRAINING-mode modules exposing
     ``for_decoding()`` (GPT/Llama families); both must share the
     tokenizer/vocab. ``gamma`` is the draft lookahead per target forward.
+    ``return_stats=True`` also returns ``{"target_forwards": k,
+    "mean_accepted": a}`` — k is the number of verify iterations (=
+    target forwards after prefill) and a the mean accepted drafts per
+    iteration (gamma when the draft always agrees).
     """
     ids = np.asarray(prompt)
     if ids.ndim != 2 or ids.shape[0] != 1:
@@ -255,7 +269,8 @@ def speculative_generate(
             f"speculative decoding supports batch size 1, got shape {ids.shape}"
         )
     if max_new_tokens <= 0:
-        return ids.copy()
+        out = ids.copy()
+        return (out, {"target_forwards": 0, "mean_accepted": 0.0}) if return_stats else out
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     for m, label in ((model, "model"), (draft_model, "draft_model")):
@@ -288,7 +303,7 @@ def speculative_generate(
 
     decode_model, cache = zero_cache(model)
     decode_draft, draft_cache = zero_cache(draft_model)
-    out = _speculative_jit(
+    out, iterations = _speculative_jit(
         decode_model,
         params,
         cache,
@@ -303,7 +318,18 @@ def speculative_generate(
         top_k=top_k,
         top_p=top_p,
     )
-    return np.asarray(jax.device_get(out))
+    tokens = np.asarray(jax.device_get(out))
+    if return_stats:
+        k = int(jax.device_get(iterations))
+        # Each iteration emits accepted+1 tokens; the final iteration's
+        # overshoot past max_new_tokens is trimmed, so this slightly
+        # UNDERestimates acceptance (by < 1/k).
+        mean_accepted = max_new_tokens / k - 1.0 if k else 0.0
+        return tokens, {
+            "target_forwards": k,
+            "mean_accepted": round(mean_accepted, 4),
+        }
+    return tokens
 
 
 __all__ = ["speculative_generate"]

@@ -98,6 +98,21 @@ class TestGreedyExactness:
                                    gamma=4)
         assert got.tolist() == want.tolist()
 
+    def test_self_draft_accepts_everything(self):
+        """Draft == target must accept ALL gamma proposals every
+        iteration: ceil(max_new/(gamma+1)) target forwards. This pins the
+        draft-cache completeness invariant — the r4 bug (the last draft
+        token's K/V never written on full acceptance) kept outputs exact
+        but decayed acceptance after the first hole."""
+        m, p = _gpt(seed=20)
+        gamma, new = 3, 12
+        out, stats = speculative_generate(
+            m, p, m, p, PROMPT, max_new_tokens=new, gamma=gamma,
+            return_stats=True,
+        )
+        assert stats["target_forwards"] == -(-new // (gamma + 1))  # ceil
+        assert out.shape == (1, PROMPT.shape[1] + new)
+
     def test_llama_rolling_window_target(self):
         """Windowed llama target: the ROLLING cache's cursor rollback and
         stale-slot semantics hold under speculative rejection."""

@@ -70,6 +70,17 @@ def test_bench_family_smoke():
     assert all("error" not in r and r["tokens_per_sec"] > 0 for r in rows)
 
 
+def test_bench_speculative_smoke():
+    proc = _run(["tools/bench_speculative.py", "--cpu-smoke", "--new-tokens",
+                 "8", "--repeats", "1"])
+    assert proc.returncode == 0, proc.stderr
+    rows = [json.loads(x) for x in proc.stdout.splitlines() if x.strip()]
+    assert {r["cell"] for r in rows} == {
+        "plain", "speculative_self_draft", "speculative_fresh_draft",
+    }
+    assert all("error" not in r for r in rows)
+
+
 def test_interleave_attribution_smoke():
     proc = _run(
         ["tools/bench_interleave.py", "--no-trainer", "--attribute",

@@ -1,0 +1,143 @@
+"""Speculative-decoding throughput cells (speculative.py).
+
+The realized speedup depends on draft agreement, which depends on the
+trained pair — these cells measure the MECHANICS at GPT-2-small scale,
+with per-cell acceptance stats so the number can be interpreted:
+
+* ``self`` — draft == target: isolates the verify-loop overhead when
+  the draft costs as much as the target (speedup < 1 by construction —
+  the win requires a cheap draft).
+* ``fresh`` — a ~25x-smaller randomly-initialized draft. CAVEAT:
+  untrained models echo the previous token, so BOTH random models agree
+  near-perfectly and this cell behaves like a cheap-draft best case
+  (mean_accepted ≈ gamma), bounding the speedup a well-aligned trained
+  pair could reach; realistic mid-range acceptance needs a trained
+  target/draft pair (train one with configs/presets + --draft-config).
+
+Usage (repo root):
+
+    python tools/bench_speculative.py                 # TPU cells
+    JAX_PLATFORMS=cpu python tools/bench_speculative.py --cpu-smoke
+
+Emits one JSON line per cell (ms/token, speedup, target_forwards,
+mean_accepted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _models(cpu_smoke: bool):
+    import jax.numpy as jnp
+    from flax.linen import meta as nn_meta
+
+    from llmtrain_tpu.models.gpt import GPT
+
+    if cpu_smoke:
+        tgt_kw = dict(block_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+        drf_kw = dict(block_size=128, d_model=32, n_layers=1, n_heads=4, d_ff=64)
+        vocab = 256
+    else:
+        tgt_kw = dict(block_size=1024, d_model=768, n_layers=12, n_heads=12,
+                      d_ff=3072)
+        drf_kw = dict(block_size=1024, d_model=256, n_layers=2, n_heads=4,
+                      d_ff=1024)
+        vocab = 50257
+
+    def build(kw, seed):
+        m = GPT(vocab_size=vocab, dropout=0.0,
+                dtype=jnp.float32 if cpu_smoke else jnp.bfloat16, **kw)
+        p = nn_meta.unbox(
+            m.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32),
+                   deterministic=True)["params"]
+        )
+        return m, p
+
+    return build(tgt_kw, 0), build(drf_kw, 1), vocab
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--new-tokens", type=int, default=128)
+    ap.add_argument("--gamma", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cpu-smoke", action="store_true")
+    args = ap.parse_args()
+    if args.cpu_smoke:
+        args.new_tokens = min(args.new_tokens, 24)
+
+    from llmtrain_tpu.generation import generate
+    from llmtrain_tpu.speculative import speculative_generate
+
+    (tgt, tgt_p), (drf, drf_p), vocab = _models(args.cpu_smoke)
+    prompt = np.random.default_rng(0).integers(
+        0, vocab, (1, 16), dtype=np.int32
+    )
+
+    def timed(fn):
+        fn()  # compile
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            np.asarray(fn())  # host sync
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    plain_s = timed(
+        lambda: generate(tgt, tgt_p, prompt, max_new_tokens=args.new_tokens,
+                         temperature=0.0, use_cache=True)
+    )
+    cells = {
+        "self": (tgt, tgt_p),
+        "fresh": (drf, drf_p),
+    }
+    rows = [{
+        "cell": "plain", "backend": jax.default_backend(),
+        "new_tokens": args.new_tokens,
+        "ms_per_token": round(plain_s / args.new_tokens * 1e3, 3),
+    }]
+    print(json.dumps(rows[0]), flush=True)
+    for name, (d, dp) in cells.items():
+        try:
+            spec_s = timed(
+                lambda: speculative_generate(
+                    tgt, tgt_p, d, dp, prompt,
+                    max_new_tokens=args.new_tokens, gamma=args.gamma,
+                )
+            )
+            _, stats = speculative_generate(
+                tgt, tgt_p, d, dp, prompt, max_new_tokens=args.new_tokens,
+                gamma=args.gamma, return_stats=True,
+            )
+            row = {
+                "cell": f"speculative_{name}_draft",
+                "backend": jax.default_backend(),
+                "gamma": args.gamma,
+                "new_tokens": args.new_tokens,
+                "ms_per_token": round(spec_s / args.new_tokens * 1e3, 3),
+                "speedup_vs_plain": round(plain_s / spec_s, 3),
+                **stats,
+            }
+        except Exception as exc:  # noqa: BLE001 — per-cell isolation
+            row = {"cell": f"speculative_{name}_draft", "error": str(exc)[:300]}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

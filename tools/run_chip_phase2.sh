@@ -67,6 +67,12 @@ timeout 1200 python tools/bench_family.py \
     >"$OUT/family.json" 2>"$OUT/family.log" || log "family cells failed/partial"
 tail -2 "$OUT/family.json" || true
 
+log "7b/8 speculative-decode bounds (self/fresh draft, gamma=4)..."
+timeout 1200 python tools/bench_speculative.py \
+    >"$OUT/speculative.json" 2>"$OUT/speculative.log" \
+    || log "speculative cells failed/partial"
+tail -2 "$OUT/speculative.json" || true
+
 log "8/8 BPE headline train (tokenizer already at runs/pytok8k.json)..."
 if [ -f runs/pytok8k.json ]; then
     timeout 5400 python -m llmtrain_tpu train \
