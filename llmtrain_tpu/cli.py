@@ -720,9 +720,6 @@ def _handle_generate(args: argparse.Namespace) -> int:
     if (args.draft_config is None) != (args.draft_from is None):
         _emit_error("--draft-config and --draft-from must be given together")
         return EXIT_CONFIG_ERROR
-    if args.draft_config is not None and args.eos_token_id is not None:
-        _emit_error("speculative decoding does not support --eos-token-id")
-        return EXIT_CONFIG_ERROR
     if args.draft_config is not None and args.gamma < 1:
         _emit_error(f"--gamma must be >= 1, got {args.gamma}")
         return EXIT_CONFIG_ERROR
@@ -856,17 +853,6 @@ def _handle_generate(args: argparse.Namespace) -> int:
         if eos_token_id is None and tokenizer is not None:
             # tiktoken encodings expose the end-of-text id as eot_token.
             eos_token_id = getattr(tokenizer, "eot_token", None)
-        if draft is not None and eos_token_id is not None:
-            # Not silent: a tokenizer-derived EOS means the plain path
-            # would stop early while the speculative path cannot — the
-            # outputs WILL differ past the first EOS.
-            logger.warning(
-                "eos early-stop (token %s) is disabled under speculative "
-                "decoding; output continues past EOS and may differ from a "
-                "plain `generate` run, which stops there",
-                eos_token_id,
-            )
-            eos_token_id = None
 
         # Batch per prompt length: generate() takes a rectangular (B, Tp)
         # batch, so equal-length prompts share ONE compiled decode loop.
@@ -898,6 +884,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
                             if args.top_p is not None and 0 < args.top_p < 1
                             else None
                         ),
+                        eos_token_id=eos_token_id,
                         # Two folds (group, then row): collision-free
                         # streams however large a prompt-length group is.
                         rng=jax.random.fold_in(

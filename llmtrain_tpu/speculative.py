@@ -32,9 +32,10 @@ query can see them, for both the linear and the rolling (windowed)
 cache layouts (models/gpt.py:_decode_attention).
 
 Scope: batch size 1 (per-row acceptance lengths would need per-row
-cursors); ``eos_token_id`` is not supported (an eos-conditioned
-continuation would diverge from the single-model path). Both are
-validated loudly.
+cursors; validated loudly). ``eos_token_id`` stops at the first emitted
+eos and eos-fills the tail — exactly the plain path's behavior
+(generation.py force-fills eos after the first one), so exactness
+holds with early stopping too.
 """
 
 from __future__ import annotations
@@ -81,7 +82,7 @@ def _filtered_logprobs(
     jax.jit,
     static_argnames=(
         "model", "draft_model", "max_new_tokens", "gamma", "temperature",
-        "top_k", "top_p",
+        "top_k", "top_p", "eos_token_id",
     ),
 )
 def _speculative_jit(
@@ -99,6 +100,7 @@ def _speculative_jit(
     temperature: float,
     top_k: int | None,
     top_p: float | None,
+    eos_token_id: int | None,
 ) -> jax.Array:
     tp = prompt.shape[1]
     total = tp + max_new_tokens
@@ -223,19 +225,38 @@ def _speculative_jit(
             buf, out_tokens[None].astype(buf.dtype), (0, n)
         )
         n_new = n + accepted + 1
+        if eos_token_id is not None:
+            # Stop at the FIRST emitted eos: clamp the advance so n_new
+            # points one past it. Exactness holds because the plain path
+            # force-fills eos after the first one regardless of context
+            # (generation.py:104-106) — the post-loop fill below emits
+            # the same tail.
+            emitted = jnp.arange(gamma + 1) <= accepted
+            is_eos = emitted & (out_tokens == eos_token_id)
+            first = jnp.argmax(is_eos)  # 0 if none — guarded by any()
+            n_new = jnp.where(jnp.any(is_eos), n + first + 1, n_new)
         cache = _set_cursor(cache, n_new - 1)
         draft_cache = _set_cursor(draft_cache, n_new - 1)
         return buf, n_new, cache, draft_cache, it + 1
 
     def cond(carry):
-        _, n, _, _, _ = carry
-        return n < total
+        buf, n, _, _, _ = carry
+        going = n < total
+        if eos_token_id is not None:
+            # n-1 is the last emitted token; eos there ends the loop.
+            last = jax.lax.dynamic_slice(buf, (0, n - 1), (1, 1))[0, 0]
+            going = going & ((n <= tp) | (last != eos_token_id))
+        return going
 
     buf, n, _, _, iterations = jax.lax.while_loop(
         cond, body, (buf, jnp.asarray(tp, jnp.int32), cache, draft_cache,
                      jnp.asarray(0, jnp.int32))
     )
-    return buf[:, :total], iterations
+    if eos_token_id is not None:
+        # eos-fill the tail beyond the stop point, like the plain path.
+        pos = jnp.arange(buf.shape[1])
+        buf = jnp.where(pos[None, :] >= n, jnp.asarray(eos_token_id, buf.dtype), buf)
+    return buf[:, :total], n, iterations
 
 
 def speculative_generate(
@@ -250,6 +271,7 @@ def speculative_generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    eos_token_id: int | None = None,
     rng: jax.Array | None = None,
     return_stats: bool = False,
 ) -> np.ndarray | tuple[np.ndarray, dict]:
@@ -303,7 +325,7 @@ def speculative_generate(
 
     decode_model, cache = zero_cache(model)
     decode_draft, draft_cache = zero_cache(draft_model)
-    out, iterations = _speculative_jit(
+    out, final_n, iterations = _speculative_jit(
         decode_model,
         params,
         cache,
@@ -317,14 +339,15 @@ def speculative_generate(
         temperature=float(temperature),
         top_k=top_k,
         top_p=top_p,
+        eos_token_id=eos_token_id,
     )
     tokens = np.asarray(jax.device_get(out))
     if return_stats:
         k = int(jax.device_get(iterations))
-        # Each iteration emits accepted+1 tokens; the final iteration's
-        # overshoot past max_new_tokens is trimmed, so this slightly
-        # UNDERestimates acceptance (by < 1/k).
-        mean_accepted = max_new_tokens / k - 1.0 if k else 0.0
+        # ACTUAL emitted count (eos may stop early; the final iteration's
+        # trimmed overshoot slightly underestimates acceptance, < 1/k).
+        emitted = min(int(jax.device_get(final_n)) - ids.shape[1], max_new_tokens)
+        mean_accepted = emitted / k - 1.0 if k else 0.0
         return tokens, {
             "target_forwards": k,
             "mean_accepted": round(mean_accepted, 4),

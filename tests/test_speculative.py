@@ -125,6 +125,41 @@ class TestGreedyExactness:
         assert got.tolist() == want.tolist()
 
 
+class TestEosParity:
+    def test_eos_stop_matches_plain(self):
+        """Pick a token the greedy chain actually emits as 'eos': both
+        paths must stop there and eos-fill the tail identically."""
+        m, p = _gpt(seed=30)
+        d, dp = _gpt(n_layers=1, seed=31)
+        free = generate(m, p, PROMPT, max_new_tokens=10, temperature=0.0,
+                        use_cache=True)
+        eos = int(free[0, PROMPT.shape[1] + 3])  # 4th generated token
+        want = generate(m, p, PROMPT, max_new_tokens=10, temperature=0.0,
+                        eos_token_id=eos, use_cache=True)
+        got = speculative_generate(
+            m, p, d, dp, PROMPT, max_new_tokens=10, gamma=4,
+            eos_token_id=eos,
+        )
+        assert got.tolist() == want.tolist()
+        # The tail from the first eos onward is eos-filled.
+        first = int(np.argmax(got[0, PROMPT.shape[1] :] == eos))
+        tail = got[0, PROMPT.shape[1] + first :]
+        assert (tail == eos).all()
+
+    def test_eos_never_emitted_is_noop(self):
+        m, p = _gpt(seed=32)
+        free = speculative_generate(m, p, m, p, PROMPT, max_new_tokens=8,
+                                    gamma=3)
+        unused_set = set(range(V)) - set(int(t) for t in free[0])
+        assert unused_set  # 11 tokens over V=32 cannot cover the vocab
+        unused = min(unused_set)
+        guarded = speculative_generate(
+            m, p, m, p, PROMPT, max_new_tokens=8, gamma=3,
+            eos_token_id=unused,
+        )
+        assert guarded.tolist() == free.tolist()
+
+
 class TestSamplingDistribution:
     def test_marginal_matches_analytic_target(self):
         """First sampled token over many seeds vs the ANALYTIC filtered
