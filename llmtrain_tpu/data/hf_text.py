@@ -16,7 +16,6 @@ non-overlapping ``block_size + 1`` chunks of the concatenated stream.
 
 from __future__ import annotations
 
-import os
 from pathlib import Path
 from typing import Any
 
@@ -24,16 +23,46 @@ import numpy as np
 
 from ..config.schemas import RunConfig
 from ..registry.data import register_data_module
-from .base import DataModule, IndexedDataset
+from .base import (
+    DataModule,
+    IndexedDataset,
+    load_token_cache,
+    validate_split_documents,
+    write_token_cache,
+)
 
 
 class TokenWindowDataset:
-    """Non-overlapping (block_size+1)-token windows over a flat stream."""
+    """Non-overlapping (block_size+1)-token windows over a flat stream.
 
-    def __init__(self, tokens: np.ndarray, block_size: int) -> None:
+    With ``doc_starts`` (sorted document start offsets into the stream)
+    and ``split_documents=True``, ``attention_mask`` carries SEGMENT ids
+    instead of all-ones: within each window, tokens of the same document
+    share one nonzero id (1-based, local to the window), the attention
+    paths mask cross-document pairs (equal-id semantics, models/gpt.py
+    dense_attention and the Pallas kernels), and positions whose LABEL
+    belongs to the next document get mask 0 — a cross-document
+    next-token prediction is noise, and as keys those document-final
+    tokens serve no same-document query anyway.
+    """
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        block_size: int,
+        *,
+        doc_starts: np.ndarray | None = None,
+        split_documents: bool = False,
+    ) -> None:
         if tokens.ndim != 1:
             raise ValueError(f"token stream must be 1-D, got shape {tokens.shape}")
+        if split_documents and doc_starts is None:
+            raise ValueError("split_documents=True requires doc_starts")
         self._tokens = tokens
+        self._doc_starts = (
+            np.asarray(doc_starts, dtype=np.int64) if doc_starts is not None else None
+        )
+        self._split = bool(split_documents)
         self._block_size = block_size
         self._chunk = block_size + 1
         self._num_windows = len(tokens) // self._chunk
@@ -45,13 +74,23 @@ class TokenWindowDataset:
         starts = np.asarray(indices, dtype=np.int64) * self._chunk
         # Gather all windows in one vectorized fancy-index.
         offsets = np.arange(self._chunk, dtype=np.int64)
-        chunks = self._tokens[starts[:, None] + offsets[None, :]]
+        positions = starts[:, None] + offsets[None, :]
+        chunks = self._tokens[positions]
         input_ids = np.ascontiguousarray(chunks[:, :-1], dtype=np.int32)
         labels = np.ascontiguousarray(chunks[:, 1:], dtype=np.int32)
+        if self._split:
+            # Document ordinal per position (1-based via 'right'), then
+            # renumbered locally so ids stay small per window.
+            doc = np.searchsorted(self._doc_starts, positions, side="right")
+            seg_in, seg_lab = doc[:, :-1], doc[:, 1:]
+            local = seg_in - seg_in.min(axis=1, keepdims=True) + 1
+            mask = np.where(seg_in == seg_lab, local, 0).astype(np.int32)
+        else:
+            mask = np.ones_like(input_ids)
         return {
             "input_ids": input_ids,
             "labels": labels,
-            "attention_mask": np.ones_like(input_ids),
+            "attention_mask": mask,
         }
 
 
@@ -59,7 +98,7 @@ class TokenWindowDataset:
 class HFTextDataModule(DataModule):
     """Loads a HuggingFace text dataset and serves fixed token windows."""
 
-    known_extra_keys = frozenset()
+    known_extra_keys = frozenset({"split_documents"})
 
     def __init__(self) -> None:
         self._cfg: RunConfig | None = None
@@ -72,14 +111,27 @@ class HFTextDataModule(DataModule):
         if cfg.data.dataset_name is None:
             raise ValueError("hf_text requires data.dataset_name")
         text_column = cfg.data.text_column or "text"
+        split_docs = bool(cfg.data.extra.get("split_documents", False))
+        if split_docs:
+            validate_split_documents(cfg)
         self._cfg = cfg
 
-        train_tokens = self._prepare_split(cfg, cfg.data.train_split, tokenizer, text_column)
-        self._train = TokenWindowDataset(train_tokens, cfg.model.block_size)
+        train_tokens, train_docs = self._prepare_split(
+            cfg, cfg.data.train_split, tokenizer, text_column, need_docs=split_docs
+        )
+        self._train = TokenWindowDataset(
+            train_tokens, cfg.model.block_size,
+            doc_starts=train_docs, split_documents=split_docs,
+        )
         self._val = None
         if cfg.data.val_split:
-            val_tokens = self._prepare_split(cfg, cfg.data.val_split, tokenizer, text_column)
-            val_ds = TokenWindowDataset(val_tokens, cfg.model.block_size)
+            val_tokens, val_docs = self._prepare_split(
+                cfg, cfg.data.val_split, tokenizer, text_column, need_docs=split_docs
+            )
+            val_ds = TokenWindowDataset(
+                val_tokens, cfg.model.block_size,
+                doc_starts=val_docs, split_documents=split_docs,
+            )
             if len(val_ds) > 0:
                 self._val = val_ds
 
@@ -98,11 +150,13 @@ class HFTextDataModule(DataModule):
         )
 
     def _prepare_split(
-        self, cfg: RunConfig, split: str, tokenizer: Any, text_column: str
-    ) -> np.ndarray:
+        self, cfg: RunConfig, split: str, tokenizer: Any, text_column: str,
+        *, need_docs: bool = False,
+    ) -> tuple[np.ndarray, np.ndarray | None]:
         cache_path = self._token_cache_path(cfg, split, tokenizer)
-        if cache_path.exists():
-            return np.load(cache_path, mmap_mode="r")
+        cached = load_token_cache(cache_path, need_docs=need_docs)
+        if cached is not None:
+            return cached
 
         from datasets import load_dataset
 
@@ -112,19 +166,19 @@ class HFTextDataModule(DataModule):
             split=split,
             cache_dir=cfg.data.cache_dir,
         )
-        tokens = self._tokenize_stream(raw, tokenizer, text_column)
-        cache_path.parent.mkdir(parents=True, exist_ok=True)
-        # np.save appends ".npy" unless the name already ends with it.
-        # Per-process tmp name: concurrent ranks building a cold cache must
-        # not scribble into each other's file before the atomic rename.
-        tmp = cache_path.with_suffix(f".tmp{os.getpid()}.npy")
-        np.save(tmp, tokens)
-        tmp.replace(cache_path)
-        return tokens
+        tokens, doc_starts = self._tokenize_stream(raw, tokenizer, text_column)
+        write_token_cache(cache_path, tokens, doc_starts)
+        return tokens, (doc_starts if need_docs else None)
 
     @staticmethod
-    def _tokenize_stream(raw_dataset: Any, tokenizer: Any, text_column: str) -> np.ndarray:
-        """Encode every row's text column and concatenate into one stream."""
+    def _tokenize_stream(
+        raw_dataset: Any, tokenizer: Any, text_column: str
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Encode every row's text column and concatenate into one stream.
+
+        Also returns the document START offsets into the stream (one per
+        encoded row) — the boundary structure ``split_documents`` needs.
+        """
         pieces: list[np.ndarray] = []
         batch_encode = getattr(tokenizer, "encode_ordinary_batch", None)
         texts = (str(t) for t in raw_dataset[text_column] if t is not None)
@@ -140,8 +194,10 @@ class HFTextDataModule(DataModule):
                 if ids:
                     pieces.append(np.asarray(ids, dtype=np.int32))
         if not pieces:
-            return np.zeros((0,), dtype=np.int32)
-        return np.concatenate(pieces)
+            return np.zeros((0,), dtype=np.int32), np.zeros((0,), dtype=np.int64)
+        lengths = np.asarray([len(p) for p in pieces], dtype=np.int64)
+        doc_starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+        return np.concatenate(pieces), doc_starts
 
     def train_dataset(self) -> IndexedDataset:
         if self._train is None:

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import glob
 import hashlib
-import os
 from pathlib import Path
 from typing import Any
 
@@ -33,7 +32,13 @@ import numpy as np
 
 from ..config.schemas import RunConfig
 from ..registry.data import register_data_module
-from .base import DataModule, IndexedDataset
+from .base import (
+    DataModule,
+    IndexedDataset,
+    load_token_cache,
+    validate_split_documents,
+    write_token_cache,
+)
 from .hf_text import TokenWindowDataset
 
 _DEFAULT_VAL_FRACTION = 0.01
@@ -43,7 +48,9 @@ _DEFAULT_VAL_FRACTION = 0.01
 class LocalTextDataModule(DataModule):
     """Serves fixed token windows over a corpus of local text files."""
 
-    known_extra_keys = frozenset({"globs", "val_fraction", "format", "text_key"})
+    known_extra_keys = frozenset(
+        {"globs", "val_fraction", "format", "text_key", "split_documents"}
+    )
 
     def __init__(self) -> None:
         self._train: TokenWindowDataset | None = None
@@ -67,22 +74,41 @@ class LocalTextDataModule(DataModule):
         if not files:
             raise ValueError(f"local_text globs matched no files: {globs}")
 
-        tokens = self._load_or_build_cache(cfg, files, tokenizer, fmt=fmt)
-        n_val = int(len(tokens) * val_fraction)
-        train_tokens, val_tokens = tokens[: len(tokens) - n_val], tokens[len(tokens) - n_val :]
+        split_docs = bool(cfg.data.extra.get("split_documents", False))
+        if split_docs:
+            validate_split_documents(cfg)
+        tokens, doc_starts = self._load_or_build_cache(
+            cfg, files, tokenizer, fmt=fmt, need_docs=split_docs
+        )
+        n_train = len(tokens) - int(len(tokens) * val_fraction)
+        train_tokens, val_tokens = tokens[:n_train], tokens[n_train:]
+        train_docs = val_docs = None
+        if split_docs:
+            train_docs = doc_starts[doc_starts < n_train]
+            # The val stream may open mid-document; positions before its
+            # first boundary get ordinal 0, made 1-based by the window's
+            # local renumbering.
+            val_docs = doc_starts[doc_starts >= n_train] - n_train
 
-        self._train = TokenWindowDataset(train_tokens, cfg.model.block_size)
+        self._train = TokenWindowDataset(
+            train_tokens, cfg.model.block_size,
+            doc_starts=train_docs, split_documents=split_docs,
+        )
         if len(self._train) == 0:
             raise ValueError(
                 f"corpus too small: {len(train_tokens)} train tokens for "
                 f"block_size {cfg.model.block_size}"
             )
-        val_ds = TokenWindowDataset(val_tokens, cfg.model.block_size)
+        val_ds = TokenWindowDataset(
+            val_tokens, cfg.model.block_size,
+            doc_starts=val_docs, split_documents=split_docs,
+        )
         self._val = val_ds if len(val_ds) > 0 else None
 
     def _load_or_build_cache(
-        self, cfg: RunConfig, files: list[str], tokenizer: Any, *, fmt: str = "text"
-    ) -> np.ndarray:
+        self, cfg: RunConfig, files: list[str], tokenizer: Any, *,
+        fmt: str = "text", need_docs: bool = False,
+    ) -> tuple[np.ndarray, np.ndarray | None]:
         text_key = str(cfg.data.extra.get("text_key", "text"))
         # Key by file list + size + mtime (size alone misses equal-length
         # edits) + parse mode + tokenizer identity — token ids from a
@@ -102,46 +128,54 @@ class LocalTextDataModule(DataModule):
         cache_path = (
             Path(cfg.data.cache_dir) / "processed" / f"local__{h.hexdigest()[:16]}__{tok_id}.npy"
         )
-        if cache_path.exists():
-            return np.load(cache_path, mmap_mode="r")
+        cached = load_token_cache(cache_path, need_docs=need_docs)
+        if cached is not None:
+            return cached
 
         encode_np = getattr(tokenizer, "encode_np", None)
+        sep = np.asarray(tokenizer.encode("\n\n"), dtype=np.int32)
         pieces: list[np.ndarray] = []
+        doc_starts: list[int] = []
+        total = 0
         for f in files:
             raw = Path(f).read_text(encoding="utf-8", errors="ignore")
-            text = self._extract_text(f, raw, fmt, text_key)
-            if not text:
-                continue
-            if encode_np is not None:
-                ids = encode_np(text)
-            else:
-                ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
-            if ids.size:
-                pieces.append(ids)
-                # File boundary marker: newline keeps documents separated
-                # without inventing an out-of-vocab separator id.
-                pieces.append(np.asarray(tokenizer.encode("\n\n"), dtype=np.int32))
+            # Document granularity: the whole file in text mode, one JSON
+            # record in jsonl mode — so split_documents boundaries match
+            # what a reader would call a document, not the file layout.
+            for text in self._extract_documents(f, raw, fmt, text_key):
+                if not text:
+                    continue
+                if encode_np is not None:
+                    ids = encode_np(text)
+                else:
+                    ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
+                if ids.size:
+                    # The boundary marker belongs to the document it
+                    # follows: newline keeps documents separated without
+                    # inventing an out-of-vocab separator id.
+                    doc_starts.append(total)
+                    pieces.append(ids)
+                    pieces.append(sep)
+                    total += ids.size + sep.size
         tokens = (
             np.concatenate(pieces).astype(np.int32)
             if pieces
             else np.zeros((0,), dtype=np.int32)
         )
-
-        cache_path.parent.mkdir(parents=True, exist_ok=True)
-        # Per-process tmp name: concurrent ranks building a cold cache must
-        # not scribble into each other's file before the atomic rename.
-        tmp = cache_path.with_suffix(f".tmp{os.getpid()}.npy")
-        np.save(tmp, tokens)
-        tmp.replace(cache_path)
-        return tokens
+        starts_arr = np.asarray(doc_starts, dtype=np.int64)
+        write_token_cache(cache_path, tokens, starts_arr)
+        return tokens, (starts_arr if need_docs else None)
 
     @staticmethod
-    def _extract_text(path: str, raw: str, fmt: str, text_key: str) -> str:
-        """Raw file content → training text. "jsonl" parses one JSON object
-        per line and concatenates the ``text_key`` field of each, separated
-        by blank lines (same document-boundary convention as text mode)."""
+    def _extract_documents(
+        path: str, raw: str, fmt: str, text_key: str
+    ) -> list[str]:
+        """Raw file content → list of document texts. "text" yields the
+        whole file as one document; "jsonl" parses one JSON object per
+        line and yields each ``text_key`` field as its own document (so
+        ``split_documents`` boundaries are per record, not per file)."""
         if fmt == "text":
-            return raw
+            return [raw]
         import json
 
         docs: list[str] = []
@@ -163,7 +197,7 @@ class LocalTextDataModule(DataModule):
                     f"in each JSONL object"
                 )
             docs.append(val)
-        return "\n\n".join(docs)
+        return docs
 
     def train_dataset(self) -> IndexedDataset:
         if self._train is None:

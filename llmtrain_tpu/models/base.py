@@ -229,5 +229,8 @@ def masked_ce_components(
     if attention_mask is None:
         mask = jnp.ones_like(per_token)
     else:
-        mask = attention_mask.astype(jnp.float32)
+        # BOOLEAN semantics (nonzero = real token): the mask may carry
+        # segment ids > 1 for packed cross-document masking — they must
+        # not become loss weights.
+        mask = (attention_mask != 0).astype(jnp.float32)
     return jnp.sum(per_token * mask, axis=-1), jnp.sum(mask, axis=-1)

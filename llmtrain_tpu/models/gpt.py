@@ -268,8 +268,9 @@ class CausalSelfAttention(nn.Module):
 
         if attention_mask is not None:
             # Zero padded rows so they contribute nothing downstream
-            # (reference gpt.py:73-74).
-            out = out * attention_mask[:, :, None].astype(out.dtype)
+            # (reference gpt.py:73-74). Boolean compare: the mask may
+            # carry segment ids > 1 (packed cross-document masking).
+            out = out * (attention_mask != 0)[:, :, None].astype(out.dtype)
         return out
 
     def _decode_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
@@ -422,8 +423,18 @@ def dense_attention(
         causal = causal & (pos[:, None] - pos[None, :] < window)
     scores = jnp.where(causal[None, None, :, :], scores, big_neg)
     if attention_mask is not None:
-        key_mask = attention_mask.astype(jnp.bool_)[:, None, None, :]  # (B,1,1,T)
-        scores = jnp.where(key_mask, scores, big_neg)
+        # Segment semantics (packed sequences): nonzero = real token,
+        # EQUAL nonzero values = same document — a key is live for a
+        # query iff it is real and in the same segment. Plain 0/1
+        # padding masks are the one-segment special case (identical
+        # behavior to key-only masking for real queries; padded-query
+        # rows become fully masked, which the caller's output zeroing
+        # already covers).
+        seg = attention_mask
+        live = (seg != 0)[:, None, None, :] & (
+            seg[:, None, :, None] == seg[:, None, None, :]
+        )
+        scores = jnp.where(live, scores, big_neg)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     if dropout > 0.0 and not deterministic and dropout_rng_module is not None:

@@ -103,8 +103,9 @@ def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
         attn_out = proj + p["out_bias"].astype(dtype)
         if key_mask is not None:
             # Zero padded rows' attention contribution (reference
-            # gpt.py:73-74, same multiply as models/gpt.py).
-            attn_out = attn_out * key_mask[:, :, None].astype(attn_out.dtype)
+            # gpt.py:73-74, same boolean compare as models/gpt.py —
+            # mask values may be segment ids).
+            attn_out = attn_out * (key_mask != 0)[:, :, None].astype(attn_out.dtype)
         h = h + attn_out
 
         hn = _layernorm(h, p["ln2_scale"], p["ln2_bias"])

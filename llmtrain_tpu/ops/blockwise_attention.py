@@ -31,6 +31,7 @@ def _chunk_scan(
     causal: bool,
     kv_chunk: int,
     key_mask: jax.Array | None = None,
+    query_mask: jax.Array | None = None,
     window: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Online-softmax accumulation of one q-chunk over all kv-chunks.
@@ -52,8 +53,13 @@ def _chunk_scan(
     k_chunks = k.reshape(k.shape[0], num_kv, kv_chunk, *k.shape[2:])
     v_chunks = v.reshape(v.shape[0], num_kv, kv_chunk, *v.shape[2:])
     mask_chunks = None
+    seg_chunks = None
+    q_seg = None
     if key_mask is not None:
         mask_chunks = (key_mask != 0).reshape(key_mask.shape[0], num_kv, kv_chunk)
+        if query_mask is not None:
+            seg_chunks = key_mask.reshape(key_mask.shape[0], num_kv, kv_chunk)
+            q_seg = query_mask
 
     q_pos = q_offset + jnp.arange(tq)
 
@@ -72,7 +78,7 @@ def _chunk_scan(
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(carry, inputs):
         acc, row_max, row_sum = carry
-        k_c, v_c, m_c, chunk_idx = inputs
+        k_c, v_c, m_c, mseg_c, chunk_idx = inputs
         if group > 1:
             s = jnp.einsum("bqkgd,bskd->bqkgs", qg, k_c) * scale
             s = s.reshape(b, tq_, h, k_c.shape[1])
@@ -86,7 +92,14 @@ def _chunk_scan(
                 mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
             s = jnp.where(mask[None, :, None, :], s, _NEG_INF)
         if m_c is not None:
-            s = jnp.where(m_c[:, None, None, :], s, _NEG_INF)  # (B,1,1,chunk)
+            live = m_c[:, None, None, :]  # (B,1,1,chunk) real-key mask
+            if q_seg is not None:
+                # Segment semantics: equal nonzero mask values = same
+                # document; keys outside the query's segment are dead.
+                live = live & (
+                    q_seg[:, :, None, None] == mseg_c[:, None, None, :]
+                )
+            s = jnp.where(live, s, _NEG_INF)
         new_max = jnp.maximum(row_max, s.max(axis=-1))
         correction = jnp.exp(row_max - new_max)
         p = jnp.exp(s - new_max[..., None])
@@ -113,8 +126,9 @@ def _chunk_scan(
     k_scan = jnp.moveaxis(k_chunks, 1, 0)
     v_scan = jnp.moveaxis(v_chunks, 1, 0)
     m_scan = None if mask_chunks is None else jnp.moveaxis(mask_chunks, 1, 0)
+    mseg_scan = None if seg_chunks is None else jnp.moveaxis(seg_chunks, 1, 0)
     (acc, row_max, row_sum), _ = jax.lax.scan(
-        body, init, (k_scan, v_scan, m_scan, jnp.arange(num_kv))
+        body, init, (k_scan, v_scan, m_scan, mseg_scan, jnp.arange(num_kv))
     )
     return acc, row_max, row_sum
 
@@ -130,6 +144,7 @@ def blockwise_attention(
     q_offset: jax.Array | int = 0,
     kv_offset: jax.Array | int = 0,
     key_mask: jax.Array | None = None,
+    query_mask: jax.Array | None = None,
     window: int = 0,
 ) -> jax.Array:
     """Exact attention over (B, T, H, D) tensors with O(T * chunk) memory.
@@ -137,13 +152,20 @@ def blockwise_attention(
     ``k``/``v`` may be grouped-query narrow (B, Tk, Hkv, D). ``key_mask``
     is an optional (B, Tk) padding mask (nonzero = attend), the
     reference's in-attention padding semantics (gpt.py:60-64).
-    ``window`` > 0 restricts each query to its trailing ``window`` keys
-    (requires ``causal``).
+    ``query_mask`` (B, Tq) upgrades both masks to SEGMENT semantics
+    (packed sequences): equal nonzero values = same document, and a key
+    is live only for same-segment queries. ``window`` > 0 restricts each
+    query to its trailing ``window`` keys (requires ``causal``).
     """
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
+    if query_mask is not None and key_mask is None:
+        raise ValueError(
+            "query_mask (segment semantics) requires key_mask — passing it "
+            "alone would silently apply NO masking"
+        )
     b, tq, h, d = q.shape
     q_chunk = min(q_chunk, tq)
     kv_chunk = min(kv_chunk, k.shape[1])
@@ -155,6 +177,11 @@ def blockwise_attention(
 
     def one_q_chunk(qi):
         qc = jax.lax.dynamic_slice_in_dim(q, qi * q_chunk, q_chunk, axis=1)
+        qm = (
+            jax.lax.dynamic_slice_in_dim(query_mask, qi * q_chunk, q_chunk, axis=1)
+            if query_mask is not None
+            else None
+        )
         acc, _, row_sum = _chunk_scan(
             qc,
             k,
@@ -164,6 +191,7 @@ def blockwise_attention(
             causal=causal,
             kv_chunk=kv_chunk,
             key_mask=key_mask,
+            query_mask=qm,
             window=window,
         )
         return (acc / row_sum[..., None]).astype(q.dtype)

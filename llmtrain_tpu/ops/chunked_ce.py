@@ -175,7 +175,9 @@ def chunked_ce_components(
     if attention_mask is None:
         mask = jnp.ones_like(per_token)
     else:
-        mask = attention_mask.astype(jnp.float32)
+        # Boolean semantics: segment ids > 1 (packed cross-document
+        # masking) must not become loss weights.
+        mask = (attention_mask != 0).astype(jnp.float32)
     return jnp.sum(per_token * mask, axis=-1), jnp.sum(mask, axis=-1)
 
 

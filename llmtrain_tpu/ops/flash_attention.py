@@ -61,9 +61,11 @@ def _pallas_bwd_enabled() -> bool:
 
 
 def _blockwise(q, k, v, key_mask=None, window=0):
-    # blockwise consumes grouped-query narrow K/V natively.
+    # blockwise consumes grouped-query narrow K/V natively. query_mask =
+    # key_mask upgrades to segment semantics (q and k cover the same
+    # sequence here), matching the Pallas kernels and dense_attention.
     return blockwise_attention(q, k, v, causal=True, key_mask=key_mask,
-                               window=window)
+                               query_mask=key_mask, window=window)
 
 
 # ``window`` is a static Python int (0 = off) and travels as the leading

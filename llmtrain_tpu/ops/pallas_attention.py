@@ -55,17 +55,20 @@ def _flash_kernel(
     """One q-block vs the streamed K/V sequence.
 
     Ref shapes: q (1, BQ, D), k/v (1, T, D), o (1, BQ, D), l (1, 1, BQ),
-    optional mask (1, 1, T) int32 ahead of the outputs when ``masked``.
-    ``l`` is the per-row logsumexp of the scaled/masked logits — the
-    residual the backward kernels use to recompute P without a re-softmax.
-    It is carried with a singleton middle dim so its block shape satisfies
-    Mosaic's tiling rule (second-to-last block dim == array dim).
+    optional mask (1, 1, T) int32 + its q-block view (1, 1, BQ) ahead of
+    the outputs when ``masked``. Mask values are SEGMENT ids: nonzero =
+    real token, equal values = same document (plain 0/1 padding masks are
+    the one-segment special case). ``l`` is the per-row logsumexp of the
+    scaled/masked logits — the residual the backward kernels use to
+    recompute P without a re-softmax. It is carried with a singleton
+    middle dim so its block shape satisfies Mosaic's tiling rule
+    (second-to-last block dim == array dim).
     """
     if masked:
-        mask_ref, o_ref, l_ref = rest
+        mask_ref, mask_q_ref, o_ref, l_ref = rest
     else:
         (o_ref, l_ref) = rest
-        mask_ref = None
+        mask_ref = mask_q_ref = None
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
     seq_len = k_ref.shape[1]
@@ -106,7 +109,12 @@ def _flash_kernel(
             s = jnp.where(live, s, _NEG_INF)
         if masked:
             m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]  # (BK,) int32
-            s = jnp.where(m_blk[None, :] != 0, s, _NEG_INF)
+            mq = mask_q_ref[0, 0]  # (BQ,) int32 — this q-block's segments
+            s = jnp.where(
+                (m_blk[None, :] != 0) & (mq[:, None] == m_blk[None, :]),
+                s,
+                _NEG_INF,
+            )
         new_max = jnp.maximum(row_max, s.max(axis=1))
         p = jnp.exp(s - new_max[:, None])
         correction = jnp.exp(row_max - new_max)
@@ -222,8 +230,13 @@ def pallas_flash_attention_fwd(
     ]
     operands = [qf, kf, vf]
     if masked:
+        mask3 = _mask3(mask)
         in_specs.append(pl.BlockSpec((1, 1, t), lambda bh, qi: (bh // h, 0, 0)))
-        operands.append(_mask3(mask))
+        operands.append(mask3)
+        # The SAME mask array again, tiled per q-block (segment ids for
+        # this block's queries).
+        in_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh // h, 0, qi)))
+        operands.append(mask3)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
@@ -269,13 +282,14 @@ def _bwd_dq_kernel(
     """dQ for one q-block, streaming K/V (same schedule as the forward).
 
     Ref shapes: q/do/dq (1, BQ, D), k/v (1, T, D), l/d (1, 1, BQ),
-    optional mask (1, 1, T) ahead of the output when ``masked``.
+    optional mask (1, 1, T) + its q-block view (1, 1, BQ) ahead of the
+    output when ``masked`` (segment semantics — see ``_flash_kernel``).
     """
     if masked:
-        mask_ref, dq_ref = rest
+        mask_ref, mask_q_ref, dq_ref = rest
     else:
         (dq_ref,) = rest
-        mask_ref = None
+        mask_ref = mask_q_ref = None
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
     seq_len = k_ref.shape[1]
@@ -316,7 +330,12 @@ def _bwd_dq_kernel(
             s = jnp.where(live, s, _NEG_INF)
         if masked:
             m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
-            s = jnp.where(m_blk[None, :] != 0, s, _NEG_INF)
+            mq = mask_q_ref[0, 0]  # (BQ,)
+            s = jnp.where(
+                (m_blk[None, :] != 0) & (mq[:, None] == m_blk[None, :]),
+                s,
+                _NEG_INF,
+            )
         p = jnp.exp(s - lse[:, None])  # (BQ, BK)
         dp = jax.lax.dot_general(
             do, v_blk,
@@ -344,17 +363,19 @@ def _bwd_dkdv_kernel(
     that query head's Q/dO/L/D from the causal diagonal down.
 
     Ref shapes: k/v/dk/dv (1, BK, D), q/do (1, T, D), l/d (1, 1, T),
-    optional mask (1, 1, BK) ahead of the outputs when ``masked``.
+    optional mask (1, 1, BK) + the full-length mask (1, 1, T) for the
+    streamed queries' segments, ahead of the outputs when ``masked``
+    (segment semantics — see ``_flash_kernel``).
     The query group (G = n_heads // n_kv_heads, 1 for classic MHA) is the
     INNERMOST grid dimension: the dk/dv output block stays resident across
     the G consecutive revisits and accumulates in float32 — VMEM stays
     O(T·D) however large the group (MQA makes G = n_heads).
     """
     if masked:
-        mask_ref, dk_ref, dv_ref = rest
+        mask_ref, mask_q_ref, dk_ref, dv_ref = rest
     else:
         dk_ref, dv_ref = rest
-        mask_ref = None
+        mask_ref = mask_q_ref = None
     block_k = k_ref.shape[1]
     head_dim = k_ref.shape[2]
     seq_len = q_ref.shape[1]
@@ -366,7 +387,8 @@ def _bwd_dkdv_kernel(
 
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     if masked:
-        key_live = mask_ref[0, 0] != 0  # (BK,)
+        k_seg = mask_ref[0, 0]  # (BK,) segment ids
+        key_live = k_seg != 0
 
     num_q = seq_len // block_q
     start_q = 0
@@ -399,7 +421,12 @@ def _bwd_dkdv_kernel(
                 live &= q_pos - k_pos < window
             s = jnp.where(live, s, _NEG_INF)
         if masked:
-            s = jnp.where(key_live[None, :], s, _NEG_INF)
+            q_seg = mask_q_ref[0, 0, pl.ds(qb * block_q, block_q)]  # (BQ,)
+            s = jnp.where(
+                key_live[None, :] & (q_seg[:, None] == k_seg[None, :]),
+                s,
+                _NEG_INF,
+            )
         p = jnp.exp(s - lse[:, None])  # (BQ, BK)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do_blk,
@@ -496,6 +523,11 @@ def pallas_flash_attention_bwd(
     if masked:
         seq_specs.append(pl.BlockSpec((1, 1, t), lambda bh, qi: (bh // h, 0, 0)))
         dq_operands.append(mask_arr)
+        # Same mask, q-block tiled (the queries' segment ids).
+        seq_specs.append(
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh // h, 0, qi))
+        )
+        dq_operands.append(mask_arr)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal,
@@ -527,6 +559,11 @@ def pallas_flash_attention_bwd(
     if masked:
         kv_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda r, ki, g: (r // hkv, 0, ki))
+        )
+        dkdv_operands.append(mask_arr)
+        # Full-length mask for the streamed queries' segment ids.
+        kv_specs.append(
+            pl.BlockSpec((1, 1, t), lambda r, ki, g: (r // hkv, 0, 0))
         )
         dkdv_operands.append(mask_arr)
     # f32 block residency is only needed when the group accumulates across

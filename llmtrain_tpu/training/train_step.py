@@ -65,7 +65,7 @@ def make_loss_fn(
                     jnp.float32,
                 )
             else:
-                tokens = mask.astype(jnp.float32).sum(axis=-1)
+                tokens = (mask != 0).astype(jnp.float32).sum(axis=-1)
             # Fallback: distribute the scalar loss uniformly per token.
             return loss, (loss * tokens, tokens)
         loss_sum, tokens = comps

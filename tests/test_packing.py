@@ -1,0 +1,315 @@
+"""Packed-sequence cross-document masking (segment semantics).
+
+The attention mask operand is generalized: nonzero = real token, EQUAL
+nonzero values = same document. Plain 0/1 padding masks are the
+one-segment special case, so every existing masked path keeps its
+behavior; segment ids > 1 make attention block-diagonal-within-causal
+and the data modules emit them via ``data.extra.split_documents``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from llmtrain_tpu.models.gpt import GPT, dense_attention
+from llmtrain_tpu.ops.blockwise_attention import blockwise_attention
+from llmtrain_tpu.ops.pallas_attention import (
+    pallas_flash_attention,
+    pallas_flash_attention_bwd,
+    pallas_flash_attention_fwd,
+)
+
+
+def _qkv(b=2, t=32, h=2, d=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), jnp.float32) for k in ks)
+
+
+def _segments(b=2, t=32):
+    """Two documents + trailing padding: seg 1 | seg 2 | 0."""
+    seg = np.ones((b, t), np.int32)
+    seg[:, 14:28] = 2
+    seg[:, 28:] = 0
+    return jnp.asarray(seg)
+
+
+class TestSegmentOps:
+    def test_dense_isolates_documents(self):
+        """Each document's rows equal attention over that document alone."""
+        q, k, v = _qkv()
+        seg = _segments()
+        out = dense_attention(q, k, v, attention_mask=seg)
+        doc1 = dense_attention(q[:, :14], k[:, :14], v[:, :14], attention_mask=None)
+        doc2 = dense_attention(q[:, 14:28], k[:, 14:28], v[:, 14:28], attention_mask=None)
+        np.testing.assert_allclose(np.asarray(out)[:, :14], np.asarray(doc1), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out)[:, 14:28], np.asarray(doc2), atol=1e-5)
+
+    def test_pallas_and_blockwise_match_dense(self):
+        q, k, v = _qkv(seed=1)
+        seg = _segments()
+        ref = dense_attention(q, k, v, attention_mask=seg)
+        pal = pallas_flash_attention(q, k, v, seg, block_q=8, block_k=8, interpret=True)
+        blk = blockwise_attention(
+            q, k, v, causal=True, q_chunk=8, kv_chunk=8,
+            key_mask=seg, query_mask=seg,
+        )
+        live = np.asarray(seg != 0)[:, :, None, None]
+        for got in (pal, blk):
+            np.testing.assert_allclose(
+                np.asarray(got) * live, np.asarray(ref) * live, atol=1e-5
+            )
+
+    def test_pallas_bwd_matches_dense_grads(self):
+        q, k, v = _qkv(seed=2)
+        seg = _segments()
+        g = jax.random.normal(jax.random.key(3), q.shape, jnp.float32)
+        g = g * (seg != 0)[:, :, None, None].astype(jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, attention_mask=seg) * g)
+
+        out, lse = pallas_flash_attention_fwd(
+            q, k, v, seg, block_q=8, block_k=8, interpret=True
+        )
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, seg, block_q=8, block_k=8, interpret=True
+        )
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4)
+
+    def test_zero_one_masks_unchanged(self):
+        """Plain padding masks (the one-segment case) keep their exact
+        pre-segment behavior on real-query rows."""
+        q, k, v = _qkv(seed=4)
+        mask = jnp.asarray(
+            np.concatenate([np.ones((2, 20), np.int32), np.zeros((2, 12), np.int32)], 1)
+        )
+        out = dense_attention(q, k, v, attention_mask=mask)
+        # Key-only reference (the old semantics) on real rows.
+        big = jnp.finfo(jnp.float32).min
+        import math
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(q.shape[-1])
+        t = q.shape[1]
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(causal[None, None], s.astype(jnp.float32), big)
+        s = jnp.where((mask != 0)[:, None, None, :], s, big)
+        ref = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(s, -1).astype(q.dtype), v
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[:, :20], np.asarray(ref)[:, :20], atol=1e-5
+        )
+
+
+class TestSegmentModel:
+    def test_doc_b_logits_independent_of_doc_a(self):
+        """Perturbing document A's tokens must not change document B's
+        logits when the mask carries segments — and must change them
+        under a plain all-ones mask."""
+        m = GPT(vocab_size=64, block_size=16, d_model=32, n_layers=2,
+                n_heads=4, d_ff=64, dropout=0.0, attention="flash")
+        from flax.linen import meta as nn_meta
+
+        p = nn_meta.unbox(
+            m.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32),
+                   deterministic=True)["params"]
+        )
+        seg = jnp.asarray([[1] * 8 + [2] * 8])
+        a = jnp.asarray([np.r_[np.arange(1, 9), np.arange(20, 28)]]).astype(jnp.int32)
+        b = a.at[0, 2].set(44)  # perturb doc A only
+        la = m.apply({"params": p}, a, attention_mask=seg, deterministic=True)
+        lb = m.apply({"params": p}, b, attention_mask=seg, deterministic=True)
+        np.testing.assert_allclose(
+            np.asarray(la)[:, 8:], np.asarray(lb)[:, 8:], atol=1e-5
+        )
+        ones = jnp.ones_like(seg)
+        fa = m.apply({"params": p}, a, attention_mask=ones, deterministic=True)
+        fb = m.apply({"params": p}, b, attention_mask=ones, deterministic=True)
+        assert np.abs(np.asarray(fa)[:, 8:] - np.asarray(fb)[:, 8:]).max() > 1e-4
+
+    def test_boundary_positions_are_loss_masked(self):
+        """The loss ignores positions whose label is the next document's
+        first token (mask 0 there, boolean loss weights)."""
+        from llmtrain_tpu.models.base import masked_ce_components
+
+        logits = jax.random.normal(jax.random.key(5), (1, 6, 16))
+        labels = jnp.zeros((1, 6), jnp.int32)
+        mask = jnp.asarray([[1, 1, 0, 2, 2, 2]])  # boundary at position 2
+        loss_sum, tokens = masked_ce_components(logits, labels, mask)
+        assert float(tokens[0]) == 5.0  # boolean count, not 1+1+0+2+2+2
+
+
+class TestSplitDocumentsData:
+    def test_window_dataset_emits_segments_and_boundary_zeros(self):
+        from llmtrain_tpu.data.hf_text import TokenWindowDataset
+
+        tokens = np.arange(20, dtype=np.int32)
+        # Docs: [0..6), [6..15), [15..20) — window 0 covers 0..8 (chunk 9).
+        ds = TokenWindowDataset(
+            tokens, block_size=8, doc_starts=np.asarray([0, 6, 15]),
+            split_documents=True,
+        )
+        ex = ds.get_examples(np.asarray([0]))
+        # Positions 0..7: docs 1,1,1,1,1,1,2,2; labels are positions 1..8.
+        # Boundary at position 5 (label = position 6 = doc 2) -> 0.
+        assert ex["attention_mask"][0].tolist() == [1, 1, 1, 1, 1, 0, 2, 2]
+        assert ex["input_ids"][0].tolist() == list(range(8))
+
+    def test_local_text_split_documents_end_to_end(self, tmp_path):
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        (corpus / "a.txt").write_text("a" * 30)
+        (corpus / "b.txt").write_text("b" * 30)
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "pk", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "gpt", "block_size": 16, "d_model": 32,
+                    "n_layers": 1, "n_heads": 2, "d_ff": 64, "dropout": 0.0,
+                    "vocab_size": 257,
+                },
+                "data": {
+                    "name": "local_text",
+                    "cache_dir": str(tmp_path / "cache"),
+                    "extra": {
+                        "globs": [str(corpus / "*.txt")],
+                        "val_fraction": 0.0,
+                        "split_documents": True,
+                    },
+                },
+                "trainer": {"max_steps": 2, "micro_batch_size": 1,
+                            "warmup_steps": 0},
+                "mlflow": {"enabled": False},
+            }
+        )
+        dm = LocalTextDataModule()
+        dm.setup(cfg, ByteTokenizer())
+        ex = dm.train_dataset().get_examples(np.asarray([1]))
+        mask = ex["attention_mask"][0]
+        # Window 1 covers positions 17..33: doc a (0..31 incl. separator)
+        # then doc b — two distinct nonzero segments with one boundary 0.
+        vals = set(mask.tolist())
+        assert 0 in vals and len(vals - {0}) == 2
+
+    def test_jsonl_records_are_separate_documents(self, tmp_path):
+        """split_documents boundaries are per JSON record, not per file —
+        two records in ONE file must land in different segments."""
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.data.local_text import LocalTextDataModule
+        from llmtrain_tpu.data.tokenizers import ByteTokenizer
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        (corpus / "data.jsonl").write_text(
+            '{"text": "' + "x" * 20 + '"}\n{"text": "' + "y" * 20 + '"}\n'
+        )
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "jl", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "gpt", "block_size": 30, "d_model": 32,
+                    "n_layers": 1, "n_heads": 2, "d_ff": 64, "dropout": 0.0,
+                    "vocab_size": 257,
+                },
+                "data": {
+                    "name": "local_text",
+                    "cache_dir": str(tmp_path / "cache"),
+                    "extra": {
+                        "globs": [str(corpus / "*.jsonl")],
+                        "val_fraction": 0.0,
+                        "format": "jsonl",
+                        "split_documents": True,
+                    },
+                },
+                "trainer": {"max_steps": 1, "micro_batch_size": 1,
+                            "warmup_steps": 0},
+                "mlflow": {"enabled": False},
+            }
+        )
+        dm = LocalTextDataModule()
+        dm.setup(cfg, ByteTokenizer())
+        mask = dm.train_dataset().get_examples(np.asarray([0]))["attention_mask"][0]
+        # Window 0 spans both records: two distinct nonzero segment ids.
+        assert len(set(mask.tolist()) - {0}) == 2
+
+    def test_split_documents_rejects_ring_and_assume_packed(self, tmp_path):
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.data.base import validate_split_documents as _validate_split_documents
+
+        def cfg(**model_extra_or_attention):
+            attention = model_extra_or_attention.pop("attention", "flash")
+            return RunConfig.model_validate(
+                {
+                    "run": {"name": "x", "seed": 0, "device": "cpu"},
+                    "model": {
+                        "name": "gpt", "block_size": 16, "d_model": 32,
+                        "n_layers": 1, "n_heads": 2, "d_ff": 64,
+                        "dropout": 0.0, "vocab_size": 64,
+                        "attention": attention,
+                        "extra": model_extra_or_attention,
+                    },
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1, "micro_batch_size": 1,
+                                "warmup_steps": 0},
+                    "mlflow": {"enabled": False},
+                }
+            )
+
+        with pytest.raises(ValueError, match="ring"):
+            _validate_split_documents(cfg(attention="ring"))
+        with pytest.raises(ValueError, match="assume_packed"):
+            _validate_split_documents(cfg(assume_packed=True))
+        _validate_split_documents(cfg())  # flash: fine
+
+
+class TestTrainerEndToEnd:
+    def test_training_runs_with_split_documents(self, tmp_path):
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        for i, ch in enumerate("abcd"):
+            (corpus / f"{ch}.txt").write_text(ch * 120)
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "pk-train", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "gpt", "block_size": 32, "d_model": 32,
+                    "n_layers": 2, "n_heads": 4, "d_ff": 64, "dropout": 0.0,
+                    "vocab_size": 257, "attention": "flash",
+                    "extra": {"tokenizer": "byte"},
+                },
+                "data": {
+                    "name": "local_text",
+                    "cache_dir": str(tmp_path / "cache"),
+                    "extra": {
+                        "globs": [str(corpus / "*.txt")],
+                        "val_fraction": 0.2,
+                        "split_documents": True,
+                    },
+                },
+                "trainer": {
+                    "max_steps": 8, "micro_batch_size": 2,
+                    "grad_accum_steps": 1, "lr": 5e-3, "warmup_steps": 0,
+                    "log_every_steps": 4, "eval_every_steps": 8,
+                    "save_every_steps": 100,
+                },
+                "mlflow": {"enabled": False},
+            }
+        )
+        initialize_registries()
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert np.isfinite(res.final_loss)
+        assert res.final_loss < res.first_step_loss
