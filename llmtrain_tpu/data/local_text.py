@@ -116,8 +116,12 @@ class LocalTextDataModule(DataModule):
         # cache rule).
         h = hashlib.sha256()
         # text_key only matters in jsonl mode; hashing it in text mode would
-        # invalidate the cache on an irrelevant config change.
-        h.update(f"{fmt}:{text_key if fmt == 'jsonl' else ''};".encode())
+        # invalidate the cache on an irrelevant config change. The "r2"
+        # marker versions the jsonl ingestion: per-RECORD encoding (for
+        # document boundaries) can merge BPE tokens differently than the
+        # old joined-text encode, so pre-change jsonl caches must not be
+        # silently reused. Text-mode streams are unchanged — no bump.
+        h.update(f"{fmt}:{text_key + ':r2' if fmt == 'jsonl' else ''};".encode())
         for f in files:
             st = Path(f).stat()
             h.update(f.encode())
