@@ -241,6 +241,46 @@ class TestLlamaArchitecture:
             LlamaAdapter().build_model(_cfg(rms_norm_eps=0.0))
 
 
+class TestLlamaSequenceParallel:
+    """RoPE composes with ring/Ulysses SP: the rotation happens on the
+    global view BEFORE the sequence-sharded attention, so positions are
+    absolute regardless of the shard layout."""
+
+    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    def test_sp_matches_dense(self, attention, caplog):
+        import logging
+
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        dense = _model(attention="dense", n_kv_heads=2)
+        p = _params(dense)
+        sp = _model(attention=attention, n_kv_heads=2)
+        ids = jax.random.randint(jax.random.key(70), (2, T), 0, V)
+
+        want = dense.apply({"params": p}, ids, deterministic=True)
+        mesh = Mesh(
+            np.array(jax.devices("cpu")[:4]).reshape(1, 4),
+            ("data", "sequence"),
+        )
+        with caplog.at_level(logging.WARNING, logger="llmtrain"), mesh:
+            ids_sharded = jax.device_put(
+                ids, NamedSharding(mesh, P("data", "sequence"))
+            )
+            got = jax.jit(
+                lambda pp, xx: sp.apply({"params": pp}, xx, deterministic=True)
+            )(p, ids_sharded)
+            np.asarray(got)
+        # Vacuity guard: a silent fallback to blockwise would also match
+        # dense — the SP path must actually have been routed
+        # (ops/ring_attention.py logs "falling back" when it is not).
+        assert not any(
+            "falling back" in r.getMessage() for r in caplog.records
+        ), "sequence-parallel routing fell back to blockwise"
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-4
+        )
+
+
 class TestLlamaSharded:
     def test_train_step_on_fsdp_tp_mesh(self):
         """One Trainer step under {data:2, fsdp:2, tensor:2} — the logical
