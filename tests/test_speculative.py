@@ -125,6 +125,28 @@ class TestGreedyExactness:
         assert got.tolist() == want.tolist()
 
 
+class TestMoETarget:
+    def test_llama_moe_target_matches_plain(self):
+        """Mixtral-class target (SwiGLU experts, top-2 routing, GQA,
+        window): expert routing re-evaluates per decode step, and the
+        exactness contract must survive it."""
+        m = Llama(
+            vocab_size=V, block_size=64, d_model=32, n_layers=2, n_heads=4,
+            d_ff=64, dropout=0.0, n_experts=4, router_top_k=2,
+            capacity_factor=2.0, n_kv_heads=2, sliding_window=6,
+        )
+        p = nn_meta.unbox(
+            m.init(jax.random.key(40), jnp.zeros((1, 4), jnp.int32),
+                   deterministic=True)["params"]
+        )
+        d, dp = _llama(n_layers=1, seed=41)
+        want = generate(m, p, PROMPT, max_new_tokens=10, temperature=0.0,
+                        use_cache=True)
+        got = speculative_generate(m, p, d, dp, PROMPT, max_new_tokens=10,
+                                   gamma=3)
+        assert got.tolist() == want.tolist()
+
+
 class TestEosParity:
     def test_eos_stop_matches_plain(self):
         """Pick a token the greedy chain actually emits as 'eos': both
