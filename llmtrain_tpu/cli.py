@@ -670,6 +670,9 @@ def _prepare_decode_model(model, params, decode_param_dtype: str, logger, label=
             param_dtype=model.param_dtype,
             attention=model.attention,
             n_kv_heads=model.n_kv_heads,
+            # A windowed pipeline checkpoint must keep its window at
+            # decode time (rolling cache + masked reads).
+            sliding_window=getattr(model, "sliding_window", 0),
         )
         logger.info(
             "%spipeline checkpoint converted to the gpt tree for KV-cache "

@@ -50,7 +50,9 @@ def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
+def make_block_apply(
+    *, attention: str, dtype: Any, tp_axis: str | None = None, window: int = 0
+):
     """Functional pre-norm transformer block over stacked params.
 
     ``p`` leaves are ONE layer's slice (no leading layer dim); ``h`` is
@@ -88,13 +90,15 @@ def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
 
             # Narrow GQA K/V consumed natively (Pallas index maps on TPU,
             # grouped einsums in the blockwise fallback).
-            att = flash_attention(q, k, v, attention_mask=key_mask, causal=True)
+            att = flash_attention(
+                q, k, v, attention_mask=key_mask, causal=True, window=window
+            )
         else:
             if k.shape[2] != q.shape[2]:
                 reps = q.shape[2] // k.shape[2]
                 k = jnp.repeat(k, reps, axis=2)
                 v = jnp.repeat(v, reps, axis=2)
-            att = dense_attention(q, k, v, attention_mask=key_mask)
+            att = dense_attention(q, k, v, attention_mask=key_mask, window=window)
         proj = jnp.einsum(
             "bthe,hed->btd", att.astype(dtype), p["out_kernel"].astype(dtype)
         )
@@ -120,10 +124,14 @@ def make_block_apply(*, attention: str, dtype: Any, tp_axis: str | None = None):
     return block_apply
 
 
-def make_stage_fn(*, attention: str, dtype: Any, tp_axis: str | None = None):
+def make_stage_fn(
+    *, attention: str, dtype: Any, tp_axis: str | None = None, window: int = 0
+):
     """Stage program: scan ``block_apply`` over this stage's layer slice.
     ``key_mask`` is the microbatch's (B, T) padding mask (or None)."""
-    block_apply = make_block_apply(attention=attention, dtype=dtype, tp_axis=tp_axis)
+    block_apply = make_block_apply(
+        attention=attention, dtype=dtype, tp_axis=tp_axis, window=window
+    )
 
     def stage_fn(
         stage_params: dict[str, jax.Array],
@@ -168,6 +176,9 @@ class PipelineGPT(nn.Module):
     # Data is guaranteed packed (all-ones masks): skip the in-attention
     # mask (model.extra.assume_packed, same knob as models/gpt.py).
     assume_packed: bool = False
+    # Sliding-window attention (model.extra.sliding_window, Mistral
+    # semantics — see models/gpt.py); 0 = full causal.
+    sliding_window: int = 0
     # Grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = MQA), the
     # same semantics and param naming family as models/gpt.py — flash
     # consumes the narrow K/V natively, dense broadcasts.
@@ -341,7 +352,8 @@ class PipelineGPT(nn.Module):
 
             tp_axis = "tensor" if tp > 1 else None
             stage_fn = make_stage_fn(
-                attention=self.attention, dtype=self.dtype, tp_axis=tp_axis
+                attention=self.attention, dtype=self.dtype, tp_axis=tp_axis,
+                window=self.sliding_window,
             )
 
             def _pspec(*tail):
@@ -396,7 +408,10 @@ class PipelineGPT(nn.Module):
                 mask=attention_mask,
             )
         else:
-            stage_fn = make_stage_fn(attention=self.attention, dtype=self.dtype)
+            stage_fn = make_stage_fn(
+                attention=self.attention, dtype=self.dtype,
+                window=self.sliding_window,
+            )
             fn = jax.checkpoint(stage_fn) if self.remat else stage_fn
             x = fn(blocks, x) if attention_mask is None else fn(blocks, x, attention_mask)
 
@@ -457,6 +472,7 @@ class PipelineGPTAdapter(ModelAdapter):
             "n_kv_heads",
             "pipeline_microbatches",
             "pipeline_virtual_chunks",
+            "sliding_window",
         }
     )
 
@@ -495,6 +511,11 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"model.n_heads ({cfg.model.n_heads}) must be divisible by "
                 f"model.extra.n_kv_heads ({n_kv_heads})"
             )
+        sliding_window = int(cfg.model.extra.get("sliding_window", 0))
+        if sliding_window < 0:
+            raise ValueError(
+                f"model.extra.sliding_window must be >= 0, got {sliding_window}"
+            )
         return PipelineGPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -514,6 +535,7 @@ class PipelineGPTAdapter(ModelAdapter):
             z_loss=z_loss,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             n_kv_heads=n_kv_heads,
+            sliding_window=sliding_window,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

@@ -240,6 +240,30 @@ class TestPipelineGPT:
         for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_windowed_pipelined_matches_sequential(self, attention):
+        """sliding_window flows into every stage's attention: the
+        pipelined result equals the single-device stack, and the window
+        actually binds (differs from full causal)."""
+        cfg = _pp_cfg(
+            model={
+                "attention": attention,
+                "extra": {
+                    "tokenizer": "byte",
+                    "pipeline_microbatches": 2,
+                    "sliding_window": 5,
+                },
+            }
+        )
+        _, model, params = self._build(cfg)
+        tokens = jax.random.randint(jax.random.key(7), (8, 16), 0, 32)
+        ref = model.apply({"params": params}, tokens)
+        with _mesh():
+            out = jax.jit(lambda p, t: model.apply({"params": p}, t))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+        full = model.clone(sliding_window=0).apply({"params": params}, tokens)
+        assert np.abs(np.asarray(full) - np.asarray(ref)).max() > 1e-4
+
     def test_assume_packed_drops_mask(self):
         """assume_packed ignores the mask operand entirely — identical
         output with and without one (all-ones equivalence is the packed
