@@ -32,12 +32,61 @@ class ByteTokenizer:
         return bytes(arr.astype(np.uint8).tolist()).decode("utf-8", errors="replace")
 
 
+class HFTokenizer:
+    """Wrapper over a HuggingFace ``tokenizer.json`` file (offline).
+
+    The companion of the HF-Llama checkpoint interop
+    (interop/llama_hf.py): import the weights, point
+    ``model.extra.tokenizer: "hf:<tokenizer.json>"`` at the matching
+    fast-tokenizer file, and text generation speaks the checkpoint's own
+    vocabulary — no network, no transformers pipeline. Exposes the same
+    protocol the rest of the stack expects (``n_vocab``/``encode``/
+    ``decode``/``eot_token``/``fingerprint``).
+    """
+
+    def __init__(self, path: str) -> None:
+        from tokenizers import Tokenizer  # bundled with transformers
+
+        self._tok = Tokenizer.from_file(path)
+        # Size by the HIGHEST id, not the token count: tokenizer.json id
+        # spaces can have holes (special tokens above a non-contiguous
+        # base vocab), and an embedding sized by count would silently
+        # clamp out-of-range ids onto the last row under jit.
+        vocab_ids = self._tok.get_vocab(with_added_tokens=True).values()
+        self.n_vocab = max(
+            int(self._tok.get_vocab_size(with_added_tokens=True)),
+            (max(vocab_ids) + 1) if vocab_ids else 0,
+        )
+        import hashlib
+        from pathlib import Path
+
+        self.fingerprint = hashlib.sha256(
+            Path(path).read_bytes()
+        ).hexdigest()[:12]
+        # End-of-text id for generation early-stop, when the vocab has a
+        # conventional marker.
+        vocab = self._tok.get_vocab(with_added_tokens=True)
+        for marker in ("</s>", "<|endoftext|>", "<eos>", "[SEP]"):
+            if marker in vocab:
+                self.eot_token = vocab[marker]
+                break
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._tok.encode(text, add_special_tokens=False).ids)
+
+    def decode(self, ids) -> str:
+        arr = np.asarray(ids, dtype=np.int64)
+        return self._tok.decode(arr.tolist(), skip_special_tokens=False)
+
+
 def build_tokenizer(name: str):
     """Resolve a tokenizer by config name.
 
-    "gpt2" (tiktoken, needs network), "byte" (offline fallback), or
+    "gpt2" (tiktoken, needs network), "byte" (offline fallback),
     "bpe:<path>" — a vocabulary trained offline with the
-    ``train-tokenizer`` CLI subcommand (data/bpe.py).
+    ``train-tokenizer`` CLI subcommand (data/bpe.py) — or
+    "hf:<tokenizer.json>" — a HuggingFace fast-tokenizer file (the
+    companion of HF-Llama checkpoint import).
     """
     if name == "byte":
         return ByteTokenizer()
@@ -49,8 +98,11 @@ def build_tokenizer(name: str):
         from .bpe import BPETokenizer
 
         return BPETokenizer.load(name[len("bpe:") :])
+    if name.startswith("hf:"):
+        return HFTokenizer(name[len("hf:") :])
     raise ValueError(
-        f"unknown tokenizer {name!r}; expected 'gpt2', 'byte', or 'bpe:<path>'"
+        f"unknown tokenizer {name!r}; expected 'gpt2', 'byte', 'bpe:<path>', "
+        "or 'hf:<tokenizer.json>'"
     )
 
 
@@ -67,4 +119,4 @@ def tokenizer_cache_id(tokenizer) -> str:
     )
 
 
-__all__ = ["ByteTokenizer", "build_tokenizer", "tokenizer_cache_id"]
+__all__ = ["ByteTokenizer", "HFTokenizer", "build_tokenizer", "tokenizer_cache_id"]

@@ -318,3 +318,90 @@ class TestNativeEncoder:
         tok = train_bpe(CORPUS, 400)
         assert tok._native is None
         assert tok.decode(tok.encode("the quick fox")) == "the quick fox"
+
+
+class TestHFTokenizer:
+    """tokenizer: "hf:<tokenizer.json>" — the HF-Llama interop companion."""
+
+    @pytest.fixture(scope="class")
+    def tok_file(self, tmp_path_factory):
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+        del tokenizers
+
+        path = tmp_path_factory.mktemp("hftok") / "tokenizer.json"
+        tok = Tokenizer(models.BPE(unk_token="<unk>"))
+        tok.pre_tokenizer = pre_tokenizers.Whitespace()
+        trainer = trainers.BpeTrainer(
+            vocab_size=64, special_tokens=["<unk>", "</s>"]
+        )
+        tok.train_from_iterator(
+            ["hello world hello there", "world of tokens and text"], trainer
+        )
+        tok.save(str(path))
+        return str(path)
+
+    def test_build_and_roundtrip(self, tok_file):
+        from llmtrain_tpu.data.tokenizers import build_tokenizer
+
+        tok = build_tokenizer(f"hf:{tok_file}")
+        assert tok.n_vocab > 0
+        ids = tok.encode("hello world")
+        assert ids and all(0 <= i < tok.n_vocab for i in ids)
+        assert "hello" in tok.decode(ids)
+
+    def test_eos_detected_and_cache_id(self, tok_file):
+        from llmtrain_tpu.data.tokenizers import (
+            build_tokenizer,
+            tokenizer_cache_id,
+        )
+
+        tok = build_tokenizer(f"hf:{tok_file}")
+        assert isinstance(getattr(tok, "eot_token", None), int)  # </s>
+        cid = tokenizer_cache_id(tok)
+        assert "HFTokenizer" in cid and tok.fingerprint in cid
+
+    def test_unknown_scheme_still_rejected(self):
+        from llmtrain_tpu.data.tokenizers import build_tokenizer
+
+        with pytest.raises(ValueError, match="hf:<tokenizer.json>"):
+            build_tokenizer("sentencepiece:x")
+
+    def test_trains_a_model_end_to_end(self, tok_file, tmp_path):
+        """local_text + hf tokenizer + gpt: the full offline loop for an
+        HF-ecosystem vocabulary."""
+        import numpy as np
+
+        from llmtrain_tpu.config import RunConfig
+        from llmtrain_tpu.registry import initialize_registries
+        from llmtrain_tpu.tracking import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        (corpus / "a.txt").write_text("hello world of tokens and text " * 40)
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "hf-tok", "seed": 0, "device": "cpu"},
+                "model": {
+                    "name": "llama", "block_size": 16, "d_model": 32,
+                    "n_layers": 1, "n_heads": 2, "d_ff": 64, "dropout": 0.0,
+                    "extra": {"tokenizer": f"hf:{tok_file}"},
+                },
+                "data": {
+                    "name": "local_text",
+                    "cache_dir": str(tmp_path / "cache"),
+                    "extra": {"globs": [str(corpus / "*.txt")],
+                               "val_fraction": 0.0},
+                },
+                "trainer": {"max_steps": 4, "micro_batch_size": 2,
+                            "lr": 5e-3, "warmup_steps": 0,
+                            "log_every_steps": 2, "eval_every_steps": 100,
+                            "save_every_steps": 100},
+                "mlflow": {"enabled": False},
+            }
+        )
+        initialize_registries()
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert np.isfinite(res.final_loss)
