@@ -149,6 +149,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=4,
         help="speculative lookahead: draft tokens proposed per target forward",
     )
+    gen.add_argument(
+        "--logprobs",
+        action="store_true",
+        help="include the model's log-probability of every emitted token "
+        "in the JSON output (not supported with --draft-config)",
+    )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
     evalp = sub.add_parser(
@@ -726,6 +732,9 @@ def _handle_generate(args: argparse.Namespace) -> int:
     if args.draft_config is not None and args.gamma < 1:
         _emit_error(f"--gamma must be >= 1, got {args.gamma}")
         return EXIT_CONFIG_ERROR
+    if args.draft_config is not None and args.logprobs:
+        _emit_error("--logprobs is not supported with speculative decoding")
+        return EXIT_CONFIG_ERROR
 
     # Fail fast on a bad prompts file — before the expensive registry/
     # tokenizer/model build, and with a clean error instead of a traceback.
@@ -865,6 +874,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
         results: list[dict] = [{} for _ in prompt_batches]
         for tp, idxs in sorted(by_len.items()):
             stacked = np.stack([prompt_batches[i] for i in idxs])
+            group_lps = None
             if draft is not None:
                 from .speculative import speculative_generate
 
@@ -899,7 +909,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 ]
                 out = np.concatenate(rows, axis=0)
             else:
-                out = generate(
+                gen_out = generate(
                     model,
                     params,
                     stacked,
@@ -911,7 +921,12 @@ def _handle_generate(args: argparse.Namespace) -> int:
                     top_k=args.top_k,  # generate() maps <=0 to "disabled"
                     top_p=args.top_p,
                     eos_token_id=eos_token_id,
+                    return_logprobs=args.logprobs,
                 )
+                if args.logprobs:
+                    out, group_lps = gen_out
+                else:
+                    out = gen_out
             for row, i in enumerate(idxs):
                 output_ids = [int(t) for t in out[row]]
                 results[i] = {
@@ -922,6 +937,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
                         tokenizer.decode(output_ids) if tokenizer is not None else None
                     ),
                 }
+                if args.logprobs and group_lps is not None:
+                    results[i]["logprobs"] = [
+                        round(float(x), 6) for x in group_lps[row]
+                    ]
                 if prompts is not None:
                     results[i]["prompt"] = prompts[i]
 

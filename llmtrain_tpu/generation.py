@@ -58,6 +58,14 @@ def filter_logits(
     return scaled
 
 
+def _chosen_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
+    """The MODEL's logprob of the emitted token (raw log-softmax —
+    temperature/top-k/top-p shape the CHOICE, not the report; the
+    OpenAI-style serving convention). Shared by both decode paths."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+
+
 def _sample_next(
     next_logits: jax.Array,  # (B, V) float32
     rng: jax.Array,
@@ -77,7 +85,8 @@ def _sample_next(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "model", "max_new_tokens", "temperature", "top_k", "top_p", "eos_token_id"
+        "model", "max_new_tokens", "temperature", "top_k", "top_p",
+        "eos_token_id", "with_logprobs",
     ),
 )
 def _generate_cached_jit(
@@ -92,7 +101,8 @@ def _generate_cached_jit(
     top_k: int | None,
     top_p: float | None,
     eos_token_id: int | None,
-) -> jax.Array:
+    with_logprobs: bool = False,
+) -> tuple[jax.Array, jax.Array]:
     def apply(cache, tokens):
         logits, mutated = model.apply(
             {"params": params, "cache": cache},
@@ -107,6 +117,11 @@ def _generate_cached_jit(
     tok0 = _sample_next(
         logits[:, -1], rng, 0, temperature=temperature, top_k=top_k, top_p=top_p
     ).astype(prompt.dtype)
+    # with_logprobs is STATIC: the default path keeps its pre-logprob
+    # cost (greedy decode pays only the argmax, no O(V) log-softmax).
+    lp0 = _chosen_logprob(logits[:, -1], tok0) if with_logprobs else jnp.zeros(
+        (prompt.shape[0],), jnp.float32
+    )
     done0 = jnp.zeros((prompt.shape[0],), jnp.bool_)
     if eos_token_id is not None:
         done0 = tok0 == eos_token_id
@@ -120,19 +135,30 @@ def _generate_cached_jit(
         if eos_token_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
             done = done | (nxt == eos_token_id)
-        return (cache, nxt, done), nxt
+        lp = (
+            _chosen_logprob(logits[:, 0], nxt)
+            if with_logprobs
+            else jnp.zeros((nxt.shape[0],), jnp.float32)
+        )
+        return (cache, nxt, done), (nxt, lp)
 
-    _, rest = jax.lax.scan(
+    _, (rest, rest_lps) = jax.lax.scan(
         step, (cache, tok0, done0), jnp.arange(1, max_new_tokens)
     )  # rest: (max_new_tokens-1, B)
     new_tokens = jnp.concatenate([tok0[:, None], rest.T], axis=1)
-    return jnp.concatenate([prompt, new_tokens], axis=1)
+    logprobs = (
+        jnp.concatenate([lp0[:, None], rest_lps.T], axis=1)
+        if with_logprobs
+        else jnp.zeros((prompt.shape[0], 0), jnp.float32)
+    )
+    return jnp.concatenate([prompt, new_tokens], axis=1), logprobs
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "model", "max_new_tokens", "window_len", "temperature", "top_k", "top_p"
+        "model", "max_new_tokens", "window_len", "temperature", "top_k",
+        "top_p", "with_logprobs",
     ),
 )
 def _generate_jit(
@@ -148,11 +174,12 @@ def _generate_jit(
     top_k: int | None,
     top_p: float | None = None,
     eos_token_id: int | None = None,
-) -> jax.Array:
+    with_logprobs: bool = False,
+) -> tuple[jax.Array, jax.Array]:
     total_len = buffer.shape[1]
 
     def step(i, carry):
-        buf, done = carry
+        buf, lps, done = carry
         cur = prompt_len + i  # (B,) next position to fill
 
         # Fixed-size context window ending at the longest current position.
@@ -186,11 +213,19 @@ def _generate_jit(
         buf = jax.vmap(
             lambda row, pos, tok: jax.lax.dynamic_update_slice(row, tok[None], (pos,))
         )(buf, cur, next_tok)
-        return buf, done
+        if with_logprobs:
+            chosen = _chosen_logprob(next_logits, next_tok)[:, None]
+            lps = jax.lax.dynamic_update_slice(lps, chosen, (0, i))
+        return buf, lps, done
 
     done0 = jnp.zeros((buffer.shape[0],), jnp.bool_)
-    buffer, _ = jax.lax.fori_loop(0, max_new_tokens, step, (buffer, done0))
-    return buffer
+    lps0 = jnp.zeros(
+        (buffer.shape[0], max_new_tokens if with_logprobs else 0), jnp.float32
+    )
+    buffer, logprobs, _ = jax.lax.fori_loop(
+        0, max_new_tokens, step, (buffer, lps0, done0)
+    )
+    return buffer, logprobs
 
 
 def generate(
@@ -205,7 +240,8 @@ def generate(
     top_p: float | None = None,
     eos_token_id: int | None = None,
     use_cache: bool | None = None,
-) -> np.ndarray:
+    return_logprobs: bool = False,
+) -> np.ndarray | tuple[np.ndarray, np.ndarray]:
     """Sample ``max_new_tokens`` continuations; returns (B, Tp+max_new_tokens).
 
     ``temperature=0`` decodes greedily; otherwise categorical sampling with
@@ -215,6 +251,9 @@ def generate(
     when the model supports it (``for_decoding()``) and the whole output fits
     in ``block_size``; ``False`` forces the sliding-window re-forward path
     (which also handles outputs longer than ``block_size``).
+    ``return_logprobs=True`` also returns the MODEL's log-probability of
+    each emitted token (raw log-softmax, (B, max_new_tokens) f32 —
+    temperature/top-k/top-p shape the choice, not the report).
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0; got {max_new_tokens}")
@@ -259,7 +298,8 @@ def generate(
         )
 
     if max_new_tokens == 0:
-        return ids.copy()
+        empty_lp = np.zeros((b, 0), np.float32)
+        return (ids.copy(), empty_lp) if return_logprobs else ids.copy()
 
     if use_cache:
         decode_model = model.for_decoding(cache_len=total)
@@ -272,7 +312,7 @@ def generate(
         cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), var_shapes["cache"]
         )
-        out = _generate_cached_jit(
+        out, lps = _generate_cached_jit(
             decode_model,
             params,
             cache,
@@ -283,14 +323,18 @@ def generate(
             top_k=top_k,
             top_p=top_p,
             eos_token_id=eos_token_id,
+            with_logprobs=return_logprobs,
         )
-        return np.asarray(jax.device_get(out))
+        tokens = np.asarray(jax.device_get(out))
+        if return_logprobs:
+            return tokens, np.asarray(jax.device_get(lps))
+        return tokens
 
     buffer = np.zeros((b, total), dtype=np.int32)
     buffer[:, :tp] = ids
     prompt_len = jnp.full((b,), tp, jnp.int32)
 
-    out = _generate_jit(
+    out, lps = _generate_jit(
         model,
         params,
         jnp.asarray(buffer),
@@ -302,8 +346,12 @@ def generate(
         top_k=top_k,
         top_p=top_p,
         eos_token_id=eos_token_id,
+        with_logprobs=return_logprobs,
     )
-    return np.asarray(jax.device_get(out))
+    tokens = np.asarray(jax.device_get(out))
+    if return_logprobs:
+        return tokens, np.asarray(jax.device_get(lps))
+    return tokens
 
 
 def generate_text(

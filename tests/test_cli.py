@@ -325,6 +325,31 @@ class TestGenerate:
             == json.loads(plain.stdout)["completion_ids"]
         )
 
+    def test_generate_logprobs(self, workdir):
+        first = _run(["train", "--config", "config.yaml", "--json",
+                      "--run-id", "runLP"], workdir)
+        assert first.returncode == 0, first.stderr
+        proc = _run(
+            ["generate", "--config", "config.yaml", "--from", "runLP",
+             "--prompt-ids", "1,2", "--max-new-tokens", "4",
+             "--temperature", "0", "--logprobs", "--json"],
+            workdir,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert len(out["logprobs"]) == 4
+        assert all(lp <= 0.0 for lp in out["logprobs"])
+
+    def test_logprobs_rejected_with_speculative(self, workdir):
+        proc = _run(
+            ["generate", "--config", "config.yaml", "--from", "x",
+             "--prompt-ids", "1", "--logprobs", "--draft-config",
+             "config.yaml", "--draft-from", "y"],
+            workdir,
+        )
+        assert proc.returncode == 2
+        assert "logprobs" in proc.stderr
+
     def test_speculative_flags_must_pair(self, workdir):
         proc = _run(
             ["generate", "--config", "config.yaml", "--from", "nope",

@@ -187,6 +187,53 @@ class TestGenerate:
             generate(model, params, np.zeros((1, 0), np.int32), max_new_tokens=2)
 
 
+class TestLogprobs:
+    def _model(self):
+        from flax.linen import meta as nn_meta
+
+        from llmtrain_tpu.models.gpt import GPT
+
+        m = GPT(vocab_size=32, block_size=32, d_model=32, n_layers=1,
+                n_heads=2, d_ff=64, dropout=0.0)
+        p = nn_meta.unbox(
+            m.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32),
+                   deterministic=True)["params"]
+        )
+        return m, p
+
+    @pytest.mark.parametrize("use_cache", [True, False])
+    def test_greedy_logprobs_match_manual_forward(self, use_cache):
+        """Per-token logprob == log_softmax of a fresh forward at each
+        prefix, at the emitted token — both decode paths."""
+        m, p = self._model()
+        prompt = np.asarray([[3, 1, 4]], np.int32)
+        out, lps = generate(
+            m, p, prompt, max_new_tokens=4, temperature=0.0,
+            use_cache=use_cache, return_logprobs=True,
+        )
+        assert lps.shape == (1, 4)
+        for j in range(4):
+            prefix = jnp.asarray(out[:, : prompt.shape[1] + j])
+            logits = m.apply({"params": p}, prefix, deterministic=True)
+            want = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            )[0, out[0, prompt.shape[1] + j]]
+            np.testing.assert_allclose(lps[0, j], float(want), atol=1e-4)
+
+    def test_default_return_unchanged(self):
+        m, p = self._model()
+        prompt = np.asarray([[3, 1, 4]], np.int32)
+        out = generate(m, p, prompt, max_new_tokens=3, temperature=0.0)
+        assert isinstance(out, np.ndarray) and out.shape == (1, 6)
+
+    def test_zero_new_tokens(self):
+        m, p = self._model()
+        prompt = np.asarray([[3, 1]], np.int32)
+        out, lps = generate(m, p, prompt, max_new_tokens=0,
+                            return_logprobs=True)
+        assert out.tolist() == prompt.tolist() and lps.shape == (1, 0)
+
+
 class TestTextHelpers:
     def test_generate_text_roundtrip(self, tiny_model):
         model, params = tiny_model
