@@ -101,15 +101,13 @@ def write_token_cache(cache_path, tokens, doc_starts) -> None:
 
 
 def validate_split_documents(cfg: RunConfig) -> None:
-    """Config combinations ``split_documents`` cannot serve, failed loudly."""
-    attention = cfg.model.attention
-    if attention in ("ring", "ulysses"):
-        raise ValueError(
-            "data.extra.split_documents is not supported with "
-            f"attention={attention!r}: the sequence-parallel paths apply "
-            "key-padding masks only (no cross-document segment equality); "
-            "use 'flash' or 'dense'"
-        )
+    """Config combinations ``split_documents`` cannot serve, failed loudly.
+
+    Ring/Ulysses are fine: segment masks ride both sequence-parallel
+    schemes (the ring rotates key segments with their K/V shards and
+    keeps the unrotated local shard as the query segments;
+    tests/test_ops.py::TestSequenceParallelMasks pins parity).
+    """
     if cfg.model.extra.get("assume_packed"):
         raise ValueError(
             "data.extra.split_documents emits segment masks, but "

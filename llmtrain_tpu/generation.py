@@ -128,6 +128,7 @@ def _generate_cached_jit(
 
     def step(carry, i):
         cache, tok, done = carry
+        done_in = done  # rows already ended BEFORE this step
         cache, logits = apply(cache, tok[:, None])
         nxt = _sample_next(
             logits[:, 0], rng, i, temperature=temperature, top_k=top_k, top_p=top_p
@@ -140,6 +141,11 @@ def _generate_cached_jit(
             if with_logprobs
             else jnp.zeros((nxt.shape[0],), jnp.float32)
         )
+        if with_logprobs and eos_token_id is not None:
+            # Post-eos padding is not an emission: report 0.0 so
+            # sum(logprobs) scores exactly the real sequence (the FIRST
+            # eos keeps its true logprob).
+            lp = jnp.where(done_in, 0.0, lp)
         return (cache, nxt, done), (nxt, lp)
 
     _, (rest, rest_lps) = jax.lax.scan(
@@ -180,6 +186,7 @@ def _generate_jit(
 
     def step(i, carry):
         buf, lps, done = carry
+        done_in = done  # rows already ended BEFORE this step
         cur = prompt_len + i  # (B,) next position to fill
 
         # Fixed-size context window ending at the longest current position.
@@ -214,8 +221,12 @@ def _generate_jit(
             lambda row, pos, tok: jax.lax.dynamic_update_slice(row, tok[None], (pos,))
         )(buf, cur, next_tok)
         if with_logprobs:
-            chosen = _chosen_logprob(next_logits, next_tok)[:, None]
-            lps = jax.lax.dynamic_update_slice(lps, chosen, (0, i))
+            chosen = _chosen_logprob(next_logits, next_tok)
+            if eos_token_id is not None:
+                # done_in (pre-update) marks post-eos padding — see the
+                # cached path: report 0.0 there.
+                chosen = jnp.where(done_in, 0.0, chosen)
+            lps = jax.lax.dynamic_update_slice(lps, chosen[:, None], (0, i))
         return buf, lps, done
 
     done0 = jnp.zeros((buffer.shape[0],), jnp.bool_)

@@ -63,6 +63,10 @@ def ring_attention(
             causal=causal,
             kv_chunk=chunk,
             key_mask=m_cur if masked else None,
+            # The UNROTATED local mask is this shard's queries' segment
+            # ids: equal-nonzero-value semantics (packed cross-document
+            # masking) ride the ring exactly like the key shards do.
+            query_mask=key_mask if masked else None,
         )
         new_max = jnp.maximum(row_max, max2)
         c1 = jnp.exp(row_max - new_max)
@@ -251,7 +255,12 @@ def route_or_blockwise(
                 _dim_shards(mesh, 1),
                 _dim_shards(mesh, 2),
             )
-    return blockwise_attention(q, k, v, causal=causal, key_mask=key_mask)
+    # query_mask = key_mask keeps SEGMENT semantics on the fallback: a
+    # split_documents mask degrading to key-padding-only here would
+    # silently re-open cross-document attention.
+    return blockwise_attention(
+        q, k, v, causal=causal, key_mask=key_mask, query_mask=key_mask
+    )
 
 
 def ring_or_blockwise(

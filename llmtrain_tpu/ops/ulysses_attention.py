@@ -93,7 +93,12 @@ def ulysses_attention(
         )
         kh, vh = kv[0], kv[1]  # each (B, T, Hkv/s, D)
 
-    out = blockwise_attention(qh, kh, vh, causal=causal, key_mask=key_mask)
+    # query_mask = key_mask: q and k cover the same full sequence after
+    # the all-to-all, so segment semantics (packed cross-document
+    # masking) apply directly.
+    out = blockwise_attention(
+        qh, kh, vh, causal=causal, key_mask=key_mask, query_mask=key_mask
+    )
     # Collective 2: back to sequence-sharded, all heads local.
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 

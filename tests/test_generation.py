@@ -220,6 +220,23 @@ class TestLogprobs:
             )[0, out[0, prompt.shape[1] + j]]
             np.testing.assert_allclose(lps[0, j], float(want), atol=1e-4)
 
+    @pytest.mark.parametrize("use_cache", [True, False])
+    def test_post_eos_logprobs_are_zero(self, use_cache):
+        """Forced post-eos padding reports 0.0, so sum(logprobs) scores
+        exactly the real emissions (the first eos keeps its logprob)."""
+        m, p = self._model()
+        prompt = np.asarray([[3, 1, 4]], np.int32)
+        free = generate(m, p, prompt, max_new_tokens=6, temperature=0.0,
+                        use_cache=use_cache)
+        eos = int(free[0, prompt.shape[1]])  # first generated token = eos
+        out, lps = generate(
+            m, p, prompt, max_new_tokens=6, temperature=0.0,
+            use_cache=use_cache, eos_token_id=eos, return_logprobs=True,
+        )
+        assert (out[0, prompt.shape[1] :] == eos).all()
+        assert lps[0, 0] < 0.0  # the real first emission
+        np.testing.assert_allclose(lps[0, 1:], 0.0)
+
     def test_default_return_unchanged(self):
         m, p = self._model()
         prompt = np.asarray([[3, 1, 4]], np.int32)

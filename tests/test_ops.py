@@ -312,6 +312,72 @@ class TestSequenceParallelMasks:
         )(q)
         np.testing.assert_allclose(np.asarray(g_sp), np.asarray(g_ref), atol=1e-4)
 
+    @pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+    def test_sharded_segment_mask_matches_dense(self, scheme):
+        """SEGMENT masks (packed cross-document) ride the SP schemes: the
+        query-side segments come from the unrotated local shard (ring) or
+        the full replicated mask (ulysses)."""
+        if scheme == "ring":
+            from llmtrain_tpu.ops.ring_attention import ring_attention_sharded as fn
+        else:
+            from llmtrain_tpu.ops.ulysses_attention import (
+                ulysses_attention_sharded as fn,
+            )
+
+        q, k, v = _qkv(b=4, t=16, h=4, d=8, seed=51)
+        seg = np.ones((4, 16), np.int32)
+        seg[:, 6:13] = 2  # doc boundary NOT on the shard boundary (t/2=8)
+        seg[:, 13:] = 0
+        seg = jnp.asarray(seg)
+        ref = dense_attention(q, k, v, attention_mask=seg)
+        mesh = self._mesh()
+        out = jax.jit(
+            lambda q, k, v, m: fn(q, k, v, mesh, key_mask=m)
+        )(q, k, v, seg)
+        np.testing.assert_allclose(_valid(out, seg), _valid(ref, seg), atol=1e-5)
+
+    @pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+    def test_sharded_segment_grads_match_dense(self, scheme):
+        if scheme == "ring":
+            from llmtrain_tpu.ops.ring_attention import ring_attention_sharded as fn
+        else:
+            from llmtrain_tpu.ops.ulysses_attention import (
+                ulysses_attention_sharded as fn,
+            )
+
+        q, k, v = _qkv(b=4, t=16, h=4, d=8, seed=53)
+        seg = np.ones((4, 16), np.int32)
+        seg[:, 5:11] = 2
+        seg[:, 11:] = 3
+        seg = jnp.asarray(seg)
+        gmask = (seg != 0)[:, :, None, None].astype(jnp.float32)
+        mesh = self._mesh()
+        g_sp = jax.jit(
+            jax.grad(lambda q: (fn(q, k, v, mesh, key_mask=seg) * gmask).sum())
+        )(q)
+        g_ref = jax.grad(
+            lambda q: (dense_attention(q, k, v, attention_mask=seg) * gmask).sum()
+        )(q)
+        np.testing.assert_allclose(np.asarray(g_sp), np.asarray(g_ref), atol=1e-4)
+
+    def test_fallback_keeps_segment_semantics(self):
+        """No mesh → blockwise fallback: a split_documents segment mask
+        must STILL block cross-document attention (degrading to key-only
+        padding here silently re-opened the leak the feature closes)."""
+        from llmtrain_tpu.ops.ring_attention import ring_or_blockwise
+        from llmtrain_tpu.ops.ulysses_attention import ulysses_or_blockwise
+
+        q, k, v = _qkv(b=2, t=16, h=2, d=8, seed=55)
+        seg = np.ones((2, 16), np.int32)
+        seg[:, 7:] = 2
+        seg = jnp.asarray(seg)
+        ref = dense_attention(q, k, v, attention_mask=seg)
+        for fn in (ring_or_blockwise, ulysses_or_blockwise):
+            out = fn(q, k, v, key_mask=seg)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=1e-5
+            )
+
     def test_fallback_masked_matches_dense(self):
         """No mesh: the route-or-fallback path passes the mask to
         blockwise."""

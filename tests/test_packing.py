@@ -241,7 +241,7 @@ class TestSplitDocumentsData:
         # Window 0 spans both records: two distinct nonzero segment ids.
         assert len(set(mask.tolist()) - {0}) == 2
 
-    def test_split_documents_rejects_ring_and_assume_packed(self, tmp_path):
+    def test_split_documents_validation(self, tmp_path):
         from llmtrain_tpu.config import RunConfig
         from llmtrain_tpu.data.base import validate_split_documents as _validate_split_documents
 
@@ -264,8 +264,10 @@ class TestSplitDocumentsData:
                 }
             )
 
-        with pytest.raises(ValueError, match="ring"):
-            _validate_split_documents(cfg(attention="ring"))
+        # ring/ulysses are supported (segment masks ride both SP
+        # schemes); only assume_packed conflicts.
+        _validate_split_documents(cfg(attention="ring"))
+        _validate_split_documents(cfg(attention="ulysses"))
         with pytest.raises(ValueError, match="assume_packed"):
             _validate_split_documents(cfg(assume_packed=True))
         _validate_split_documents(cfg())  # flash: fine
