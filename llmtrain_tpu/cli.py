@@ -222,6 +222,31 @@ def build_parser() -> argparse.ArgumentParser:
     )
     imp.add_argument("--json", action="store_true", help="emit stats as JSON")
 
+    avg = sub.add_parser(
+        "average-checkpoints",
+        help="average the params of several checkpoints (model soup) into "
+        "a resumable step-0 checkpoint",
+    )
+    avg.add_argument("--config", required=True, help="path to the YAML run config")
+    avg.add_argument(
+        "--inputs",
+        required=True,
+        help="comma-separated checkpoint files/dirs/run-ids (each resolved "
+        "like --resume), OR one checkpoint dir with --last-k",
+    )
+    avg.add_argument(
+        "--last-k",
+        type=int,
+        default=0,
+        help="average the last K step_*.ckpt files of the single --inputs dir",
+    )
+    avg.add_argument(
+        "--output",
+        required=True,
+        help="empty checkpoint directory to write step_000000.ckpt into",
+    )
+    avg.add_argument("--json", action="store_true", help="emit stats as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -317,6 +342,142 @@ def _load_checkpoint_params(cfg, adapter, model, from_spec: str):
         expected_config_yaml=yaml.safe_dump(cfg.model_dump(), sort_keys=False),
     )
     return ckpt_path, params, step
+
+
+def _handle_average_checkpoints(args: argparse.Namespace) -> int:
+    """Model soup: uniform average of several checkpoints' params.
+
+    Averaging the last few checkpoints of a run (or parallel fine-tunes
+    of one init) often beats the final checkpoint alone — a cheap
+    post-training win with no new training machinery: the result is a
+    standard ``step_000000.ckpt`` (fresh optimizer state) that ``train
+    --resume``, ``eval``, and ``generate`` all consume as usual.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    try:
+        import jax
+        import numpy as np
+
+        from .registry import get_model_adapter
+        from .training.checkpoint import (
+            CheckpointManager,
+            load_inference_params,
+            resolve_resume_path,
+            state_to_host,
+        )
+        from .training.optimizer import build_optimizer
+        from .training.train_step import create_train_state
+
+        initialize_registries()
+        out_dir = Path(args.output)
+        if out_dir.exists() and sorted(out_dir.glob("step_*.ckpt")):
+            _emit_error(
+                f"output dir {out_dir} already holds checkpoints; "
+                "pass an empty directory"
+            )
+            return EXIT_TRAIN_FAILURE
+
+        specs = [s.strip() for s in args.inputs.split(",") if s.strip()]
+        if args.last_k:
+            if len(specs) != 1:
+                _emit_error("--last-k needs --inputs to be ONE checkpoint dir")
+                return EXIT_CONFIG_ERROR
+            if args.last_k < 2:
+                _emit_error("averaging needs at least 2 checkpoints")
+                return EXIT_CONFIG_ERROR
+            files = sorted(Path(specs[0]).glob("step_*.ckpt"))
+            if len(files) < args.last_k:
+                _emit_error(
+                    f"{specs[0]} holds {len(files)} checkpoints, "
+                    f"fewer than --last-k {args.last_k}"
+                )
+                return EXIT_CONFIG_ERROR
+            paths = files[-args.last_k :]
+        else:
+            if len(specs) < 2:
+                _emit_error("averaging needs at least 2 checkpoints")
+                return EXIT_CONFIG_ERROR
+            paths = [
+                resolve_resume_path(s, cfg.output.root_dir) for s in specs
+            ]
+
+        import yaml as _yaml
+
+        adapter = get_model_adapter(cfg.model.name)()
+        model = adapter.build_model(cfg)
+        abstract = _abstract_params(cfg, adapter, model)
+        expected_yaml = _yaml.safe_dump(cfg.model_dump(), sort_keys=False)
+
+        acc = None
+        steps = []
+        for p in paths:
+            # device=False: the average is pure host work — no reason to
+            # round-trip every input through the accelerator. The config-
+            # mismatch warning fires like every sibling loader's.
+            params, step = load_inference_params(
+                p, abstract, expected_config_yaml=expected_yaml, device=False
+            )
+            steps.append(step)
+            # Accumulate FLOAT leaves in float64 (averaging N bf16/f32
+            # trees in their own dtype loses low bits N times over);
+            # non-float leaves (int buffers) keep the first checkpoint's
+            # value — summing them would corrupt the soup.
+            as64 = jax.tree.map(
+                lambda a: np.asarray(a, np.float64)
+                if np.issubdtype(np.asarray(a).dtype, np.floating)
+                else np.asarray(a),
+                params,
+            )
+            acc = (
+                as64
+                if acc is None
+                else jax.tree.map(
+                    lambda t, x: np.add(t, x)
+                    if np.issubdtype(t.dtype, np.floating)
+                    else t,
+                    acc,
+                    as64,
+                )
+            )
+        import jax.numpy as jnp
+
+        avg = jax.tree.map(
+            # Divide in f64, THEN cast back to the param dtype.
+            lambda s, like: (s / len(paths)).astype(like.dtype)
+            if np.issubdtype(like.dtype, np.floating)
+            else s,
+            acc,
+            params,
+        )
+        state = create_train_state(
+            jax.tree.map(jnp.asarray, avg), build_optimizer(cfg.trainer)
+        )
+        target = CheckpointManager(out_dir).save_host(
+            0, state_to_host(state), cfg.model_dump()
+        )
+        stats = {
+            "inputs": [str(p) for p in paths],
+            "steps": steps,
+            "checkpoint": str(target),
+        }
+        if args.json:
+            print(json.dumps(stats))
+        else:
+            print(
+                f"averaged {len(paths)} checkpoints (steps {steps}) -> {target}; "
+                f"continue with: train --config {args.config} --resume {out_dir}"
+            )
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"averaging failed: {exc}")
+        return EXIT_TRAIN_FAILURE
 
 
 def _handle_export_checkpoint(args: argparse.Namespace) -> int:
@@ -1128,6 +1289,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_export_checkpoint(args)
     if args.command == "import-checkpoint":
         return _handle_import_checkpoint(args)
+    if args.command == "average-checkpoints":
+        return _handle_average_checkpoints(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

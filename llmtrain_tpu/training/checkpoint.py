@@ -209,7 +209,11 @@ class CheckpointManager:
 
 
 def load_inference_params(
-    path: str | Path, abstract_params: Any, *, expected_config_yaml: str | None = None
+    path: str | Path,
+    abstract_params: Any,
+    *,
+    expected_config_yaml: str | None = None,
+    device: bool = True,
 ) -> tuple[Any, int]:
     """Restore just the model params (no optimizer state) from a checkpoint.
 
@@ -218,6 +222,8 @@ def load_inference_params(
     mapped back onto. Returns ``(params_on_device, step)`` — the inference
     path for the ``generate`` CLI, which the reference only offers as eager
     notebook cells (reference notebooks/trained_vs_random_completion.ipynb).
+    ``device=False`` keeps host numpy (host-side consumers like
+    checkpoint averaging skip a full device round-trip per input).
 
     When ``expected_config_yaml`` is given and differs from the config stored
     in the checkpoint, a warning is logged — the same warn-and-continue
@@ -229,6 +235,8 @@ def load_inference_params(
     if expected_config_yaml is not None:
         warn_on_config_mismatch(payload, expected_config_yaml, path)
     host_params = serialization.from_state_dict(abstract_params, payload["params"])
+    if not device:
+        return host_params, int(payload["step"])
     params = jax.tree.map(jnp.asarray, host_params)
     return params, int(payload["step"])
 

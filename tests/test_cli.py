@@ -200,6 +200,60 @@ class TestTrain:
         assert "training failed" in err["error"]
 
 
+class TestAverageCheckpoints:
+    def test_soup_is_the_uniform_average_and_resumable(self, workdir):
+        """average-checkpoints writes the exact param mean of the inputs
+        as a standard resumable step-0 checkpoint."""
+        import numpy as np
+
+        first = _run(["train", "--config", "config.yaml", "--json",
+                      "--run-id", "runAV"], workdir)
+        assert first.returncode == 0, first.stderr
+        ckpt_dir = workdir / "runs" / "runAV" / "checkpoints"
+        files = sorted(ckpt_dir.glob("step_*.ckpt"))
+        assert len(files) >= 2
+
+        proc = _run(
+            ["average-checkpoints", "--config", "config.yaml", "--inputs",
+             str(ckpt_dir), "--last-k", "2", "--output", "soup", "--json"],
+            workdir,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert len(out["inputs"]) == 2
+
+        from flax import serialization
+
+        def params_of(path):
+            payload = serialization.msgpack_restore(path.read_bytes())
+            return payload["params"]
+
+        import jax
+
+        a, b = params_of(files[-2]), params_of(files[-1])
+        soup = params_of(workdir / "soup" / "step_000000.ckpt")
+        want = jax.tree.map(lambda x, y: (np.asarray(x, np.float64) + y) / 2, a, b)
+        for got, exp in zip(jax.tree.leaves(soup), jax.tree.leaves(want)):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64), exp, atol=1e-6
+            )
+
+        # The soup resumes/evals like any checkpoint.
+        ev = _run(["eval", "--config", "config.yaml", "--from", "soup",
+                   "--json"], workdir)
+        assert ev.returncode == 0, ev.stderr
+        assert np.isfinite(json.loads(ev.stdout)["metrics"]["val/loss"])
+
+    def test_needs_two_inputs(self, workdir):
+        proc = _run(
+            ["average-checkpoints", "--config", "config.yaml", "--inputs",
+             "onlyone", "--output", "soup2"],
+            workdir,
+        )
+        assert proc.returncode == 2
+        assert "at least 2" in proc.stderr
+
+
 class TestGenerate:
     def test_generate_from_trained_run(self, workdir):
         first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runG"], workdir)
