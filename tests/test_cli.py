@@ -404,6 +404,40 @@ class TestGenerate:
         assert proc.returncode == 2
         assert "logprobs" in proc.stderr
 
+    def test_speculative_prompts_file_matches_plain(self, workdir):
+        """The per-row speculative loop over a prompts file (different
+        prompt lengths → separate length groups) matches the plain
+        batched path's completions exactly."""
+        tgt = {
+            **CFG,
+            "model": {
+                "name": "gpt", "block_size": 32, "d_model": 32,
+                "n_layers": 2, "n_heads": 2, "d_ff": 64, "dropout": 0.0,
+                "vocab_size": 257, "extra": {"tokenizer": "byte"},
+            },
+        }
+        drf = {**tgt, "model": {**tgt["model"], "n_layers": 1}}
+        (workdir / "tgt.yaml").write_text(yaml.safe_dump(tgt))
+        (workdir / "drf.yaml").write_text(yaml.safe_dump(drf))
+        for cfg_name, rid in (("tgt.yaml", "runPT"), ("drf.yaml", "runPD")):
+            proc = _run(["train", "--config", cfg_name, "--json",
+                         "--run-id", rid], workdir)
+            assert proc.returncode == 0, proc.stderr
+        (workdir / "prompts.txt").write_text("hello\nworld wide\n")
+        base = ["generate", "--config", "tgt.yaml", "--from", "runPT",
+                "--prompts-file", "prompts.txt", "--max-new-tokens", "5",
+                "--temperature", "0", "--json"]
+        plain = _run(base, workdir)
+        assert plain.returncode == 0, plain.stderr
+        spec = _run([*base, "--draft-config", "drf.yaml", "--draft-from",
+                     "runPD", "--gamma", "2"], workdir)
+        assert spec.returncode == 0, spec.stderr
+        p_res = json.loads(plain.stdout)["results"]
+        s_res = json.loads(spec.stdout)["results"]
+        assert [r["completion_ids"] for r in p_res] == [
+            r["completion_ids"] for r in s_res
+        ]
+
     def test_speculative_flags_must_pair(self, workdir):
         proc = _run(
             ["generate", "--config", "config.yaml", "--from", "nope",
