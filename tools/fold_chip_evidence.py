@@ -1,0 +1,129 @@
+"""Summarize a chip-evidence artifact dir into RESULTS-ready markdown.
+
+The phase-2 runbook (tools/run_chip_phase2.sh) drops one JSON/log file
+per step into its output dir; whoever folds the numbers into RESULTS.md
+has to re-derive what each file means. This prints a markdown block per
+artifact found — bench line, longctx table, decode sweep, mask A/B,
+family cells, speculative bounds, compiled-suite tail — skipping files
+that are absent or hold only error rows (named explicitly, so a silent
+gap cannot read as "covered").
+
+Usage (repo root):
+
+    python tools/fold_chip_evidence.py [chip_evidence_p2]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def _rows(path: Path) -> list[dict]:
+    rows = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return rows
+
+
+def _table(rows: list[dict], cols: list[str]) -> str:
+    head = "| " + " | ".join(cols) + " |"
+    sep = "|" + "|".join("---" for _ in cols) + "|"
+    body = [
+        "| " + " | ".join(str(r.get(c, "—")) for c in cols) + " |"
+        for r in rows
+    ]
+    return "\n".join([head, sep, *body])
+
+
+def main() -> None:
+    out = Path(sys.argv[1] if len(sys.argv) > 1 else "chip_evidence_p2")
+    if not out.is_dir():
+        print(f"no artifact dir {out}", file=sys.stderr)
+        raise SystemExit(1)
+
+    sections: list[str] = []
+    missing: list[str] = []
+
+    def handle(name: str, title: str, cols: list[str] | None = None):
+        path = out / name
+        if not path.exists():
+            missing.append(name)
+            return
+        rows = _rows(path)
+        good = [r for r in rows if "error" not in r]
+        bad = [r for r in rows if "error" in r]
+        parts = [f"### {title} (`{name}`)"]
+        if good:
+            parts.append(
+                _table(good, cols or sorted({k for r in good for k in r}))
+            )
+        if bad:
+            parts.append(
+                f"{len(bad)} errored cell(s): "
+                + "; ".join(
+                    f"{r.get('cell', r.get('seq', '?'))}: {str(r['error'])[:80]}"
+                    for r in bad
+                )
+            )
+        if not rows:
+            parts.append("(no JSON rows — see the matching .log)")
+        sections.append("\n\n".join(parts))
+
+    handle("bench.json", "Bench (window-1 runbook name)")
+    handle("bench_sweep.json", "Bench auto-sweep")
+    handle("bench_c128.json", "Chunked-CE batch-128 cell")
+    handle(
+        "decode.json", "Decode sweep (window-1 runbook name)",
+        ["batch", "n_kv_heads", "ms_per_step", "tokens_per_sec"],
+    )
+    handle(
+        "longctx.json", "Long context",
+        ["seq", "batch", "window", "tokens_per_sec", "mfu", "peak_hbm_gb"],
+    )
+    handle(
+        "longctx_window.json", "Windowed long context",
+        ["seq", "batch", "window", "tokens_per_sec", "mfu", "peak_hbm_gb"],
+    )
+    handle(
+        "mask_ab.json", "Masked vs assume_packed A/B",
+        ["cell", "tokens_per_sec", "mfu", "step_time_ms"],
+    )
+    handle(
+        "diag_decode.json", "Decode attribution",
+        ["batch", "n_kv_heads", "ms_per_token", "attribution_ms"],
+    )
+    handle(
+        "family.json", "Family cells (gpt vs llama)",
+        ["family", "tokens_per_sec", "mfu", "step_time_ms", "params"],
+    )
+    handle(
+        "speculative.json", "Speculative bounds",
+        ["cell", "ms_per_token", "speedup_vs_plain", "mean_accepted"],
+    )
+    handle("bpe_headline.json", "BPE headline train")
+
+    compiled = out / "tpu_compiled.log"
+    if compiled.exists():
+        tail = compiled.read_text().splitlines()[-1:]
+        sections.append("### Compiled-kernel suite\n\n```\n" + "\n".join(tail) + "\n```")
+    else:
+        missing.append("tpu_compiled.log")
+
+    print(f"## Chip evidence from `{out}/`\n")
+    print("\n\n".join(sections))
+    if missing:
+        print(
+            "\n\nNOT COVERED (file absent): " + ", ".join(sorted(missing)),
+        )
+
+
+if __name__ == "__main__":
+    main()
