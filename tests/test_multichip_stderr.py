@@ -1,0 +1,32 @@
+"""Regression: the multichip dryrun legs compile without SPMD
+"Involuntary full rematerialization" warnings (VERDICT r4 item 3).
+
+The warning (XLA spmd_partitioner.cc:652) means GSPMD gave up on an
+efficient reshard and replicated a tensor — wasted HBM + ICI every step
+on real hardware. Round 4's llama leg hit it on {fsdp, tensor, data}
+meshes: with the dense loss, the tied-embedding grad's sharding
+propagates embed-over-fsdp into the saved final-norm activation, which
+GSPMD cannot convert from batch-sharded efficiently. The fix keeps the
+lm_head backward on chunked CE's explicit-einsum custom_vjp
+(__graft_entry__._dryrun_llama); this test pins the property.
+
+XLA emits the warning from C++ on fd 2, so plain capsys cannot see it —
+``capfd`` captures at the file-descriptor level.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import __graft_entry__ as graft
+from llmtrain_tpu.registry import initialize_registries
+
+
+@pytest.mark.slow
+def test_llama_fsdp_tensor_data_leg_no_spmd_remat_warning(capfd, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # run dirs land in the test sandbox
+    initialize_registries()
+    graft._dryrun_llama(8)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+    assert "spmd_partitioner" not in err, err[-2000:]
