@@ -100,20 +100,36 @@ def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_R
     dims (optax.adafactor's factored ``v_row``/``v_col``, rank reduced by
     one, and its shape-(1,) placeholders) carry the full spec through the
     flax boxes, and applying it to the reduced array is a pjit error.
-    The repair is deliberately NARROW (spec longer than the rank, or a
-     1-element leaf): a full-rank param whose dim the mesh axis doesn't
-    divide still fails loudly at jit time instead of silently losing its
-    sharding.
+    The repair is deliberately NARROW: spec longer than the rank, or a
+    1-element leaf whose spec the mesh cannot satisfy (adafactor's (1,)
+    placeholders carrying an ``embed``-style spec). A shape-(1,) leaf
+    whose spec IS satisfiable (all mapped axes size 1) keeps it, and a
+    full-rank param whose dim the mesh axis doesn't divide still fails
+    loudly at jit time instead of silently losing its sharding.
     """
     logical_spec = nn.get_partition_spec(abstract_tree)
     shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+
+    def spec_fits(sharding: NamedSharding, shape: tuple) -> bool:
+        for dim, axes in zip(shape, sharding.spec):
+            if axes is None:
+                continue
+            names = (axes,) if isinstance(axes, str) else axes
+            shards = 1
+            for name in names:
+                shards *= mesh.shape[name]
+            if dim % shards != 0:
+                return False
+        return True
 
     def finalize(sharding: Any, leaf: Any) -> Any:
         value = nn.meta.unbox(leaf)
         shape = getattr(value, "shape", None)
         if shape is None or not isinstance(sharding, NamedSharding):
             return sharding
-        if len(sharding.spec) > len(shape) or tuple(shape) == (1,):
+        if len(sharding.spec) > len(shape) or (
+            tuple(shape) == (1,) and not spec_fits(sharding, tuple(shape))
+        ):
             return replicated(mesh)
         return sharding
 

@@ -295,6 +295,13 @@ def speculative_generate(
         return (out, {"target_forwards": 0, "mean_accepted": 0.0}) if return_stats else out
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    # Same out-of-band conventions as generate() (generation.py:283-289):
+    # a library caller passing top_k=0 must mean "disabled", not reach
+    # lax.top_k(x, 0) inside filter_logits under jit.
+    if top_k is not None and top_k <= 0:
+        top_k = None
+    if top_p is not None and (top_p <= 0.0 or top_p >= 1.0):
+        top_p = None
     for m, label in ((model, "model"), (draft_model, "draft_model")):
         if not hasattr(m, "for_decoding"):
             raise ValueError(f"{label} must expose for_decoding() for KV caching")
