@@ -196,10 +196,14 @@ def _watchdog_main() -> None:
             give_up()
         return
 
+    # Every intended-TPU child carries REQUIRE_TPU: the child's in-process
+    # CPU fallback must exit nonzero rather than print a CPU line the
+    # watchdog would mislabel as on-chip.
+    tpu_env = {"LLMTRAIN_BENCH_REQUIRE_TPU": "1"}
     backend, probe_fail = _probe_backend(probe_timeout)
     if backend == "tpu":
         print(f"probe: tpu backend alive in <= {probe_timeout:.0f}s", file=sys.stderr, flush=True)
-        for env, timeout_sec in (({}, tpu_timeout), ({}, retry_timeout)):
+        for env, timeout_sec in ((tpu_env, tpu_timeout), (tpu_env, retry_timeout)):
             if attempt(env, timeout_sec):
                 return
         if not no_fallback:
@@ -216,17 +220,19 @@ def _watchdog_main() -> None:
     if no_fallback:
         # Evidence mode: no CPU line allowed; one straight TPU attempt in
         # case the probe itself was a flake, then give up loudly.
-        if not attempt({}, tpu_timeout):
+        if not attempt(tpu_env, tpu_timeout):
             give_up()
         return
-    got_cpu = attempt({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout)
-    # The probe fast-fail left budget rounds 1-4 never had: re-probe once
-    # and, if the tunnel came back, print the on-chip line AFTER the CPU
-    # line (last JSON line wins — same contract the auto-sweep relies on).
-    backend, _ = _probe_backend(probe_timeout)
-    if backend == "tpu":
-        print("probe: tunnel came back, attempting live TPU run", file=sys.stderr, flush=True)
-        attempt({}, tpu_timeout)
+    attempt({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout)
+    # With the CPU line banked, the probe fast-fail left budget rounds 1-4
+    # never had: one UNCONDITIONAL full-length TPU attempt. Gating this on
+    # a second probe would permanently downgrade a slow-but-alive tunnel
+    # (backend init slower than the probe window but inside tpu_timeout);
+    # on a truly dead tunnel the cost is wall-clock only — the CPU JSON
+    # line is already on stdout, and a TPU line printed after it wins
+    # (last JSON line, the same contract the auto-sweep relies on).
+    print("retrying TPU at full timeout after banked CPU line", file=sys.stderr, flush=True)
+    attempt(tpu_env, tpu_timeout)
     if not printed_any:
         give_up()
 
@@ -260,12 +266,11 @@ def _probe_main() -> None:
 
 def _cache_entry_count() -> int:
     """Entry count of the persistent compilation cache dir (-1 = no dir)."""
-    env = os.environ.get("LLMTRAIN_COMPILATION_CACHE", "")
-    if env.lower() in ("off", "0", "false", "no", "disable"):
+    from llmtrain_tpu.distributed import resolve_compilation_cache_dir
+
+    path = resolve_compilation_cache_dir()
+    if path is None:
         return -1
-    if env.lower() in ("on", "1", "true", "yes"):
-        env = ""
-    path = env or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
     try:
         return len(os.listdir(path))
     except OSError:
@@ -290,6 +295,15 @@ def _child_main() -> None:
         jax.config.update("jax_platforms", "cpu")
         backend = jax.default_backend()
     on_tpu = backend == "tpu"
+    if os.environ.get("LLMTRAIN_BENCH_REQUIRE_TPU") == "1" and not on_tpu:
+        # The watchdog spawned this child as a TPU attempt. Without this
+        # gate the in-process CPU fallback above would run the CPU shape
+        # while honoring chip-tuned sweep knobs and print a line the
+        # watchdog mislabels as on-chip — in evidence mode
+        # (LLMTRAIN_BENCH_NO_FALLBACK=1) exactly the contamination the
+        # mode exists to forbid. No JSON line; nonzero exit.
+        print(f"REQUIRE_TPU: backend is {backend!r}, refusing to run", file=sys.stderr)
+        raise SystemExit(3)
 
     # Persistent compile cache: watchdog retries, the auto-sweep, and
     # future rounds reuse each ~20-40s TPU compile instead of repaying it.
@@ -542,11 +556,21 @@ def _run(
         state, metrics = step_fn(state, batch_dict, rng)
     jax.device_get(metrics["loss"])
 
-    start = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch_dict, rng)
-    final_loss = float(jax.device_get(metrics["loss"]))
-    elapsed = time.perf_counter() - start
+    # Best-of-two timing passes: a transient load spike on a shared host
+    # (the 1-core CPU fallback hosts especially) inflates a single pass;
+    # the faster pass is the closer estimate of the machine's capability.
+    # (elapsed, final_loss) are taken from the SAME pass so the reported
+    # step_time/loss pair stays internally consistent.
+    elapsed = float("inf")
+    final_loss = float("nan")
+    for _ in range(2):
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_dict, rng)
+        pass_loss = float(jax.device_get(metrics["loss"]))
+        pass_elapsed = time.perf_counter() - start
+        if pass_elapsed < elapsed:
+            elapsed, final_loss = pass_elapsed, pass_loss
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * steps / elapsed

@@ -134,6 +134,20 @@ def configure_platform(device: str) -> None:
         get_logger().warning("could not pin jax platform to cpu: %s", exc)
 
 
+def resolve_compilation_cache_dir() -> str | None:
+    """The directory ``configure_compilation_cache`` will use, or None when
+    disabled via ``LLMTRAIN_COMPILATION_CACHE=off``. Single owner of the
+    env-token and default-path conventions (bench.py's cache telemetry
+    reads it too)."""
+    env = os.environ.get("LLMTRAIN_COMPILATION_CACHE", "")
+    low = env.lower()
+    if low in ("off", "0", "false", "no", "disable"):
+        return None
+    if low in ("on", "1", "true", "yes"):
+        env = ""  # boolean-ish enable: use the default dir, not a dir named "true"
+    return env or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
+
+
 def configure_compilation_cache() -> None:
     """Enable JAX's persistent compilation cache (new capability; the
     reference has no compiled artifacts to cache).
@@ -144,13 +158,9 @@ def configure_compilation_cache() -> None:
     (stable across CWDs so identical programs actually hit); opt out with
     ``LLMTRAIN_COMPILATION_CACHE=off``; any other value is the cache dir.
     Safe to call multiple times."""
-    env = os.environ.get("LLMTRAIN_COMPILATION_CACHE", "")
-    low = env.lower()
-    if low in ("off", "0", "false", "no", "disable"):
+    path = resolve_compilation_cache_dir()
+    if path is None:
         return
-    if low in ("on", "1", "true", "yes"):
-        env = ""  # boolean-ish enable: use the default dir, not a dir named "true"
-    path = env or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
     try:
         # Cache everything that took noticeable compile time; tiny programs
         # aren't worth the disk round-trip. Set BEFORE the dir: the cache
