@@ -582,13 +582,11 @@ def _run(
         tokens_per_sec, n_params=n_params, n_layers=depth, seq_len=seq, d_model=d_model
     )
 
-    # Peak device memory (VERDICT r4 item 7): same source as
-    # Trainer._peak_memory_bytes. CPU PJRT reports no stats -> 0.0.
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-        peak_hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 1e9, 3)
-    except Exception:  # noqa: BLE001
-        peak_hbm_gb = 0.0
+    # Peak device memory (VERDICT r4 item 7): same helper as the trainer
+    # metric and the long-context sweep. CPU PJRT reports no stats -> 0.0.
+    from llmtrain_tpu.utils.hw import peak_memory_bytes
+
+    peak_hbm_gb = round(peak_memory_bytes() / 1e9, 3)
 
     return {
         "metric": "tokens_per_sec_per_chip",

@@ -1,0 +1,162 @@
+#!/usr/bin/env bash
+# Docker-free end-to-end run: the REAL k8s/entrypoint.sh drives the REAL
+# CLI as two "pods", then k8s/assertions.sh is applied to the produced
+# logs and artifacts — the closest executable thing to k8s/test_e2e.sh on
+# a host with no Docker daemon (this image ships no docker/kind/kubectl;
+# see RESULTS.md "K8s E2E"). What is real here: the entrypoint's
+# JOB_COMPLETION_INDEX/NUM_PROCESSES contract, coordinator discovery
+# through the Kubernetes API codepath (curl + serviceaccount files —
+# stubbed at the network edge only), the 2-process JAX rendezvous, the
+# GPT training run, rank-0-only artifacts, the sqlite tracking DB, and
+# every assertion test_e2e.sh would run. What is simulated: the cluster
+# (processes instead of pods), the image build, and WikiText-2 (offline
+# host -> local_text over the repo's own docs/tests as the corpus,
+# byte tokenizer; same model family and mesh as k8s/configmap.yaml).
+#
+#   bash k8s/test_e2e_local.sh [out_dir]   # default runs/e2e_local
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+OUT="${1:-runs/e2e_local}"
+STEPS="${LLMTRAIN_E2E_STEPS:-60}"
+FAILURES=0
+
+say() { printf '==> %s\n' "$*"; }
+. k8s/assertions.sh
+
+rm -rf "$OUT"
+mkdir -p "$OUT/volume/runs" "$OUT/volume/mlflow" "$OUT/podfs/sa" "$OUT/podfs/bin" "$OUT/logs"
+
+say "preparing pod filesystem stubs (serviceaccount + curl network edge)"
+printf 'llmtrain-e2e' > "$OUT/podfs/sa/namespace"
+printf 'stub-token' > "$OUT/podfs/sa/token"
+printf 'stub-ca' > "$OUT/podfs/sa/ca.crt"
+# The stub replaces ONLY the network hop of coordinator discovery: the
+# entrypoint still builds the real URL, reads the real SA files, and
+# parses the real pods-list JSON shape through its python parser.
+cat > "$OUT/podfs/bin/curl" <<'EOF'
+#!/usr/bin/env bash
+echo '{"items": [{"status": {"podIP": "127.0.0.1"}}]}'
+EOF
+chmod +x "$OUT/podfs/bin/curl"
+
+say "writing offline train config (mirror of k8s/configmap.yaml train.yaml)"
+cat > "$OUT/train.yaml" <<EOF
+schema_version: 1
+run:
+  name: "k8s-gpt-local"
+  seed: 42
+  device: "cpu"
+  deterministic: true
+  notes: "Docker-free e2e: GPT via the real entrypoint.sh, 2 JAX processes."
+model:
+  name: "gpt"
+  block_size: 128
+  d_model: 256
+  n_layers: 6
+  n_heads: 8
+  d_ff: 1024
+  dropout: 0.1
+  tie_embeddings: true
+  extra:
+    tokenizer: "byte"
+data:
+  name: "local_text"
+  cache_dir: "$OUT/volume/cache"
+  extra:
+    globs: ["docs/*.md", "README.md", "tests/*.py"]
+    val_fraction: 0.02
+trainer:
+  max_steps: $STEPS
+  micro_batch_size: 2
+  grad_accum_steps: 4
+  lr: 0.0005
+  weight_decay: 0.1
+  warmup_steps: 10
+  max_grad_norm: 1.0
+  log_every_steps: 5
+  eval_every_steps: 30
+  save_every_steps: $STEPS
+distributed:
+  enabled: true
+  timeout_sec: 600
+  mesh:
+    data: -1
+mlflow:
+  enabled: true
+  tracking_uri: "sqlite:///$PWD/$OUT/volume/mlflow/mlflow.db"
+  experiment: "llm-train-k8s"
+  run_name: "k8s-gpt-local"
+output:
+  root_dir: "$OUT/volume/runs"
+EOF
+
+say "launching 2 'pods' through the real k8s/entrypoint.sh"
+PIDS=()
+for IDX in 0 1; do
+    env -i \
+        PATH="$OUT/podfs/bin:$PATH" \
+        HOME="$HOME" \
+        JOB_COMPLETION_INDEX="$IDX" \
+        NUM_PROCESSES=2 \
+        JOB_NAME=llmtrain-tpu \
+        POD_IP=127.0.0.1 \
+        COORDINATOR_PORT=29531 \
+        LLMTRAIN_CONFIG="$OUT/train.yaml" \
+        LLMTRAIN_SA_DIR="$OUT/podfs/sa" \
+        LLMTRAIN_DISCOVERY_TRIES=5 \
+        LLMTRAIN_DISCOVERY_SLEEP=1 \
+        JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        LLMTRAIN_COMPILATION_CACHE="${LLMTRAIN_COMPILATION_CACHE:-$HOME/.cache/llmtrain_tpu/jax-tests}" \
+        PYTHONPATH="$PWD" \
+        bash k8s/entrypoint.sh > "$OUT/logs/pod$IDX.log" 2>&1 &
+    PIDS+=($!)
+done
+
+# Bounded wait (same discipline as tests/test_multiprocess.py): a
+# deadlocked collective must fail the run, not hang it forever.
+DEADLINE=$(( $(date +%s) + ${LLMTRAIN_E2E_TIMEOUT:-1800} ))
+for i in 0 1; do
+    while kill -0 "${PIDS[$i]}" 2>/dev/null && [ "$(date +%s)" -lt "$DEADLINE" ]; do
+        sleep 5
+    done
+    if kill -0 "${PIDS[$i]}" 2>/dev/null; then
+        say "pod $i exceeded the deadline; killing both pods"
+        kill -9 "${PIDS[0]}" "${PIDS[1]}" 2>/dev/null || true
+    fi
+done
+CODES=()
+for i in 0 1; do
+    if wait "${PIDS[$i]}"; then CODES+=(0); else CODES+=($?); fi
+done
+
+say "collecting pod logs"
+for IDX in 0 1; do
+    sed "s/^/pod$IDX| /" "$OUT/logs/pod$IDX.log" | tail -n 5
+done
+LOGS0="$(cat "$OUT/logs/pod0.log")"
+
+say "asserting rank-0 output"
+assert_rank0_logs "$LOGS0" || true
+
+say "asserting pod exit codes"
+for IDX in 0 1; do
+    if [ "${CODES[$IDX]}" = "0" ]; then
+        pass "pod $IDX exited 0"
+    else
+        fail "pod $IDX exited ${CODES[$IDX]}"
+    fi
+done
+
+say "asserting host artifacts"
+RUN_DIR=$(find "$OUT/volume/runs" -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)
+assert_artifact_tree "$RUN_DIR" || true
+assert_tracking_db "$OUT/volume/mlflow/mlflow.db" || true
+
+if [ "$FAILURES" -eq 0 ]; then
+    say "E2E (local, docker-free) SUCCEEDED"
+else
+    say "E2E (local, docker-free) FAILED ($FAILURES assertion(s))"
+    exit 1
+fi

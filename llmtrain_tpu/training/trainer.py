@@ -688,13 +688,9 @@ class Trainer:
     # ------------------------------------------------------------------ misc
 
     def _peak_memory_bytes(self) -> float:
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-        except Exception:
-            return 0.0
-        if not stats:
-            return 0.0
-        return float(stats.get("peak_bytes_in_use", 0))
+        from ..utils.hw import peak_memory_bytes
+
+        return peak_memory_bytes()
 
 
 class _StepProfiler:

@@ -60,10 +60,44 @@ def mfu(
     return tokens_per_sec_per_chip * flops_per_token / peak
 
 
+def peak_memory_bytes() -> float:
+    """Best-effort peak device-memory bytes of the first local device.
+
+    Single owner of the lookup (trainer metrics, bench.py, and
+    tools/bench_longctx.py all report it). PJRT backends differ in which
+    keys they populate — ``peak_bytes_in_use`` is the TPU allocator's
+    high-water mark; ``bytes_in_use`` is a floor when the peak counter is
+    absent. Returns 0.0 when the backend reports nothing (CPU PJRT, and
+    some tunneled clients)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return 0.0
+    if not stats:
+        return 0.0
+    return float(stats.get("peak_bytes_in_use") or stats.get("bytes_in_use") or 0.0)
+
+
+def memory_stats_keys() -> list[str]:
+    """Diagnostic: the keys the first local device's memory_stats reports
+    (empty list = no stats). Logged by the long-context sweep when the
+    peak reads 0.0 so a failing tunnel window records WHY."""
+    import jax
+
+    try:
+        return sorted((jax.local_devices()[0].memory_stats() or {}).keys())
+    except Exception:
+        return []
+
+
 __all__ = [
     "TPU_PEAK_FLOPS",
     "CPU_NOMINAL_FLOPS",
     "peak_flops_per_chip",
     "transformer_flops_per_token",
     "mfu",
+    "peak_memory_bytes",
+    "memory_stats_keys",
 ]

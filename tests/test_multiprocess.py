@@ -49,17 +49,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_two_process(tmp_path, config_name: str, run_id: str, extra_args=()):
-    """Run the CLI as two rendezvousing processes; returns [(rc, out, err)]."""
+def _launch_procs(
+    tmp_path,
+    config_name: str,
+    run_id: str,
+    extra_args=(),
+    *,
+    n_procs: int = 2,
+    devices_per_proc: int = 4,
+    timeout: float = 300,
+):
+    """Run the CLI as ``n_procs`` rendezvousing processes, each with
+    ``devices_per_proc`` forced CPU devices; returns [(rc, out, err)]."""
     port = _free_port()
     procs = []
-    for rank in range(2):
+    for rank in range(n_procs):
         env = dict(os.environ)
         env.update(
             JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
             RANK=str(rank),
-            WORLD_SIZE="2",
+            WORLD_SIZE=str(n_procs),
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
         )
@@ -87,7 +97,7 @@ def _launch_two_process(tmp_path, config_name: str, run_id: str, extra_args=()):
     outs = []
     try:
         for proc in procs:
-            out, err = proc.communicate(timeout=300)
+            out, err = proc.communicate(timeout=timeout)
             outs.append((proc.returncode, out, err))
     finally:
         # A deadlocked collective leaves the other rank hung holding the
@@ -115,7 +125,7 @@ def test_two_process_data_parallel_train(tmp_path):
     cfg_path = tmp_path / "config.yaml"
     cfg_path.write_text(yaml.safe_dump(CFG))
 
-    outs = _launch_two_process(tmp_path, "config.yaml", "mp_run")
+    outs = _launch_procs(tmp_path, "config.yaml", "mp_run")
 
     for rc, out, err in outs:
         assert rc == 0, f"rank failed: {err[-2000:]}"
@@ -170,14 +180,14 @@ def test_two_process_fsdp_sharded_checkpoint_resume(tmp_path):
     # Continuous 4-step run; save_every=2 leaves a mid-run step-2 checkpoint.
     # (Resuming from the SAME config keeps the cosine-decay horizon identical
     # — a shorter-max_steps run would train steps 1-2 under different LRs.)
-    full = _launch_two_process(tmp_path, "full.yaml", "mp_full")
+    full = _launch_procs(tmp_path, "full.yaml", "mp_full")
     for rc, _, err in full:
         assert rc == 0, f"continuous run failed: {err[-2000:]}"
     full_loss = _summary(full)["train_result"]["final_loss"]
     mid_ckpt = tmp_path / "runs" / "mp_full" / "checkpoints" / "step_000002.ckpt"
     assert mid_ckpt.is_file()
 
-    resumed = _launch_two_process(
+    resumed = _launch_procs(
         tmp_path, "full.yaml", "mp_resumed", extra_args=("--resume", str(mid_ckpt))
     )
     for rc, _, err in resumed:
@@ -224,7 +234,7 @@ def test_two_process_pipeline_parallel_train(tmp_path):
     }
     (tmp_path / "pp.yaml").write_text(yaml.safe_dump(pp_cfg))
 
-    outs = _launch_two_process(tmp_path, "pp.yaml", "mp_pp")
+    outs = _launch_procs(tmp_path, "pp.yaml", "mp_pp")
     for rc, _, err in outs:
         assert rc == 0, f"pipeline rank failed: {err[-2000:]}"
     result = _summary(outs)["train_result"]
@@ -233,3 +243,90 @@ def test_two_process_pipeline_parallel_train(tmp_path):
     assert result["final_loss"] < result["first_step_loss"]
     runs = list((tmp_path / "runs").iterdir())
     assert [p.name for p in runs] == ["mp_pp"]
+
+
+@pytest.mark.slow
+def test_four_process_fsdp_spanning_train(tmp_path):
+    """4-process GPT run with the fsdp axis spanning ALL process
+    boundaries (VERDICT r4 item 5): 4 procs x 2 local devices = 8 global,
+    mesh {data: 2, fsdp: 4} — every fsdp shard-group of 4 devices mixes
+    devices owned by two different processes, so the just-in-time
+    all-gathers and grad reduce-scatters cross the process fabric. The
+    first real v5e-16 pod slice runs exactly this topology class; nothing
+    about the runtime may assume world size 2."""
+    cfg = {
+        **CFG,
+        "run": {"name": "mp4-fsdp", "seed": 41, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 1,
+            "n_heads": 2,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+        },
+        "trainer": {**CFG["trainer"], "micro_batch_size": 4},
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 120,
+            "mesh": {"data": -1, "fsdp": 4, "tensor": 1, "sequence": 1},
+        },
+    }
+    (tmp_path / "mp4.yaml").write_text(yaml.safe_dump(cfg))
+
+    outs = _launch_procs(
+        tmp_path, "mp4.yaml", "mp4_run", n_procs=4, devices_per_proc=2, timeout=600
+    )
+    for rc, _, err in outs:
+        assert rc == 0, f"rank failed: {err[-2000:]}"
+    result = _summary(outs)["train_result"]
+    assert result["final_step"] == 4
+    assert result["final_loss"] > 0
+    assert result["final_loss"] < result["first_step_loss"]
+    # Only rank 0 prints a summary or creates artifacts.
+    for rank in (1, 2, 3):
+        assert _summary_lines(outs[rank][1]) == []
+    assert [p.name for p in (tmp_path / "runs").iterdir()] == ["mp4_run"]
+
+
+@pytest.mark.slow
+def test_four_process_pipeline_spanning_train(tmp_path):
+    """4-process gpt_pipeline run, {pipeline: 4, data: 2} over 8 global
+    devices (4 procs x 2 local): with data outermost, each data replica's
+    four pipeline stages live on devices 4k..4k+3 — owned by two
+    processes — so every GPipe ppermute hop in the schedule crosses a
+    process boundary at least once (VERDICT r4 item 5)."""
+    cfg = {
+        **CFG,
+        "run": {"name": "mp4-pp", "seed": 43, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt_pipeline",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 4,
+            "n_heads": 2,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+            "extra": {"tokenizer": "byte", "pipeline_microbatches": 2},
+        },
+        "trainer": {**CFG["trainer"], "micro_batch_size": 4},
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 120,
+            "mesh": {"pipeline": 4, "data": -1, "fsdp": 1, "tensor": 1, "sequence": 1},
+        },
+    }
+    (tmp_path / "mp4pp.yaml").write_text(yaml.safe_dump(cfg))
+
+    outs = _launch_procs(
+        tmp_path, "mp4pp.yaml", "mp4_pp", n_procs=4, devices_per_proc=2, timeout=600
+    )
+    for rc, _, err in outs:
+        assert rc == 0, f"rank failed: {err[-2000:]}"
+    result = _summary(outs)["train_result"]
+    assert result["final_step"] == 4
+    assert result["final_loss"] > 0
+    assert result["final_loss"] < result["first_step_loss"]

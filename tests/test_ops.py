@@ -106,7 +106,15 @@ class TestPallasInterpret:
         ref = jax.scipy.special.logsumexp(s, axis=-1).reshape(1, 16)  # b*h=1
         np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), atol=1e-5)
 
-    @pytest.mark.parametrize("block_q,block_k", [(8, 8), (8, 16), (16, 8), (32, 32)])
+    @pytest.mark.parametrize(
+        "block_q,block_k",
+        [
+            pytest.param(8, 8, marks=pytest.mark.slow),
+            (8, 16),
+            pytest.param(16, 8, marks=pytest.mark.slow),
+            (32, 32),
+        ],
+    )
     def test_fused_backward_matches_dense_grads(self, block_q, block_k):
         """The Pallas dq/dk/dv kernels against jax.grad of the dense
         reference, over a block-shape sweep (VERDICT r1 #4)."""
@@ -421,7 +429,17 @@ class TestSlidingWindow:
             atol=1e-5,
         )
 
-    @pytest.mark.parametrize("window", [1, 7, 8, 13, 32, 100])
+    @pytest.mark.parametrize(
+        "window",
+        [
+            1,
+            pytest.param(7, marks=pytest.mark.slow),
+            8,
+            pytest.param(13, marks=pytest.mark.slow),
+            pytest.param(32, marks=pytest.mark.slow),
+            100,
+        ],
+    )
     def test_blockwise_matches_dense(self, window):
         """Window edges off/on chunk boundaries, window == 1 (self only),
         window >= T (== full causal)."""
@@ -432,7 +450,17 @@ class TestSlidingWindow:
         ref = dense_attention(q, k, v, attention_mask=None, window=window)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
-    @pytest.mark.parametrize("window", [1, 7, 8, 13, 32, 100])
+    @pytest.mark.parametrize(
+        "window",
+        [
+            1,
+            pytest.param(7, marks=pytest.mark.slow),
+            8,
+            pytest.param(13, marks=pytest.mark.slow),
+            pytest.param(32, marks=pytest.mark.slow),
+            100,
+        ],
+    )
     def test_pallas_fwd_matches_dense(self, window):
         q, k, v = _qkv(t=32, seed=42)
         out = pallas_flash_attention(

@@ -32,8 +32,15 @@ if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
 
 
 def _peak_bytes() -> float:
-    stats = jax.local_devices()[0].memory_stats() or {}
-    return float(stats.get("peak_bytes_in_use", 0.0))
+    from llmtrain_tpu.utils.hw import peak_memory_bytes
+
+    return peak_memory_bytes()
+
+
+def _mem_keys() -> list[str]:
+    from llmtrain_tpu.utils.hw import memory_stats_keys
+
+    return memory_stats_keys()
 
 
 def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
@@ -83,6 +90,9 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
     m = measure_cell(step_fn, state, batch_dict, steps)
     step_time = m["step_time_s"]
     tokens_per_sec = batch * seq / step_time
+    # One memory_stats RPC; the note keys off the ROUNDED value actually
+    # recorded, so a row can never read 0.0 without its diagnostic.
+    peak_hbm_gb = round(_peak_bytes() / 2**30, 3)
     return {
         "seq": seq,
         "batch": batch,
@@ -96,9 +106,17 @@ def _cell(seq: int, batch: int, *, attention: str, cpu_smoke: bool,
                         n_layers=dims["n_layers"], seq_len=seq,
                         d_model=dims["d_model"]), 4,
         ),
-        "peak_hbm_gb": round(_peak_bytes() / 2**30, 3),
+        "peak_hbm_gb": peak_hbm_gb,
         "compile_s": round(m["compile_s"], 1),
         "loss": m["loss"],
+        # r4 chip windows recorded peak_hbm_gb 0.0 in every row; when that
+        # happens again, record what the device DOES report so the failure
+        # is diagnosable from the artifact alone.
+        **(
+            {}
+            if peak_hbm_gb > 0
+            else {"hbm_note": f"memory_stats keys: {_mem_keys()}"}
+        ),
     }
 
 
