@@ -36,7 +36,14 @@ class TestBenchContract:
             # Keep the internal watchdog's budget well inside the pytest
             # timeout so a hung child resolves through bench's fallback
             # (the contract under test) rather than TimeoutExpired here.
-            env=_cpu_env(LLMTRAIN_BENCH_CPU_TIMEOUT="240"),
+            # Small batch/steps: the contract is the JSON line and exit 0,
+            # not the throughput — the default L2/d1280 CPU shape at full
+            # batch can exceed the watchdog on a loaded 1-core host.
+            env=_cpu_env(
+                LLMTRAIN_BENCH_CPU_TIMEOUT="240",
+                LLMTRAIN_BENCH_BATCH="4",
+                LLMTRAIN_BENCH_STEPS="2",
+            ),
             cwd=REPO,
         )
         assert proc.returncode == 0, proc.stderr[-500:]

@@ -225,6 +225,21 @@ class TestSamplingDistribution:
         assert out.shape == (1, PROMPT.shape[1] + 8)
         assert ((out >= 0) & (out < V)).all()
 
+    def test_out_of_band_knobs_mean_disabled(self):
+        """Library callers passing top_k=0 / top_p=0 or 1 get the same
+        'filter disabled' conventions as generate() (generation.py:283-289)
+        instead of lax.top_k(x, 0) under jit (ADVICE r4)."""
+        m, p = _gpt(seed=12)
+        ref = speculative_generate(
+            m, p, m, p, PROMPT, max_new_tokens=6, gamma=2,
+            temperature=0.8, top_k=None, top_p=None, rng=jax.random.key(7),
+        )
+        got = speculative_generate(
+            m, p, m, p, PROMPT, max_new_tokens=6, gamma=2,
+            temperature=0.8, top_k=0, top_p=0.0, rng=jax.random.key(7),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
 
 class TestValidation:
     def test_batch_one_only(self):

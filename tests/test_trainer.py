@@ -206,6 +206,26 @@ class TestAdafactor:
         assert sh["reduced"].spec == P()       # rank mismatch → replicated
         assert sh["nondivisible"].spec == P("fsdp", "tensor")  # kept
 
+    def test_shape_one_param_with_satisfiable_spec_keeps_it(self):
+        """ADVICE r4: the (1,)-leaf repair replicates ONLY unsatisfiable
+        specs (adafactor placeholders carrying an 'embed' spec on a mesh
+        where fsdp>1); a genuine (1,) param whose mapped axes are size 1
+        keeps its logical sharding instead of silently losing it."""
+        import jax
+        import jax.numpy as jnp
+        from flax import linen as nn
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from llmtrain_tpu.parallel.sharding import state_shardings
+
+        # fsdp=1 here: an "embed"→fsdp spec on a (1,) param IS satisfiable.
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(8, 1, 1, 1, 1, 1),
+                    ("data", "fsdp", "tensor", "sequence", "pipeline", "expert"))
+        box = nn.Partitioned
+        tree = {"tiny": box(jnp.zeros((1,)), names=("embed",))}
+        sh = state_shardings(mesh, tree)
+        assert sh["tiny"].spec == P("fsdp")  # kept, not silently replicated
+
     def test_unknown_optimizer_rejected(self):
         from llmtrain_tpu.config.schemas import TrainerConfig
         from llmtrain_tpu.training.optimizer import build_optimizer
