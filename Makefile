@@ -2,7 +2,13 @@
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
+# -n auto: xdist parallelism scales the gate to the host (1 worker on a
+# 1-core box, 8+ on CI); the persistent compilation cache (conftest.py)
+# is shared across workers, so compile-heavy tests pay each shape once.
 test:
+	python -m pytest tests/ -q -m "not slow" -n auto
+
+test-serial:
 	python -m pytest tests/ -q -m "not slow"
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
