@@ -243,6 +243,11 @@ def setup_distributed(cfg: DistributedConfig) -> DistState:
             process_id=process_id,
             local_device_ids=None,
             initialization_timeout=cfg.timeout_sec,
+            # The shutdown barrier must tolerate the same straggler skew
+            # as startup: on oversubscribed hosts (N procs per core in CI)
+            # ranks can reach teardown minutes apart, and jax's 300 s
+            # default then kills otherwise-green runs at the very end.
+            shutdown_timeout_seconds=max(300, cfg.timeout_sec),
         )
         _JAX_DIST_INITIALIZED = True
         process_id = jax.process_index()
