@@ -60,6 +60,23 @@ class TestBenchContract:
         for key in ("backend", "mfu", "attention", "loss_impl", "batch", "final_loss"):
             assert key in detail, key
 
+    def test_require_tpu_child_refuses_cpu_without_json(self):
+        """A watchdog-spawned 'TPU' child that lands on CPU must exit
+        nonzero with NO JSON line — otherwise a dead tunnel's in-process
+        CPU fallback would print a line the watchdog mislabels as
+        on-chip (evidence mode contamination)."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=_cpu_env(LLMTRAIN_BENCH_CHILD="1", LLMTRAIN_BENCH_REQUIRE_TPU="1"),
+            cwd=REPO,
+        )
+        assert proc.returncode == 3
+        assert "REQUIRE_TPU" in proc.stderr
+        assert not [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+
     def test_invalid_ce_knob_fails_loudly(self):
         proc = subprocess.run(
             [sys.executable, str(REPO / "bench.py")],
