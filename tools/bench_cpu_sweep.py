@@ -1,0 +1,108 @@
+"""Sweep CPU-fallback bench geometries: pick the shape bench.py uses.
+
+bench.py's forced-CPU child must clear the 0.30-MFU bar against the
+nominal 2e11 FLOP/s CPU peak (utils/hw.py) on whatever host the round
+driver lands on. This sweep reproduces how the committed shape
+(L2 d1280 h8 ff5120 V1024 T128 B16) was chosen in round 5: wide blocks
+keep a single core's FMA pipes busy where the old L2/d128 smoke shape
+measured only 0.17-0.23 across rounds 2-4. Measured landscape on the
+round-5 1-core host (MFU): d128 0.17, d256 0.22, d512 0.28, d768 0.30,
+d1024 0.25 (weights fall out of cache at L2), d1280 0.37 (best, both
+L1 and L2), d1536 0.33. Full methodology note in bench.py.
+
+Always pins the CPU backend — the point is the CPU-fallback landscape,
+never whatever accelerator the host has. Uses the shared tools/ cell
+harness (build_train_cell / measure_cell: median of device_get-synced
+per-step times), so its timing discipline matches the other sweeps.
+
+Usage (repo root, ~2-4 min per shape on one core):
+
+    python tools/bench_cpu_sweep.py
+    python tools/bench_cpu_sweep.py --shapes 1280,2,16 1536,2,8
+
+Each --shapes entry is d_model,depth,batch (d_ff = 4*d_model; n_heads =
+the largest of 8/4/2/1 dividing d_model). Emits one JSON line per shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def measure(d_model: int, depth: int, batch: int, *, seq: int = 128,
+            vocab: int = 1024, steps: int = 3) -> dict:
+    from _bench_common import build_train_cell, make_batch, measure_cell
+
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.utils.hw import mfu as compute_mfu
+
+    n_heads = next(h for h in (8, 4, 2, 1) if d_model % h == 0)
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": "cpusweep", "device": "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": seq,
+                "d_model": d_model,
+                "n_layers": depth,
+                "n_heads": n_heads,
+                "d_ff": 4 * d_model,
+                "dropout": 0.0,
+                "vocab_size": vocab,
+                "dtype": "float32",
+                "attention": "dense",
+                "extra": {"loss_impl": "dense", "assume_packed": True},
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {"micro_batch_size": batch, "grad_accum_steps": 1, "warmup_steps": 0},
+        }
+    )
+    step_fn, state, n_params = build_train_cell(cfg)
+    batch_dict = make_batch(batch, seq, vocab)
+    m = measure_cell(step_fn, state, batch_dict, steps)
+    tps = batch * seq / m["step_time_s"]
+    return {
+        "d_model": d_model,
+        "depth": depth,
+        "batch": batch,
+        "mfu": round(
+            compute_mfu(tps, n_params=n_params, n_layers=depth, seq_len=seq,
+                        d_model=d_model), 4),
+        "tokens_per_sec": round(tps, 1),
+        "step_time_ms": round(m["step_time_s"] * 1e3, 1),
+        "compile_s": round(m["compile_s"], 1),
+        "params": n_params,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--shapes",
+        nargs="+",
+        default=["128,2,4", "512,2,8", "1280,2,16", "1280,1,16"],
+        help="d_model,depth,batch per entry (d_ff = 4*d_model)",
+    )
+    args = ap.parse_args()
+    for spec in args.shapes:
+        d, depth, batch = (int(x) for x in spec.split(","))
+        try:
+            row = measure(d, depth, batch)
+        except Exception as exc:  # noqa: BLE001 — report per shape
+            row = {"d_model": d, "depth": depth, "batch": batch,
+                   "error": str(exc)[:200]}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
