@@ -55,6 +55,14 @@ def test_bench_longctx_smoke():
     assert row["tokens_per_sec"] > 0
 
 
+def test_bench_cpu_sweep_smoke():
+    proc = _run(["tools/bench_cpu_sweep.py", "--shapes", "64,1,2"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.splitlines()[-1])
+    assert "error" not in row, row
+    assert row["mfu"] > 0 and row["tokens_per_sec"] > 0
+
+
 def test_bench_interleave_smoke():
     proc = _run(["tools/bench_interleave.py", "--steps", "6"], timeout=560)
     assert proc.returncode == 0, proc.stderr
