@@ -1,87 +1,211 @@
 #!/usr/bin/env bash
-# Phase-2 on-chip evidence: the steps the first live window didn't cover
-# (r4: tunnel died after ~35 min, having banked bench/decode/longctx-4k8k).
+# Phase-2 on-chip evidence: the steps the first live windows didn't cover.
 #
 #     bash tools/run_chip_phase2.sh [outdir]
 #
-# Same contract as run_chip_evidence.sh: probe with a hard timeout, every
-# step watchdogged and independent, artifacts land in <outdir>.
+# Designed around how axon windows actually die (r4 + r5 evidence):
+#   - windows are short (~10-35 min) and can wedge on a LARGE program's
+#     remote compile (r4: seq 16384; r5: seq 8192) — after which every
+#     TPU client hangs until its watchdog;
+#   - so every step is gated by a fresh compile-verified probe: a dead
+#     tunnel aborts the runbook (exit 1) instead of burning hours of
+#     watchdogs, and tools/chip_watch.sh resumes watching;
+#   - steps are RESUME-AWARE: a step is banked iff its artifact holds
+#     its TERMINAL marker (summary line / last cell), so a window that
+#     dies mid-step re-runs that step, not the banked ones;
+#   - each step gets MAX_ATTEMPTS fired windows before the runbook
+#     gives up on it (a deterministically-failing step must not refire
+#     every ~2 min for the watch loop's whole budget);
+#   - small-program steps run first; the known window-killers (16k/32k
+#     long-context compiles) run last so a wedge costs only themselves.
+#
+# Exit 0 = nothing left to try (all banked or given up): watch stands
+# down. Exit 1 = work remains for a future window: watch keeps arming.
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-chip_evidence_p2}"
+MAX_ATTEMPTS="${CHIP_P2_MAX_ATTEMPTS:-2}"
 mkdir -p "$OUT"
 
 log() { echo "[chip-p2] $*" >&2; }
 
-log "probing TPU backend + compile helper (240s timeout)..."
-# tools/tpu_probe.py: backend init + tiny jitted matmul + device_get
-# sync — a dead remote_compile helper fails here instead of hanging
-# every armed step to its watchdog (r4 incident).
-if ! timeout 240 python tools/tpu_probe.py >"$OUT/probe.log" 2>&1; then
-    log "TPU backend or compile helper unreachable — aborting (see $OUT/probe.log)"
-    exit 1
+# ---- banked predicates: keyed on each artifact's TERMINAL output ----
+banked_suite()    { grep -Eq "= [0-9]+ passed in" "$OUT/tpu_compiled.log" 2>/dev/null \
+                    && ! grep -Eq "[0-9]+ (failed|error)" "$OUT/tpu_compiled.log"; }
+banked_mask_ab()  { grep -q "mask_overhead_pct" "$OUT/mask_ab.json" 2>/dev/null; }
+banked_sweep()    { grep -q '"vs_baseline"' "$OUT/bench_sweep.json" 2>/dev/null; }
+banked_c128()     { grep -q '"vs_baseline"' "$OUT/bench_c128.json" 2>/dev/null; }
+banked_family()   { grep '"family": "gpt"' "$OUT/family.json" 2>/dev/null | grep -q '"mfu"' \
+                    && grep '"family": "llama"' "$OUT/family.json" 2>/dev/null | grep -q '"mfu"'; }
+banked_spec()     { grep '"cell": "speculative_fresh_draft"' "$OUT/speculative.json" 2>/dev/null \
+                    | grep -q '"ms_per_token"'; }
+banked_decode()   { grep -q '"batch": 32, "n_kv_heads": 4' "$OUT/diag_decode.json" 2>/dev/null; }
+banked_bpe()      { grep -q "final_val_loss" "$OUT/bpe_headline.json" 2>/dev/null; }
+banked_longctx()  { grep -q "\"seq\": $1, \"batch\": 1, \"attention\": \"flash\", \"window\": 0, \"backend\": \"tpu\"" \
+                        "$OUT/longctx.json" 2>/dev/null; }
+banked_lc_win()   { grep -q "\"seq\": 16384, \"batch\": 1, \"attention\": \"flash\", \"window\": 1024, \"backend\": \"tpu\"" \
+                        "$OUT/longctx_window.json" 2>/dev/null; }
+
+attempts() { cat "$OUT/.attempts_$1" 2>/dev/null || echo 0; }
+mark_attempt() { echo $(( $(attempts "$1") + 1 )) >"$OUT/.attempts_$1"; }
+
+# should_run NAME BANKED_FN [ARGS...] -> 0 iff unbanked and under cap
+should_run() {
+    local name="$1"; shift
+    if "$@"; then log "$name already banked — skip"; return 1; fi
+    if [ "$(attempts "$name")" -ge "$MAX_ATTEMPTS" ]; then
+        log "$name hit $MAX_ATTEMPTS attempts without banking — giving up"
+        return 1
+    fi
+    return 0
+}
+
+# A step is open iff it is unbanked AND still has attempts left.
+open_steps() {
+    local n=0
+    banked_suite   || [ "$(attempts suite)"   -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_mask_ab || [ "$(attempts mask_ab)" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_sweep   || [ "$(attempts sweep)"   -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_c128    || [ "$(attempts c128)"    -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_family  || [ "$(attempts family)"  -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_spec    || [ "$(attempts spec)"    -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_decode  || [ "$(attempts decode)"  -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    if [ -f runs/pytok8k.json ]; then
+        banked_bpe || [ "$(attempts bpe)" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    fi
+    local T
+    for T in 8192 16384 32768; do
+        banked_longctx "$T" || [ "$(attempts "lc_$T")" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    done
+    banked_lc_win || [ "$(attempts lc_win)" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    echo "$n"
+}
+
+# Stand down BEFORE probing: a fully-banked (or given-up) outdir must
+# not need a live tunnel to report completion.
+if [ "$(open_steps)" -eq 0 ]; then
+    log "nothing left to try — standing down (see $OUT/ for artifacts)"
+    exit 0
 fi
-log "TPU live (compile path verified)."
 
-log "1/8 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
-timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
-    >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
-tail -2 "$OUT/tpu_compiled.log" || true
+# Fresh compile-verified probe. A wedged tunnel hangs even tiny
+# programs, so a 180 s timeout separates alive from dead reliably.
+gate() {
+    log "gate: probing TPU before step $1..."
+    if ! timeout 180 python tools/tpu_probe.py >"$OUT/probe.log" 2>&1; then
+        log "gate: tunnel dead before step $1 — aborting (watch loop resumes)"
+        exit 1
+    fi
+}
 
-log "2/8 masked-vs-packed A/B + GQA train deltas..."
-timeout 3000 python tools/bench_mask_ab.py \
-    >"$OUT/mask_ab.json" 2>"$OUT/mask_ab.log" || log "mask A/B failed/partial"
-tail -1 "$OUT/mask_ab.json" || true
+gate "start"
 
-log "3/8 long-context sweep (fixed per-step sync; retry 16k/32k)..."
-timeout 3600 python tools/bench_longctx.py --seqs 4096,8192,16384,32768 \
-    >"$OUT/longctx.json" 2>"$OUT/longctx.log" || log "longctx failed/partial"
+if should_run suite banked_suite; then
+    log "1/8 compiled-kernel suite (masks, GQA, bf16 bwd, chunked CE)..."
+    mark_attempt suite
+    timeout 2400 env LLMTRAIN_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -v \
+        >"$OUT/tpu_compiled.log" 2>&1 || log "compiled suite failed/partial"
+    tail -2 "$OUT/tpu_compiled.log" || true
+    gate "post-1"
+fi
 
-log "3b/8 sliding-window long-context cell (O(T·W) vs full causal)..."
-timeout 1500 python tools/bench_longctx.py --seqs 8192,16384 --window 1024 \
-    >"$OUT/longctx_window.json" 2>"$OUT/longctx_window.log" \
-    || log "windowed longctx failed/partial"
-tail -2 "$OUT/longctx_window.json" || true
+if should_run mask_ab banked_mask_ab; then
+    log "2/8 masked-vs-packed A/B + GQA train deltas..."
+    mark_attempt mask_ab
+    timeout 3000 python tools/bench_mask_ab.py \
+        >"$OUT/mask_ab.json" 2>"$OUT/mask_ab.log" || log "mask A/B failed/partial"
+    tail -1 "$OUT/mask_ab.json" || true
+    gate "post-2"
+fi
 
-log "4/8 decode attribution (layers/vocab/sampler/bf16-cast ablations)..."
-timeout 2400 python tools/diag_decode.py --batches 1,8,32 --kv-heads 0,4 \
-    >"$OUT/diag_decode.json" 2>"$OUT/diag_decode.log" \
-    || log "decode diag failed/partial"
+if should_run sweep banked_sweep; then
+    log "5/8 bench auto-sweep with room to climb (deadline 1500s)..."
+    mark_attempt sweep
+    timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=1600 \
+        LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
+        >"$OUT/bench_sweep.json" 2>"$OUT/bench_sweep.log" || log "bench sweep failed"
+    tail -1 "$OUT/bench_sweep.json" || true
+    gate "post-5"
+fi
 
-log "5/8 bench auto-sweep with room to climb (deadline 1500s)..."
-# TPU_TIMEOUT must rise with DEADLINE_SEC: the parent watchdog kills the
-# child at TPU_TIMEOUT regardless of the child's sweep budget.
-timeout 1800 env LLMTRAIN_BENCH_DEADLINE_SEC=1500 LLMTRAIN_BENCH_TPU_TIMEOUT=1600 \
-    LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
-    >"$OUT/bench_sweep.json" 2>"$OUT/bench_sweep.log" || log "bench sweep failed"
-tail -1 "$OUT/bench_sweep.json" || true
+if should_run c128 banked_c128; then
+    log "6/8 chunked-CE batch-128 cell (the HBM-freed retune)..."
+    mark_attempt c128
+    timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked \
+        LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
+        >"$OUT/bench_c128.json" 2>"$OUT/bench_c128.log" || log "c128 cell failed"
+    tail -1 "$OUT/bench_c128.json" || true
+    gate "post-6"
+fi
 
-log "6/8 chunked-CE batch-128 cell (the HBM-freed retune)..."
-timeout 1200 env LLMTRAIN_BENCH_BATCH=128 LLMTRAIN_BENCH_CE=chunked \
-    LLMTRAIN_BENCH_NO_FALLBACK=1 python bench.py \
-    >"$OUT/bench_c128.json" 2>"$OUT/bench_c128.log" || log "c128 cell failed"
-tail -1 "$OUT/bench_c128.json" || true
+if should_run family banked_family; then
+    log "7/8 model-family cells: gpt vs llama at matched scale..."
+    mark_attempt family
+    timeout 1200 python tools/bench_family.py \
+        >"$OUT/family.json" 2>"$OUT/family.log" || log "family cells failed/partial"
+    tail -2 "$OUT/family.json" || true
+    gate "post-7"
+fi
 
-log "7/8 model-family cells: gpt vs llama at matched scale..."
-timeout 1200 python tools/bench_family.py \
-    >"$OUT/family.json" 2>"$OUT/family.log" || log "family cells failed/partial"
-tail -2 "$OUT/family.json" || true
+if should_run spec banked_spec; then
+    log "7b/8 speculative-decode bounds (self/fresh draft, gamma=4)..."
+    mark_attempt spec
+    timeout 1200 python tools/bench_speculative.py \
+        >"$OUT/speculative.json" 2>"$OUT/speculative.log" \
+        || log "speculative cells failed/partial"
+    tail -2 "$OUT/speculative.json" || true
+    gate "post-7b"
+fi
 
-log "7b/8 speculative-decode bounds (self/fresh draft, gamma=4)..."
-timeout 1200 python tools/bench_speculative.py \
-    >"$OUT/speculative.json" 2>"$OUT/speculative.log" \
-    || log "speculative cells failed/partial"
-tail -2 "$OUT/speculative.json" || true
+if should_run decode banked_decode; then
+    log "4/8 decode attribution (layers/vocab/sampler/bf16-cast ablations)..."
+    mark_attempt decode
+    timeout 2400 python tools/diag_decode.py --batches 1,8,32 --kv-heads 0,4 \
+        >"$OUT/diag_decode.json" 2>"$OUT/diag_decode.log" \
+        || log "decode diag failed/partial"
+    gate "post-4"
+fi
 
-log "8/8 BPE headline train (tokenizer already at runs/pytok8k.json)..."
 if [ -f runs/pytok8k.json ]; then
-    timeout 5400 python -m llmtrain_tpu train \
-        --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
-        --run-id chip-evidence-bpe --json \
-        >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
-        || log "BPE headline failed/partial"
+    if should_run bpe banked_bpe; then
+        log "8/8 BPE headline train (tokenizer at runs/pytok8k.json)..."
+        mark_attempt bpe
+        timeout 5400 python -m llmtrain_tpu train \
+            --config configs/presets/gpt_pycorpus_bpe_tpu.yaml \
+            --run-id chip-evidence-bpe --json \
+            >"$OUT/bpe_headline.json" 2>"$OUT/bpe_headline.log" \
+            || log "BPE headline failed/partial"
+        gate "post-8"
+    fi
 else
-    log "no tokenizer file — skipping BPE headline train"
+    log "8/8 no tokenizer file — BPE headline not attempted on this host"
 fi
 
-log "done — artifacts in $OUT/. Fold the numbers into RESULTS.md."
+# Long-context rows LAST, one subprocess per T with its own watchdog:
+# a wedge on one T costs only that row plus the next gate, not the
+# rest of the runbook (r5: the single-process 4-seq sweep died at 8192
+# and took the window's remaining value with it).
+for T in 8192 16384 32768; do
+    if should_run "lc_$T" banked_longctx "$T"; then
+        log "3/8 longctx T=$T..."
+        mark_attempt "lc_$T"
+        timeout 900 python tools/bench_longctx.py --seqs "$T" \
+            >>"$OUT/longctx.json" 2>"$OUT/longctx_$T.log" \
+            || log "longctx T=$T failed/partial"
+        gate "post-3-T$T"
+    fi
+done
+
+if should_run lc_win banked_lc_win; then
+    log "3b/8 sliding-window long-context cell (O(T·W) vs full causal)..."
+    mark_attempt lc_win
+    timeout 1500 python tools/bench_longctx.py --seqs 8192,16384 --window 1024 \
+        >"$OUT/longctx_window.json" 2>"$OUT/longctx_window.log" \
+        || log "windowed longctx failed/partial"
+    tail -2 "$OUT/longctx_window.json" || true
+fi
+
+left="$(open_steps)"
+log "pass complete — $left step(s) still open (artifacts in $OUT/)."
+[ "$left" -eq 0 ] || exit 1
+exit 0
