@@ -1,0 +1,135 @@
+"""Offline regression coverage for tools/run_chip_phase2.sh resume logic.
+
+The runbook refires on every live tunnel window (tools/chip_watch.sh), so
+its banked/skip/give-up accounting must be exactly right offline:
+
+- a step is banked iff its artifact holds its TERMINAL marker (a window
+  dying mid-step must re-run that step — r5 saw mask_ab-style tools die
+  after their first row);
+- a step that burned MAX_ATTEMPTS windows without banking is given up
+  (a deterministically failing step must not refire for the whole watch
+  budget);
+- a fully banked/given-up outdir stands down (exit 0) WITHOUT needing a
+  live tunnel, so the watch loop can end even when the tunnel is dead;
+- anything still open goes through the compile-verified start gate,
+  which aborts exit-1 fast on a dead tunnel (forced here by pinning the
+  probe child to CPU).
+
+These run the real script against synthesized artifact dirs; no TPU and
+no jax import in-process (the open-dir cases pay one probe-child jax
+import each).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Terminal markers as each tool actually emits them (key order matters:
+# the runbook banks on literal substring greps).
+_BANKED = {
+    "tpu_compiled.log": "===== 22 passed in 188.13s (0:03:08) =====\n",
+    "mask_ab.json": json.dumps({"mask_overhead_pct+mha": 6.01}) + "\n",
+    "bench_sweep.json": json.dumps({"metric": "tokens_per_sec_per_chip",
+                                    "vs_baseline": 1.5}) + "\n",
+    "bench_c128.json": json.dumps({"metric": "tokens_per_sec_per_chip",
+                                   "vs_baseline": 1.4}) + "\n",
+    "family.json": (json.dumps({"family": "gpt", "mfu": 0.45}) + "\n"
+                    + json.dumps({"family": "llama", "mfu": 0.41}) + "\n"),
+    "speculative.json": json.dumps({"cell": "speculative_fresh_draft",
+                                    "ms_per_token": 1.9}) + "\n",
+    "diag_decode.json": json.dumps({"backend": "tpu", "batch": 32,
+                                    "n_kv_heads": 4}) + "\n",
+    "bpe_headline.json": json.dumps({"final_val_loss": 3.21}) + "\n",
+    "longctx.json": "".join(
+        json.dumps({"seq": t, "batch": 1, "attention": "flash",
+                    "window": 0, "backend": "tpu"}) + "\n"
+        for t in (8192, 16384, 32768)
+    ),
+    "longctx_window.json": json.dumps(
+        {"seq": 16384, "batch": 1, "attention": "flash", "window": 1024,
+         "backend": "tpu"}) + "\n",
+}
+
+
+def _write_banked(outdir: Path, *, except_for: set[str] = frozenset()) -> None:
+    for name, content in _BANKED.items():
+        if name not in except_for:
+            (outdir / name).write_text(content)
+
+
+def _run(outdir: Path, timeout: float = 300,
+         fake_dead_probe: bool = False) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # probe child asserts backend == tpu
+    if fake_dead_probe:
+        # The gate invokes `python tools/tpu_probe.py` via PATH; shadowing
+        # `python` makes the dead-tunnel abort instant instead of paying a
+        # real jax import just to learn the backend is cpu.
+        stub = outdir / ".bin"
+        stub.mkdir(exist_ok=True)
+        (stub / "python").write_text("#!/bin/sh\nexit 1\n")
+        (stub / "python").chmod(0o755)
+        env["PATH"] = f"{stub}{os.pathsep}{env['PATH']}"
+    return subprocess.run(
+        ["bash", "tools/run_chip_phase2.sh", str(outdir)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=timeout,
+    )
+
+
+def test_fully_banked_dir_stands_down_without_tunnel(tmp_path):
+    _write_banked(tmp_path)
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "standing down" in proc.stderr
+    # Stand-down must not have needed a probe: no probe artifact written.
+    assert not (tmp_path / "probe.log").exists()
+
+
+def test_partial_artifact_is_not_banked(tmp_path):
+    """A first-row-only artifact (window died mid-step) keeps the step
+    open: the runbook must reach its start gate, not stand down."""
+    _write_banked(tmp_path, except_for={"mask_ab.json"})
+    # One measured row but no terminal summary line:
+    (tmp_path / "mask_ab.json").write_text(
+        json.dumps({"cell": "packed", "backend": "tpu", "mfu": 0.38}) + "\n")
+    proc = _run(tmp_path, fake_dead_probe=True)
+    assert proc.returncode == 1
+    assert "tunnel dead before step start" in proc.stderr
+
+
+def test_failed_suite_log_is_not_banked(tmp_path):
+    _write_banked(tmp_path, except_for={"tpu_compiled.log"})
+    (tmp_path / "tpu_compiled.log").write_text(
+        "==== 2 failed, 20 passed in 201.0s ====\n")
+    proc = _run(tmp_path, fake_dead_probe=True)
+    assert proc.returncode == 1
+    assert "tunnel dead before step start" in proc.stderr
+
+
+def test_attempt_cap_gives_up_and_stands_down(tmp_path):
+    """An unbanked step that already burned MAX_ATTEMPTS windows is given
+    up; with nothing else open the runbook stands down offline."""
+    _write_banked(tmp_path, except_for={"speculative.json"})
+    (tmp_path / ".attempts_spec").write_text("2\n")
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "standing down" in proc.stderr
+
+
+def test_banked_suite_marker_is_count_independent(tmp_path):
+    """Banking must not hardcode a pass count: a grown suite still banks."""
+    _write_banked(tmp_path, except_for={"tpu_compiled.log"})
+    (tmp_path / "tpu_compiled.log").write_text(
+        "===== 31 passed in 240.00s (0:04:00) =====\n")
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "standing down" in proc.stderr
