@@ -285,11 +285,27 @@ def _warn_unknown_extras(cfg) -> None:
         pass
 
 
+def _lora_spec_error(cfg) -> str | None:
+    """A malformed ``model.extra.lora`` is a CONFIG error (exit 2), not a
+    training failure — catch it before any jax work (models/lora.py)."""
+    try:
+        from .models.lora import LoraSpec
+
+        LoraSpec.from_extra(cfg.model.extra)
+    except ValueError as exc:
+        return str(exc)
+    return None
+
+
 def _handle_validate(args: argparse.Namespace) -> int:
     try:
         cfg, _, _ = load_and_validate_config(args.config)
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
         return EXIT_CONFIG_ERROR
     _warn_unknown_extras(cfg)
     if args.json:
@@ -358,6 +374,10 @@ def _handle_average_checkpoints(args: argparse.Namespace) -> int:
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
@@ -410,7 +430,20 @@ def _handle_average_checkpoints(args: argparse.Namespace) -> int:
 
         import yaml as _yaml
 
-        adapter = get_model_adapter(cfg.model.name)()
+        from .models.lora import LoraAdapter, build_adapter
+
+        adapter = build_adapter(cfg)
+        if isinstance(adapter, LoraAdapter):
+            # Averaging factors leafwise keeps the checkpoint resumable,
+            # but avg(A) @ avg(B) != avg(A @ B): sound for the near-
+            # collinear factors of ONE run's last-k checkpoints, wrong
+            # for divergent parallel fine-tunes (merge via
+            # export-checkpoint first for those).
+            get_logger().warning(
+                "LoRA soup: averaging A/B factors leafwise — only "
+                "meaningful for checkpoints of a single run; for parallel "
+                "fine-tunes, export-checkpoint (merged) and average those"
+            )
         model = adapter.build_model(cfg)
         abstract = _abstract_params(cfg, adapter, model)
         expected_yaml = _yaml.safe_dump(cfg.model_dump(), sort_keys=False)
@@ -456,8 +489,16 @@ def _handle_average_checkpoints(args: argparse.Namespace) -> int:
             acc,
             params,
         )
+        # The Trainer resumes against ITS optimizer layout: apply the same
+        # adapter-level wrap (LoRA: moments only for the factors) or the
+        # printed `train --resume` would hit an opt_state structure
+        # mismatch. Mirrors the import-checkpoint path.
+        avg_tx = build_optimizer(cfg.trainer)
+        wrap_tx = getattr(adapter, "wrap_optimizer", None)
+        if wrap_tx is not None:
+            avg_tx = wrap_tx(avg_tx)
         state = create_train_state(
-            jax.tree.map(jnp.asarray, avg), build_optimizer(cfg.trainer)
+            jax.tree.map(jnp.asarray, avg), avg_tx
         )
         target = CheckpointManager(out_dir).save_host(
             0, state_to_host(state), cfg.model_dump()
@@ -492,6 +533,10 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
@@ -505,14 +550,17 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
             params_to_torch_state_dict,
             pipeline_params_to_gpt,
         )
-        from .registry import get_model_adapter
+        from .models.lora import build_adapter, to_inference_params
 
         initialize_registries()
-        adapter = get_model_adapter(cfg.model.name)()
+        adapter = build_adapter(cfg)
         model = adapter.build_model(cfg)
         ckpt_path, params, step = _load_checkpoint_params(
             cfg, adapter, model, args.from_spec
         )
+        # LoRA runs export their MERGED weights: the file stays the
+        # family's lingua-franca full-rank state dict (models/lora.py).
+        params = to_inference_params(adapter, params)
         if is_pipeline_tree(params):
             # Pipeline-trained run: unstack to the per-layer gpt tree
             # first (interop/pipeline_convert.py) — same math, so the
@@ -563,6 +611,10 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
@@ -579,7 +631,7 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
             params_from_torch_state_dict,
             pipeline_params_to_gpt,
         )
-        from .registry import get_model_adapter
+        from .models.lora import LoraAdapter, build_adapter, init_lora
         from .training.checkpoint import CheckpointManager, state_to_host
         from .training.optimizer import build_optimizer
         from .training.train_step import create_train_state
@@ -595,9 +647,15 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
                 f"({existing[0].name}, ...); pass an empty directory"
             )
             return EXIT_TRAIN_FAILURE
-        adapter = get_model_adapter(cfg.model.name)()
+        adapter = build_adapter(cfg)
         model = adapter.build_model(cfg)
         template = _abstract_params(cfg, adapter, model)
+        # Importing into a LoRA config is THE fine-tuning entry point:
+        # the torch weights fill the frozen base, the factors start at
+        # their zero-delta init, and `train --resume` picks it up.
+        lora_adapter = adapter if isinstance(adapter, LoraAdapter) else None
+        if lora_adapter is not None:
+            template = template["base"]
         raw = torch.load(args.input, weights_only=True)
         # .float() first: torch bf16 tensors cannot .numpy() directly, and
         # the converter works in float32 anyway.
@@ -620,7 +678,18 @@ def _handle_import_checkpoint(args: argparse.Namespace) -> int:
         else:
             params = params_from_torch_state_dict(sd, template)
 
-        state = create_train_state(params, build_optimizer(cfg.trainer))
+        tx = build_optimizer(cfg.trainer)
+        if lora_adapter is not None:
+            params = {
+                "base": params,
+                "lora": init_lora(
+                    params,
+                    lora_adapter.spec,
+                    jax.random.fold_in(jax.random.key(cfg.run.seed), 0x10A),
+                ),
+            }
+            tx = lora_adapter.wrap_optimizer(tx)
+        state = create_train_state(params, tx)
         target = CheckpointManager(out_dir).save_host(
             0, state_to_host(state), cfg.model_dump()
         )
@@ -775,6 +844,10 @@ def _handle_eval(args: argparse.Namespace) -> int:
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache()
@@ -879,6 +952,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
         return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache()
@@ -916,9 +993,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
         import numpy as np
 
         from .generation import generate
+        from .models.lora import build_adapter, to_inference_params
 
         initialize_registries()
-        adapter = get_model_adapter(cfg.model.name)()
+        adapter = build_adapter(cfg)
 
         tokenizer = None
         try:
@@ -977,6 +1055,8 @@ def _handle_generate(args: argparse.Namespace) -> int:
             cfg, adapter, model, args.from_spec
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
+        # LoRA checkpoints decode on the merged weights (models/lora.py).
+        params = to_inference_params(adapter, params)
         model, params = _prepare_decode_model(
             model, params, args.decode_param_dtype, logger
         )
@@ -992,6 +1072,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
             except ConfigLoadError as exc:
                 _emit_error(exc.message, details=exc.details, errors=exc.errors)
                 return EXIT_CONFIG_ERROR
+            draft_lora_err = _lora_spec_error(draft_cfg)
+            if draft_lora_err is not None:
+                _emit_error(draft_lora_err)
+                return EXIT_CONFIG_ERROR
             # Same fail-fast bound as the target's, BEFORE checkpoint I/O.
             longest = max(len(ids) for ids in prompt_batches)
             need = longest + args.max_new_tokens + args.gamma + 1
@@ -1001,11 +1085,12 @@ def _handle_generate(args: argparse.Namespace) -> int:
                     f"draft model's block_size ({draft_cfg.model.block_size})"
                 )
                 return EXIT_CONFIG_ERROR
-            draft_adapter = get_model_adapter(draft_cfg.model.name)()
+            draft_adapter = build_adapter(draft_cfg)
             draft_model = draft_adapter.build_model(draft_cfg)
             draft_ckpt, draft_params, draft_step = _load_checkpoint_params(
                 draft_cfg, draft_adapter, draft_model, args.draft_from
             )
+            draft_params = to_inference_params(draft_adapter, draft_params)
             logger.info(
                 "loaded draft checkpoint %s (step %d)", draft_ckpt, draft_step
             )
@@ -1134,6 +1219,10 @@ def _handle_train(args: argparse.Namespace) -> int:
         cfg, _, resolved = load_and_validate_config(args.config)
     except ConfigLoadError as exc:
         _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)

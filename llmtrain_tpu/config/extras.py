@@ -37,14 +37,17 @@ def unknown_extra_keys(cfg: RunConfig) -> dict[str, list[str]]:
             out[section] = unknown
 
     try:
-        from ..registry import get_model_adapter, initialize_registries
+        from ..models.lora import build_adapter
+        from ..registry import initialize_registries
 
         initialize_registries()
-        adapter_cls = get_model_adapter(cfg.model.name)
+        # The instance, not the class: the LoRA wrapper augments the
+        # wrapped family's known keys with its own (models/lora.py).
+        adapter = build_adapter(cfg)
         check(
             "model.extra",
             cfg.model.extra,
-            getattr(adapter_cls, "known_extra_keys", None),
+            getattr(adapter, "known_extra_keys", None),
         )
     except Exception:  # unknown plugin name etc. — other checks will report
         pass

@@ -1,0 +1,381 @@
+"""LoRA fine-tuning as a family-agnostic adapter wrapper.
+
+Beyond-reference capability (the reference trains full-rank only): wrap
+ANY registered model family in low-rank adaptation without touching its
+module code. The design is deliberately functional, the TPU-idiomatic
+shape of LoRA:
+
+* the trainable state becomes ``{"base": <frozen family params>,
+  "lora": <A/B factor tree>}`` — one pytree, so the existing train step,
+  checkpointing, sharding, and resume machinery apply unchanged;
+* the merge ``W' = W + (alpha/rank) * A @ B`` happens INSIDE the jitted
+  loss with ``stop_gradient`` on the base leaves, so XLA dead-code
+  eliminates the entire frozen backward pass — the compiled step computes
+  gradients only for the factors;
+* freezing is an ``optax.masked`` wrapper (``wrap_optimizer``): moments
+  exist only for LoRA leaves, so AdamW optimizer state drops from
+  2x params to 2x factors — the usual reason to LoRA-tune at all;
+* base leaves keep their flax logical-axis boxes through the merge
+  (``replace_boxed``), so FSDP/TP shardings of the frozen weights
+  survive and the small factors replicate (parallel/sharding.py treats
+  metadata-less leaves as replicated).
+
+Config surface (any family)::
+
+    model:
+      extra:
+        lora: {rank: 8, alpha: 16}            # defaults target attention
+        # lora: {rank: 8, alpha: 16, targets: [qkv_proj, out_proj, mlp_fc]}
+
+Targets name the parent flax module of a ``kernel``/``embedding`` leaf;
+the families share the naming (``qkv_proj``/``q_proj``/``kv_proj``/
+``out_proj`` attention projections, ``mlp_*`` dense layers, models/gpt.py
+and models/llama.py). ``llmtrain_tpu train`` consumes the config like any
+other; ``generate``/``eval``/``export`` merge automatically on load
+(``inference_params``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from .base import Batch, Metrics, ModelAdapter, Params
+
+# Attention projections: the classic LoRA target set, shared verbatim by
+# every built-in family (models/gpt.py, models/llama.py incl. GQA).
+DEFAULT_TARGETS = ("qkv_proj", "q_proj", "kv_proj", "out_proj")
+
+# Leaf names eligible for adaptation (norm scales and biases stay out).
+_FACTORABLE_LEAVES = ("kernel", "embedding")
+
+
+@dataclass(frozen=True)
+class LoraSpec:
+    rank: int
+    alpha: float
+    targets: tuple[str, ...]
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    @classmethod
+    def from_extra(cls, extra: dict) -> "LoraSpec | None":
+        """Parse ``model.extra.lora``; None when absent (LoRA off)."""
+        raw = extra.get("lora")
+        if raw is None:
+            return None
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"model.extra.lora must be a mapping, got {type(raw).__name__}"
+            )
+        unknown = sorted(set(raw) - {"rank", "alpha", "targets"})
+        if unknown:
+            raise ValueError(
+                f"model.extra.lora: unknown keys {unknown}; expected "
+                "rank/alpha/targets"
+            )
+        rank = int(raw.get("rank", 8))
+        if rank < 1:
+            raise ValueError(f"model.extra.lora.rank must be >= 1, got {rank}")
+        alpha = float(raw.get("alpha", 2.0 * rank))
+        if alpha <= 0:
+            raise ValueError(f"model.extra.lora.alpha must be > 0, got {alpha}")
+        raw_targets = raw.get("targets", DEFAULT_TARGETS)
+        if (
+            not isinstance(raw_targets, (list, tuple))
+            or not raw_targets
+            or not all(isinstance(t, str) and t for t in raw_targets)
+        ):
+            # isinstance first: tuple("qkv_proj") would silently explode a
+            # YAML string into characters and fail much later, misleadingly.
+            raise ValueError(
+                "model.extra.lora.targets must be a non-empty list of module names"
+            )
+        targets = tuple(raw_targets)
+        return cls(rank=rank, alpha=alpha, targets=targets)
+
+
+def _path_names(path: tuple) -> tuple[str, ...]:
+    return tuple(str(getattr(k, "key", k)) for k in path)
+
+
+def _is_box(leaf: Any) -> bool:
+    return isinstance(leaf, nn.meta.AxisMetadata)
+
+
+def _unbox(leaf: Any) -> jax.Array:
+    return leaf.unbox() if _is_box(leaf) else leaf
+
+
+def _split_index(module: str, ndim: int) -> int:
+    """Where the kernel factors as (fan_in dims | fan_out dims).
+
+    flax ``DenseGeneral`` lays kernels out input-dims-first: projections
+    INTO heads are ``(d_model, *out)`` (split 1) while ``out_proj``
+    contracts the leading ``(heads, head_dim)`` dims (split ndim-1).
+    Embeddings are ``(vocab, d_model)`` — split 1.
+    """
+    return ndim - 1 if module == "out_proj" else 1
+
+
+def _target_entry(names: tuple[str, ...], leaf: Any, spec: LoraSpec):
+    """``(module, shape, split)`` when this leaf is adapted, else None."""
+    if len(names) < 2 or names[-1] not in _FACTORABLE_LEAVES:
+        return None
+    module = names[-2]
+    if module not in spec.targets:
+        return None
+    shape = tuple(_unbox(leaf).shape)
+    if len(shape) < 2:
+        return None
+    return module, shape, _split_index(module, len(shape))
+
+
+def init_lora(base_params: Params, spec: LoraSpec, rng: jax.Array) -> Params:
+    """The factor tree: nested dict mirroring target paths, each holding
+    ``a: (fan_in, rank)`` Gaussian and ``b: (rank, fan_out)`` zeros — so
+    the initial delta is exactly zero and step 0 reproduces the base
+    model."""
+    flat = jax.tree_util.tree_flatten_with_path(
+        base_params, is_leaf=_is_box
+    )[0]
+    lora: dict = {}
+    matched: list[str] = []
+    modules_seen: set[str] = set()
+    for path, leaf in flat:
+        names = _path_names(path)
+        if len(names) >= 2 and names[-1] in _FACTORABLE_LEAVES:
+            modules_seen.add(names[-2])
+        entry = _target_entry(names, leaf, spec)
+        if entry is None:
+            continue
+        _, shape, split = entry
+        fan_in = math.prod(shape[:split])
+        fan_out = math.prod(shape[split:])
+        dtype = _unbox(leaf).dtype
+        rng, a_rng = jax.random.split(rng)
+        node = lora
+        for name in names[:-1]:
+            node = node.setdefault(name, {})
+        node[names[-1]] = {
+            "a": (
+                jax.random.normal(a_rng, (fan_in, spec.rank), dtype)
+                / jnp.sqrt(jnp.asarray(fan_in, dtype))
+            ),
+            "b": jnp.zeros((spec.rank, fan_out), dtype),
+        }
+        matched.append("/".join(names[:-1]))
+    if not matched:
+        raise ValueError(
+            f"model.extra.lora.targets {list(spec.targets)} matched no "
+            f"parameters; factorable modules in this model: "
+            f"{sorted(modules_seen)}"
+        )
+    return lora
+
+
+def merge_lora(
+    base_params: Params,
+    lora_params: Params,
+    spec: LoraSpec,
+    *,
+    freeze_base: bool = False,
+) -> Params:
+    """``W + scale * (A @ B)`` on target leaves, boxes preserved.
+
+    ``freeze_base=True`` stops gradients at every base leaf — the
+    training path, where only the factors are trainable and XLA drops
+    the frozen backward entirely.
+    """
+
+    def one(path, leaf):
+        names = _path_names(path)
+        value = _unbox(leaf)
+        if freeze_base:
+            value = jax.lax.stop_gradient(value)
+        entry = _target_entry(names, leaf, spec)
+        if entry is not None:
+            node: Any = lora_params
+            for name in names:
+                node = node[name]
+            delta = (node["a"] @ node["b"]) * spec.scale
+            value = value + delta.reshape(value.shape).astype(value.dtype)
+        return leaf.replace_boxed(value) if _is_box(leaf) else value
+
+    return jax.tree_util.tree_map_with_path(one, base_params, is_leaf=_is_box)
+
+
+def lora_mask(params: Params) -> Params:
+    """Trainable-leaf mask over the combined tree: True for the factors,
+    False for the frozen base. Flax metadata boxes are masked WHOLE
+    (``is_leaf``) so one flag aligns with one array."""
+    return {
+        "base": jax.tree.map(lambda _: False, params["base"], is_leaf=_is_box),
+        "lora": jax.tree.map(lambda _: True, params["lora"]),
+    }
+
+
+def lora_only_optimizer(tx):
+    """Run ``tx`` on the ``lora`` subtree only; pass base updates through.
+
+    Base gradients are structural zeros (``stop_gradient`` in the merge),
+    so passing them through applies ``base + 0``. Deliberately NOT
+    ``optax.masked``: its ``MaskedNode`` placeholders would sit inside
+    flax metadata boxes and fight both the checkpoint serializer and
+    ``state_shardings`` — this wrapper's state is ``tx``'s state over the
+    factor subtree, plain arrays that checkpoint and shard like any
+    other. Moments for the frozen base never exist, which is the LoRA
+    memory win."""
+    import optax
+
+    def init(params):
+        return tx.init(params["lora"])
+
+    def update(updates, state, params=None):
+        lora_updates, new_state = tx.update(
+            updates["lora"], state, None if params is None else params["lora"]
+        )
+        return {"base": updates["base"], "lora": lora_updates}, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+class LoraAdapter(ModelAdapter):
+    """Wraps any base adapter; params become ``{"base": ..., "lora": ...}``.
+
+    The Trainer/CLI pick this up via :func:`build_adapter`; the existing
+    getattr-duck-typed hooks (``validate_mesh``, ``batch_divisor``) and
+    the two new ones (``wrap_optimizer``, ``inference_params``) carry the
+    LoRA specifics without touching the core train step.
+    """
+
+    supports_pipeline = False  # stacked-layer param trees name differently
+
+    def __init__(self, base: ModelAdapter, spec: LoraSpec) -> None:
+        self._base = base
+        self._spec = spec
+        base_known = getattr(base, "known_extra_keys", None)
+        self.known_extra_keys = (
+            None if base_known is None else frozenset(base_known) | {"lora"}
+        )
+        validate = getattr(base, "validate_mesh", None)
+        if validate is not None:
+            self.validate_mesh = validate  # bound method of the base
+
+    @property
+    def spec(self) -> LoraSpec:
+        return self._spec
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        return self._base.build_model(cfg)
+
+    def build_tokenizer(self, cfg: RunConfig):
+        return self._base.build_tokenizer(cfg)
+
+    def batch_divisor(self, cfg: RunConfig, mesh: Any) -> int:
+        return self._base.batch_divisor(cfg, mesh)
+
+    def init_params(self, model: nn.Module, cfg: RunConfig, rng: jax.Array) -> Params:
+        # The base tree is bit-identical to a non-LoRA init of the same
+        # seed; the factor init draws from an independent folded stream.
+        base_params = self._base.init_params(model, cfg, rng)
+        lora = init_lora(base_params, self._spec, jax.random.fold_in(rng, 0x10A))
+        return {"base": base_params, "lora": lora}
+
+    def _merged(self, params: Params, *, freeze_base: bool) -> Params:
+        if (
+            not isinstance(params, dict)
+            or "base" not in params
+            or "lora" not in params
+        ):
+            raise ValueError(
+                "LoRA is enabled (model.extra.lora) but the parameter tree "
+                "has no base/lora split — was this checkpoint trained "
+                "without LoRA? Drop model.extra.lora to consume it."
+            )
+        return merge_lora(
+            params["base"], params["lora"], self._spec, freeze_base=freeze_base
+        )
+
+    def compute_loss(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, Metrics]:
+        return self._base.compute_loss(
+            model,
+            self._merged(params, freeze_base=True),
+            batch,
+            rngs=rngs,
+            deterministic=deterministic,
+        )
+
+    def compute_loss_components(
+        self,
+        model: nn.Module,
+        params: Params,
+        batch: Batch,
+        *,
+        rngs: dict[str, jax.Array] | None = None,
+        deterministic: bool = True,
+    ):
+        return self._base.compute_loss_components(
+            model,
+            self._merged(params, freeze_base=True),
+            batch,
+            rngs=rngs,
+            deterministic=deterministic,
+        )
+
+    def wrap_optimizer(self, tx):
+        """Freeze the base: moments only for the factors."""
+        return lora_only_optimizer(tx)
+
+    def trainable_param_mask(self, params: Params) -> Params:
+        """Which leaves train — feeds the Trainer's trainable count and
+        its frozen-aware MFU FLOP model (utils/hw.py)."""
+        return lora_mask(params)
+
+    def inference_params(self, params: Params) -> Params:
+        """Plain merged tree in the base family's structure — what
+        ``generate``/``eval``/``export`` apply and write."""
+        return self._merged(params, freeze_base=False)
+
+
+def to_inference_params(adapter: ModelAdapter, params: Params) -> Params:
+    """Merge-on-load rule in one place: LoRA checkpoints become plain
+    family trees for any consumer that applies or exports weights."""
+    merge = getattr(adapter, "inference_params", None)
+    return params if merge is None else merge(params)
+
+
+def build_adapter(cfg: RunConfig) -> ModelAdapter:
+    """The one adapter factory: registry lookup + optional LoRA wrap.
+
+    Every consumer (Trainer, generate/eval/export CLI paths) builds its
+    adapter here so ``model.extra.lora`` means the same thing everywhere.
+    """
+    from ..registry import get_model_adapter
+
+    base = get_model_adapter(cfg.model.name)()
+    spec = LoraSpec.from_extra(cfg.model.extra)
+    if spec is None:
+        return base
+    if getattr(base, "supports_pipeline", False):
+        raise ValueError(
+            "model.extra.lora does not support stacked-layer pipeline "
+            "models; use a per-block family (gpt, llama, ...)"
+        )
+    return LoraAdapter(base, spec)

@@ -41,7 +41,7 @@ from ..parallel.sharding import (
     replicated,
     state_shardings,
 )
-from ..registry import get_data_module, get_model_adapter
+from ..registry import get_data_module
 from ..tracking.base import Tracker
 from ..utils.hw import mfu as compute_mfu
 from ..utils.hw import peak_flops_per_chip
@@ -84,7 +84,9 @@ class Trainer:
         self._dist_state = dist_state
 
         self._dataset_specs: dict[int, tuple[tuple[str, ...], int]] = {}
-        self._adapter = get_model_adapter(cfg.model.name)()
+        from ..models.lora import build_adapter
+
+        self._adapter = build_adapter(cfg)
         self._data_module = get_data_module(cfg.data.name)()
 
         tokenizer = None
@@ -127,6 +129,12 @@ class Trainer:
         )
 
         self._tx = build_optimizer(cfg.trainer)
+        # Adapter-level optimizer wrapping (LoRA freezes the base tree by
+        # masking moments to the factor leaves) — duck-typed like
+        # validate_mesh above.
+        wrap_tx = getattr(self._adapter, "wrap_optimizer", None)
+        if wrap_tx is not None:
+            self._tx = wrap_tx(self._tx)
         self._schedule = lr_schedule(cfg.trainer)
 
         self._ckpt_mgr: CheckpointManager | None = None
@@ -163,6 +171,23 @@ class Trainer:
         self._param_count = int(
             sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
         )
+        # Adapters that freeze parameters (LoRA) expose which leaves
+        # train; the count feeds the summary AND the MFU FLOP model
+        # (utils/hw.py: a frozen base skips its dW backward).
+        mask_fn = getattr(self._adapter, "trainable_param_mask", None)
+        if mask_fn is None:
+            self._trainable_count = self._param_count
+        else:
+            mask = mask_fn(self._state.params)
+            self._trainable_count = int(
+                sum(
+                    int(np.prod(x.shape))
+                    for x, keep in zip(
+                        jax.tree.leaves(params), jax.tree.leaves(mask)
+                    )
+                    if keep
+                )
+            )
         self._peak_flops = peak_flops_per_chip()
         self._train_seqlen = cfg.model.block_size  # refined from data in fit()
 
@@ -475,7 +500,7 @@ class Trainer:
             first_step_loss=first_step_loss,
             resumed_from_step=resumed_from_step,
             parameter_count=self._param_count,
-            trainable_parameter_count=self._param_count,
+            trainable_parameter_count=self._trainable_count,
             total_tokens=total_tokens,
         )
 
@@ -546,6 +571,7 @@ class Trainer:
             seq_len=self._train_seqlen,  # actual trained length, not block_size
             d_model=self._cfg.model.d_model,
             peak_flops=self._peak_flops,
+            n_trainable_params=self._trainable_count,
         )
 
         if self._is_main:

@@ -37,10 +37,24 @@ def peak_flops_per_chip() -> float:
 
 
 def transformer_flops_per_token(
-    *, n_params: int, n_layers: int, seq_len: int, d_model: int
+    *,
+    n_params: int,
+    n_layers: int,
+    seq_len: int,
+    d_model: int,
+    n_trainable_params: int | None = None,
 ) -> float:
-    """Training FLOPs/token ~ 6N + 12*L*T*d (PaLM appendix B approximation)."""
-    return 6.0 * n_params + 12.0 * n_layers * seq_len * d_model
+    """Training FLOPs/token ~ 6N + 12*L*T*d (PaLM appendix B approximation).
+
+    With frozen parameters (LoRA, models/lora.py) the dW backward pass
+    only runs for the trainable subset: forward 2N + activation-gradient
+    chain 2N + weight gradients 2n → ``4N + 2n``, which degrades to the
+    classic 6N when everything trains. Keeping the FLOP model honest here
+    keeps the reported MFU honest (a frozen-base step does less math, so
+    equal throughput must not claim equal utilization).
+    """
+    n_t = n_params if n_trainable_params is None else n_trainable_params
+    return 4.0 * n_params + 2.0 * n_t + 12.0 * n_layers * seq_len * d_model
 
 
 def mfu(
@@ -51,11 +65,16 @@ def mfu(
     seq_len: int,
     d_model: int,
     peak_flops: float | None = None,
+    n_trainable_params: int | None = None,
 ) -> float:
     """Model FLOPs utilization of one chip at the given throughput."""
     peak = peak_flops if peak_flops is not None else peak_flops_per_chip()
     flops_per_token = transformer_flops_per_token(
-        n_params=n_params, n_layers=n_layers, seq_len=seq_len, d_model=d_model
+        n_params=n_params,
+        n_layers=n_layers,
+        seq_len=seq_len,
+        d_model=d_model,
+        n_trainable_params=n_trainable_params,
     )
     return tokens_per_sec_per_chip * flops_per_token / peak
 

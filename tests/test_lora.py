@@ -1,0 +1,381 @@
+"""LoRA fine-tuning (models/lora.py): the family-agnostic adapter wrap.
+
+Beyond-reference capability — the reference trains full-rank only. The
+invariants that matter:
+
+* wrapping changes NOTHING at init: the base subtree is bit-identical to
+  a non-LoRA init of the same seed, and the zero-initialized B factor
+  makes the merged forward equal the base forward exactly;
+* the base is frozen end-to-end: gradients to base leaves are structural
+  zeros and a real training run leaves every base leaf bit-identical
+  while the loss still decreases through the factors;
+* the optimizer state holds moments ONLY for the factors (the memory
+  win), and still checkpoints/resumes exactly;
+* the merged weights flow to inference (``inference_params``) and the
+  whole thing composes with the sharded train step on a multi-device
+  mesh (frozen base sharded by its logical axes, factors replicated).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.config.schemas import RunConfig
+from llmtrain_tpu.models.lora import (
+    DEFAULT_TARGETS,
+    LoraAdapter,
+    LoraSpec,
+    build_adapter,
+
+    lora_mask,
+    merge_lora,
+)
+from llmtrain_tpu.registry import initialize_registries
+
+initialize_registries()
+
+
+def _cfg(family="gpt", lora=None, trainer_over=None, mesh=None, **model_over):
+    extra = {"tokenizer": "byte"}
+    if lora is not None:
+        extra["lora"] = lora
+    model = {
+        "name": family,
+        "block_size": 16,
+        "d_model": 32,
+        "n_layers": 2,
+        "n_heads": 2,
+        "d_ff": 64,
+        "vocab_size": 64,
+        "dropout": 0.0,
+        "extra": extra,
+        **model_over,
+    }
+    raw = {
+        "run": {"name": "lora-test", "device": "cpu", "seed": 11},
+        "model": model,
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "micro_batch_size": 2,
+            "grad_accum_steps": 1,
+            "max_steps": 30,
+            "warmup_steps": 0,
+            "lr": 1e-2,
+            "log_every_steps": 10,
+            "eval_every_steps": 1000,
+            "save_every_steps": 1000,
+            **(trainer_over or {}),
+        },
+        "mlflow": {"enabled": False},
+    }
+    if mesh is not None:
+        raw["distributed"] = {"mesh": mesh}
+    return RunConfig.model_validate(raw)
+
+
+def _batch(cfg):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.model.vocab_size, (2, cfg.model.block_size))
+    return {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(np.roll(ids, -1, axis=1), jnp.int32),
+    }
+
+
+class TestSpec:
+    def test_absent_means_off(self):
+        assert LoraSpec.from_extra({}) is None
+        assert not isinstance(build_adapter(_cfg()), LoraAdapter)
+
+    def test_defaults(self):
+        spec = LoraSpec.from_extra({"lora": {}})
+        assert spec.rank == 8
+        assert spec.alpha == 16.0
+        assert spec.targets == DEFAULT_TARGETS
+        assert spec.scale == 2.0
+
+    @pytest.mark.parametrize(
+        "raw, match",
+        [
+            ({"rank": 0}, "rank"),
+            ({"alpha": 0}, "alpha"),
+            ({"targets": []}, "targets"),
+            # a bare YAML string must not explode into characters
+            ({"targets": "qkv_proj"}, "targets"),
+            ({"rnk": 4}, "unknown keys"),
+            ("r8", "mapping"),
+        ],
+    )
+    def test_invalid_specs_raise(self, raw, match):
+        with pytest.raises(ValueError, match=match):
+            LoraSpec.from_extra({"lora": raw})
+
+    def test_unmatched_targets_list_modules(self):
+        cfg = _cfg(lora={"targets": ["nonexistent_proj"]})
+        adapter = build_adapter(cfg)
+        model = adapter.build_model(cfg)
+        with pytest.raises(ValueError, match="mlp_fc"):
+            adapter.init_params(model, cfg, jax.random.key(0))
+
+
+class TestInit:
+    def test_base_subtree_matches_unwrapped_init(self):
+        cfg0, cfgL = _cfg(), _cfg(lora={"rank": 4})
+        rng = jax.random.key(3)
+        p0 = build_adapter(cfg0).init_params(
+            build_adapter(cfg0).build_model(cfg0), cfg0, rng
+        )
+        adapter = build_adapter(cfgL)
+        pL = adapter.init_params(adapter.build_model(cfgL), cfgL, rng)
+        for a, b in zip(
+            jax.tree.leaves(nn_meta.unbox(p0)),
+            jax.tree.leaves(nn_meta.unbox(pL["base"])),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_zero_delta_at_init(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        params = adapter.init_params(model, cfgL, jax.random.key(3))
+        merged = merge_lora(params["base"], params["lora"], adapter.spec)
+        for a, b in zip(
+            jax.tree.leaves(nn_meta.unbox(params["base"])),
+            jax.tree.leaves(nn_meta.unbox(merged)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_factor_shapes_default_targets(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        params = adapter.init_params(
+            adapter.build_model(cfgL), cfgL, jax.random.key(0)
+        )
+        lora = params["lora"]
+        # qkv_proj kernel (32, 3, 2, 16): in=32, out=96
+        assert lora["block_0"]["attn"]["qkv_proj"]["kernel"]["a"].shape == (32, 4)
+        assert lora["block_0"]["attn"]["qkv_proj"]["kernel"]["b"].shape == (4, 96)
+        # out_proj kernel (2, 16, 32): in=(2,16)=32, out=32
+        assert lora["block_0"]["attn"]["out_proj"]["kernel"]["a"].shape == (32, 4)
+        assert lora["block_0"]["attn"]["out_proj"]["kernel"]["b"].shape == (4, 32)
+
+    def test_mlp_and_embedding_targets(self):
+        cfgL = _cfg(lora={"targets": ["mlp_fc", "token_embedding"]})
+        adapter = build_adapter(cfgL)
+        params = adapter.init_params(
+            adapter.build_model(cfgL), cfgL, jax.random.key(0)
+        )
+        lora = params["lora"]
+        assert lora["block_0"]["mlp_fc"]["kernel"]["a"].shape == (32, 8)
+        assert lora["token_embedding"]["embedding"]["a"].shape == (64, 8)
+
+    def test_eval_shape_compatible(self):
+        """_abstract_params (checkpoint restore) eval_shapes init_params."""
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        abstract = jax.eval_shape(
+            lambda rng: adapter.init_params(model, cfgL, rng), jax.random.key(0)
+        )
+        assert "base" in abstract and "lora" in abstract
+
+
+class TestFrozenBase:
+    def test_base_gradients_are_zero(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        params = adapter.init_params(model, cfgL, jax.random.key(3))
+        batch = _batch(cfgL)
+
+        def loss(p):
+            value, _ = adapter.compute_loss(model, p, batch)
+            return value
+
+        grads = jax.grad(loss)(params)
+        base_total = sum(
+            float(jnp.abs(g).sum())
+            for g in jax.tree.leaves(nn_meta.unbox(grads["base"]))
+        )
+        lora_total = sum(
+            float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads["lora"])
+        )
+        assert base_total == 0.0
+        assert lora_total > 0.0
+
+    def test_training_moves_loss_not_base(self):
+        """The strongest invariant in one run: loss decreases through the
+        factors while every base leaf stays bit-identical."""
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        cfgL = _cfg(lora={"rank": 4})
+        trainer = Trainer(cfgL, run_dir=None, tracker=NullTracker())
+        before = jax.device_get(nn_meta.unbox(trainer.state.params)["base"])
+        result = trainer.fit()
+        after = jax.device_get(nn_meta.unbox(trainer.state.params)["base"])
+        assert result.final_loss < result.first_step_loss
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        assert result.trainable_parameter_count < result.parameter_count
+        # factors only: 2 blocks x (qkv a/b + out a/b)
+        assert result.trainable_parameter_count == 2 * (
+            32 * 4 + 4 * 96 + 32 * 4 + 4 * 32
+        )
+
+    def test_optimizer_state_holds_no_base_moments(self):
+        from llmtrain_tpu.training.optimizer import build_optimizer
+
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        params = adapter.init_params(model, cfgL, jax.random.key(0))
+        tx = adapter.wrap_optimizer(build_optimizer(cfgL.trainer))
+        opt_state = tx.init(params)
+        n_lora = sum(x.size for x in jax.tree.leaves(params["lora"]))
+        moment_leaves = [
+            x for x in jax.tree.leaves(nn_meta.unbox(opt_state)) if x.ndim >= 1
+        ]
+        # AdamW mu+nu over the factor subtree only.
+        assert sum(x.size for x in moment_leaves) == 2 * n_lora
+
+
+class TestLifecycle:
+    def test_checkpoint_resume_parity(self, tmp_path):
+        """Split run (save at 15, resume to 30) == continuous 30-step run."""
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        cfg30 = _cfg(lora={"rank": 4}, trainer_over={"save_every_steps": 15})
+        continuous = Trainer(cfg30, run_dir=None, tracker=NullTracker()).fit()
+
+        run_dir = tmp_path / "run"
+        run_dir.mkdir()
+        Trainer(cfg30, run_dir=run_dir, tracker=NullTracker()).fit(
+            max_steps_override=15
+        )
+
+        resumed_trainer = Trainer(cfg30, run_dir=None, tracker=NullTracker())
+        resumed = resumed_trainer.fit(resume_from=str(run_dir / "checkpoints"))
+        assert resumed.resumed_from_step == 15
+        assert resumed.final_loss == pytest.approx(
+            continuous.final_loss, abs=1e-5
+        )
+
+    def test_inference_params_merge(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        params = adapter.init_params(model, cfgL, jax.random.key(3))
+        # Give B a nonzero value so the merge is not trivially the base.
+        params["lora"]["block_0"]["attn"]["qkv_proj"]["kernel"]["b"] = (
+            jnp.ones_like(
+                params["lora"]["block_0"]["attn"]["qkv_proj"]["kernel"]["b"]
+            )
+        )
+        merged = adapter.inference_params(params)
+        a = params["lora"]["block_0"]["attn"]["qkv_proj"]["kernel"]["a"]
+        b = params["lora"]["block_0"]["attn"]["qkv_proj"]["kernel"]["b"]
+        want = nn_meta.unbox(params["base"])["block_0"]["attn"]["qkv_proj"][
+            "kernel"
+        ] + ((a @ b) * adapter.spec.scale).reshape(32, 3, 2, 16)
+        got = nn_meta.unbox(merged)["block_0"]["attn"]["qkv_proj"]["kernel"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+        # and the merged forward differs from the base forward now
+        batch = _batch(cfgL)
+        base_adapter = build_adapter(_cfg())
+        l_base, _ = base_adapter.compute_loss(model, params["base"], batch)
+        l_merged, _ = base_adapter.compute_loss(model, merged, batch)
+        assert float(l_base) != float(l_merged)
+
+    def test_plain_checkpoint_with_lora_config_fails_loudly(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        base_params = build_adapter(_cfg()).init_params(
+            model, _cfg(), jax.random.key(0)
+        )
+        with pytest.raises(ValueError, match="base/lora"):
+            adapter.compute_loss(model, base_params, _batch(cfgL))
+
+    def test_llama_family_wraps(self):
+        cfgL = _cfg(family="llama", lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        model = adapter.build_model(cfgL)
+        params = adapter.init_params(model, cfgL, jax.random.key(0))
+        assert "qkv_proj" in str(jax.tree_util.tree_structure(params["lora"]))
+        loss, _ = adapter.compute_loss(model, params, _batch(cfgL))
+        assert np.isfinite(float(loss))
+
+    def test_pipeline_family_rejected(self):
+        cfg = _cfg(family="gpt_pipeline", lora={"rank": 4})
+        with pytest.raises(ValueError, match="pipeline"):
+            build_adapter(cfg)
+
+
+class TestSharded:
+    def test_train_step_on_fsdp_tensor_mesh(self):
+        """Frozen base shards by its logical axes; factors replicate; the
+        sharded step runs and the loss is finite (8 virtual CPU devices,
+        tests/conftest.py)."""
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        mesh_cfg = _cfg(
+            lora={"rank": 4},
+            trainer_over={"max_steps": 3},
+            mesh={"data": 2, "fsdp": 2, "tensor": 2},
+        )
+        trainer = Trainer(mesh_cfg, run_dir=None, tracker=NullTracker())
+        result = trainer.fit()
+        assert np.isfinite(result.final_loss)
+        assert result.trainable_parameter_count == 1536
+
+
+class TestMask:
+    def test_mask_aligns_with_unboxed_leaves(self):
+        cfgL = _cfg(lora={"rank": 4})
+        adapter = build_adapter(cfgL)
+        params = adapter.init_params(
+            adapter.build_model(cfgL), cfgL, jax.random.key(0)
+        )
+        mask = lora_mask(params)
+        unboxed = nn_meta.unbox(params)
+        assert len(jax.tree.leaves(mask)) == len(jax.tree.leaves(unboxed))
+        flags = jax.tree.leaves(mask)
+        assert any(flags) and not all(flags)
+
+
+def test_cli_validate_rejects_bad_spec(tmp_path):
+    import subprocess
+    import sys
+
+    cfg_file = tmp_path / "bad.yaml"
+    cfg_file.write_text(
+        """
+run: {name: x, device: cpu}
+model:
+  name: gpt
+  block_size: 16
+  d_model: 32
+  n_layers: 1
+  n_heads: 2
+  d_ff: 64
+  vocab_size: 64
+  extra: {tokenizer: byte, lora: {rank: 0}}
+data: {name: dummy_text}
+trainer: {max_steps: 10, warmup_steps: 0}
+mlflow: {enabled: false}
+"""
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", "validate", "--config", str(cfg_file)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 2
+    assert "rank" in proc.stderr
