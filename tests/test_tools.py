@@ -93,6 +93,17 @@ def test_bench_speculative_smoke():
     assert all("error" not in r for r in rows)
 
 
+def test_bench_lora_smoke():
+    proc = _run(["tools/bench_lora.py", "--cpu-smoke", "--steps", "2"])
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(x) for x in proc.stdout.splitlines()]
+    cells = {r["cell"]: r for r in lines if "cell" in r}
+    assert cells["full"]["trainable_params"] == cells["full"]["params"]
+    assert cells["lora_r8"]["trainable_params"] < cells["lora_r8"]["params"]
+    summary = lines[-1]
+    assert summary["predicted_speedup"] > 1.0
+
+
 def test_interleave_attribution_smoke():
     proc = _run(
         ["tools/bench_interleave.py", "--no-trainer", "--attribute",

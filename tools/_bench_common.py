@@ -31,14 +31,20 @@ def build_train_cell(cfg: Any) -> tuple[Any, Any, int]:
     cell harness measures any registered family (gpt, llama, ...)."""
     from flax.linen import meta as nn_meta
 
-    from llmtrain_tpu.registry import get_model_adapter, initialize_registries
+    from llmtrain_tpu.models.lora import build_adapter
+    from llmtrain_tpu.registry import initialize_registries
     from llmtrain_tpu.training.optimizer import build_optimizer
     from llmtrain_tpu.training.train_step import create_train_state, make_train_step
 
     initialize_registries()
-    adapter = get_model_adapter(cfg.model.name)()
+    # build_adapter: same factory the Trainer uses, so lora configs (and
+    # any future adapter wrap) measure through the identical step.
+    adapter = build_adapter(cfg)
     model = adapter.build_model(cfg)
     tx = build_optimizer(cfg.trainer)
+    wrap_tx = getattr(adapter, "wrap_optimizer", None)
+    if wrap_tx is not None:
+        tx = wrap_tx(tx)
     params = nn_meta.unbox(adapter.init_params(model, cfg, jax.random.key(0)))
     n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
     state = create_train_state(params, tx)

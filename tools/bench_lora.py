@@ -1,0 +1,143 @@
+"""LoRA vs full fine-tune train-step A/B at matched shape.
+
+Measures the claim behind models/lora.py's frozen-aware FLOP model
+(utils/hw.py): freezing the base skips its dW backward, so a LoRA step
+should run ~(6N + 12LTd)/(4N + 2n + 12LTd) faster than full fine-tuning
+at the same shape. Emits one JSON line per cell plus a summary with the
+measured vs predicted speedup.
+
+Usage (repo root):
+
+    python tools/bench_lora.py                    # chip shape
+    JAX_PLATFORMS=cpu python tools/bench_lora.py --cpu-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _cell(name: str, *, lora: dict | None, cpu_smoke: bool, steps: int,
+          batch: int) -> dict:
+    from _bench_common import build_train_cell, make_batch, measure_cell
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.utils.hw import transformer_flops_per_token
+
+    if cpu_smoke:
+        dims = dict(d_model=64, n_layers=2, n_heads=4, d_ff=256,
+                    vocab_size=512)
+        seq = 128
+    else:  # GPT-2-small, the headline shape
+        dims = dict(d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+                    vocab_size=50257)
+        seq = 512
+    extra = {"tokenizer": "byte", "assume_packed": True}
+    if lora is not None:
+        extra["lora"] = lora
+    cfg = RunConfig.model_validate(
+        {
+            "run": {"name": f"lora-ab-{name}",
+                    "device": "cpu" if cpu_smoke else "tpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": seq,
+                "dropout": 0.0,
+                "dtype": "float32" if cpu_smoke else "bfloat16",
+                "attention": "dense" if cpu_smoke else "flash",
+                "extra": extra,
+                **dims,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "micro_batch_size": batch,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+            },
+            "mlflow": {"enabled": False},
+        }
+    )
+    step_fn, state, n_params = build_train_cell(cfg)
+    n_trainable = (
+        sum(int(x.size) for x in jax.tree.leaves(state.params["lora"]))
+        if lora is not None
+        else n_params
+    )
+    m = measure_cell(step_fn, state, make_batch(batch, seq, dims["vocab_size"]),
+                     steps)
+    toks = batch * seq / m["step_time_s"]
+    return {
+        "cell": name,
+        "backend": jax.default_backend(),
+        "params": n_params,
+        "trainable_params": n_trainable,
+        "batch": batch,
+        "seq": seq,
+        "step_time_ms": round(m["step_time_s"] * 1e3, 2),
+        "tokens_per_sec": round(toks, 1),
+        "compile_s": round(m["compile_s"], 1),
+        "loss": m["loss"],
+        "flops_per_token": transformer_flops_per_token(
+            n_params=n_params,
+            n_layers=dims["n_layers"],
+            seq_len=seq,
+            d_model=dims["d_model"],
+            n_trainable_params=n_trainable,
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=0, help="0 = auto per mode")
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--cpu-smoke", action="store_true")
+    args = ap.parse_args()
+    batch = args.batch or (4 if args.cpu_smoke else 64)
+    steps = min(args.steps, 3) if args.cpu_smoke else args.steps
+
+    rows = {}
+    for name, lora in (
+        ("full", None),
+        (f"lora_r{args.rank}", {"rank": args.rank, "alpha": 2 * args.rank}),
+    ):
+        try:
+            row = _cell(name, lora=lora, cpu_smoke=args.cpu_smoke,
+                        steps=steps, batch=batch)
+        except Exception as exc:  # noqa: BLE001 — per-cell isolation
+            row = {"cell": name, "error": str(exc)[:500]}
+        rows[name] = row
+        print(json.dumps(row), flush=True)
+
+    full = rows.get("full", {})
+    lora_row = rows.get(f"lora_r{args.rank}", {})
+    if "step_time_ms" in full and "step_time_ms" in lora_row:
+        print(
+            json.dumps(
+                {
+                    "speedup_lora_vs_full": round(
+                        full["step_time_ms"] / lora_row["step_time_ms"], 3
+                    ),
+                    "predicted_speedup": round(
+                        full["flops_per_token"] / lora_row["flops_per_token"],
+                        3,
+                    ),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
