@@ -155,6 +155,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="include the model's log-probability of every emitted token "
         "in the JSON output (not supported with --draft-config)",
     )
+    gen.add_argument(
+        "--ema",
+        action="store_true",
+        help="decode with the EMA shadow weights tracked by "
+        "trainer.extra.ema_decay (errors if the checkpoint has none)",
+    )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
     evalp = sub.add_parser(
@@ -167,6 +173,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="checkpoint file, checkpoint dir, or run id to evaluate "
         "(default: the freshly initialized model)",
+    )
+    evalp.add_argument(
+        "--ema",
+        action="store_true",
+        help="evaluate the EMA shadow weights tracked by "
+        "trainer.extra.ema_decay (errors if the checkpoint has none)",
     )
     evalp.add_argument("--json", action="store_true", help="emit metrics as JSON")
     evalp.add_argument("-v", "--verbose", action="store_true", help="DEBUG logging")
@@ -205,6 +217,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="checkpoint file, checkpoint dir, or run id to export",
     )
     export.add_argument("--output", required=True, help="output .pt path")
+    export.add_argument(
+        "--ema",
+        action="store_true",
+        help="export the EMA shadow weights tracked by "
+        "trainer.extra.ema_decay (errors if the checkpoint has none)",
+    )
     export.add_argument("--json", action="store_true", help="emit stats as JSON")
 
     imp = sub.add_parser(
@@ -342,21 +360,50 @@ def _abstract_params(cfg, adapter, model):
     )
 
 
-def _load_checkpoint_params(cfg, adapter, model, from_spec: str):
+def _load_checkpoint_params(cfg, adapter, model, from_spec: str, *, ema: bool = False):
     """Shared inference-checkpoint load (generate / export-checkpoint):
     resolve the spec, restore params against the abstract shape tree, warn
-    on config mismatch. Returns ``(ckpt_path, params, step)``."""
+    on config mismatch. Returns ``(ckpt_path, params, step)``.
+
+    ``ema=True`` substitutes the trainable tree with the checkpoint's EMA
+    shadow (trainer.extra.ema_decay) in the SAME payload read — for LoRA
+    runs the shadow mirrors the factor subtree, the frozen base loads as
+    stored."""
     import yaml
 
     from .training.checkpoint import load_inference_params, resolve_resume_path
 
     ckpt_path = resolve_resume_path(from_spec, cfg.output.root_dir)
     abstract = _abstract_params(cfg, adapter, model)
-    params, step = load_inference_params(
-        ckpt_path,
-        abstract,
-        expected_config_yaml=yaml.safe_dump(cfg.model_dump(), sort_keys=False),
+    expected_yaml = yaml.safe_dump(cfg.model_dump(), sort_keys=False)
+    if not ema:
+        params, step = load_inference_params(
+            ckpt_path, abstract, expected_config_yaml=expected_yaml
+        )
+        return ckpt_path, params, step
+
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    from .models.lora import LoraAdapter
+    from .training.checkpoint import (
+        CheckpointManager,
+        ema_from_payload,
+        warn_on_config_mismatch,
     )
+
+    payload = CheckpointManager.load(ckpt_path)
+    warn_on_config_mismatch(payload, expected_yaml, ckpt_path)
+    step = int(payload["step"])
+    if isinstance(adapter, LoraAdapter):
+        host = serialization.from_state_dict(abstract, payload["params"])
+        params = {
+            "base": jax.tree.map(jnp.asarray, host["base"]),
+            "lora": ema_from_payload(payload, abstract["lora"]),
+        }
+    else:
+        params = ema_from_payload(payload, abstract)
     return ckpt_path, params, step
 
 
@@ -556,7 +603,7 @@ def _handle_export_checkpoint(args: argparse.Namespace) -> int:
         adapter = build_adapter(cfg)
         model = adapter.build_model(cfg)
         ckpt_path, params, step = _load_checkpoint_params(
-            cfg, adapter, model, args.from_spec
+            cfg, adapter, model, args.from_spec, ema=args.ema
         )
         # LoRA runs export their MERGED weights: the file stays the
         # family's lingua-franca full-rank state dict (models/lora.py).
@@ -859,7 +906,7 @@ def _handle_eval(args: argparse.Namespace) -> int:
 
         initialize_registries()
         trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
-        metrics = trainer.evaluate(resume_from=args.from_spec)
+        metrics = trainer.evaluate(resume_from=args.from_spec, use_ema=args.ema)
         if metrics is None:
             _emit_error("data module has no validation split to evaluate")
             return EXIT_TRAIN_FAILURE
@@ -1052,9 +1099,11 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 return EXIT_CONFIG_ERROR
 
         ckpt_path, params, step = _load_checkpoint_params(
-            cfg, adapter, model, args.from_spec
+            cfg, adapter, model, args.from_spec, ema=args.ema
         )
         logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
+        if args.ema:
+            logger.info("decoding with EMA shadow weights")
         # LoRA checkpoints decode on the merged weights (models/lora.py).
         params = to_inference_params(adapter, params)
         model, params = _prepare_decode_model(

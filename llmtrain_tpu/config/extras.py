@@ -16,7 +16,13 @@ from ..config.schemas import RunConfig
 # Knobs read from trainer.extra (training/trainer.py, training/checkpoint.py,
 # training/optimizer.py).
 TRAINER_EXTRA_KEYS = frozenset(
-    {"keep_last_k", "profile_start_step", "profile_num_steps", "optimizer"}
+    {
+        "keep_last_k",
+        "profile_start_step",
+        "profile_num_steps",
+        "optimizer",
+        "ema_decay",
+    }
 )
 
 

@@ -241,6 +241,47 @@ def load_inference_params(
     return params, int(payload["step"])
 
 
+def ema_from_payload(payload: dict[str, Any], abstract_target: Any) -> Any:
+    """Dig the EMA shadow out of an already-loaded checkpoint payload and
+    map it onto ``abstract_target`` (the params tree the shadow mirrors —
+    the full model tree, or the factor subtree for LoRA runs). The
+    shadow is stored in float32 (training/optimizer.py); extraction
+    casts back to each target leaf's dtype. Raises ``ValueError`` when
+    the payload holds no EMA state."""
+    import jax.numpy as jnp
+
+    from .optimizer import find_ema_tree
+
+    raw = find_ema_tree(payload["opt_state"])
+    if raw is None:
+        raise ValueError(
+            "checkpoint holds no EMA state — train with "
+            "trainer.extra.ema_decay to track shadow weights"
+        )
+    # from_state_dict maps values onto the target STRUCTURE (dtypes come
+    # from the stored f32 arrays); cast each leaf back to the dtype the
+    # consumer's tree expects.
+    host = serialization.from_state_dict(abstract_target, raw)
+    return jax.tree.map(
+        lambda t, v: jnp.asarray(v, t.dtype), abstract_target, host
+    )
+
+
+def load_ema_params(
+    path: str | Path,
+    abstract_target: Any,
+    *,
+    expected_config_yaml: str | None = None,
+) -> tuple[Any, int]:
+    """Path-based wrapper over :func:`ema_from_payload` — restore the
+    Polyak shadow tracked by ``trainer.extra.ema_decay`` from a
+    checkpoint file."""
+    payload = CheckpointManager.load(path)
+    if expected_config_yaml is not None:
+        warn_on_config_mismatch(payload, expected_config_yaml, path)
+    return ema_from_payload(payload, abstract_target), int(payload["step"])
+
+
 def warn_on_config_mismatch(
     payload: dict[str, Any], current_config_yaml: str, path: str | Path
 ) -> None:

@@ -336,7 +336,9 @@ class Trainer:
             self._dataset_specs[id(dataset)] = cached
         return cached
 
-    def evaluate(self, resume_from: str | None = None) -> dict[str, float] | None:
+    def evaluate(
+        self, resume_from: str | None = None, *, use_ema: bool = False
+    ) -> dict[str, float] | None:
         """Eval-only pass: restore ``resume_from`` (if given) and run the
         full validation loop once, without training.
 
@@ -346,10 +348,40 @@ class Trainer:
         tracker, as in the train loop), or None when the data module has
         no validation split. The step reported in logs is the restored
         checkpoint's step (0 for a fresh init).
+
+        ``use_ema=True`` evaluates the Polyak shadow tracked by
+        ``trainer.extra.ema_decay`` — it already sits in the (restored)
+        optimizer state, so this swaps the trainable tree in place, no
+        extra checkpoint IO. For LoRA runs the shadow replaces the
+        factors; the frozen base stays.
         """
         step = 0
         if resume_from is not None:
             step = self._restore(resume_from)
+        if use_ema:
+            from .optimizer import find_ema_tree
+
+            shadow = find_ema_tree(self._state.opt_state)
+            if shadow is None:
+                raise ValueError(
+                    "no EMA state in the optimizer — train with "
+                    "trainer.extra.ema_decay to track shadow weights"
+                )
+            shadow = nn_meta.unbox(shadow)
+            params = nn_meta.unbox(self._state.params)
+            is_lora = isinstance(params, dict) and "lora" in params
+            target = params["lora"] if is_lora else params
+            # Shadow accumulates in f32 (optimizer.py); cast back to the
+            # param dtypes the eval forward expects.
+            cast = jax.tree.map(
+                lambda p, e: jnp.asarray(e, p.dtype), target, shadow
+            )
+            new_params = {**params, "lora": cast} if is_lora else cast
+            self._state = TrainState(
+                step=self._state.step,
+                params=new_params,
+                opt_state=self._state.opt_state,
+            )
         with self._mesh, nn.logical_axis_rules(self._rules):
             return self._evaluate(step, step)
 
