@@ -42,6 +42,8 @@ _BANKED = {
                     + json.dumps({"family": "llama", "mfu": 0.41}) + "\n"),
     "speculative.json": json.dumps({"cell": "speculative_fresh_draft",
                                     "ms_per_token": 1.9}) + "\n",
+    "lora_ab.json": json.dumps({"speedup_lora_vs_full": 1.4,
+                                "predicted_speedup": 1.3}) + "\n",
     "diag_decode.json": json.dumps({"backend": "tpu", "batch": 32,
                                     "n_kv_heads": 4}) + "\n",
     "bpe_headline.json": json.dumps({"final_val_loss": 3.21}) + "\n",

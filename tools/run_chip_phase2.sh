@@ -39,6 +39,7 @@ banked_family()   { grep '"family": "gpt"' "$OUT/family.json" 2>/dev/null | grep
                     && grep '"family": "llama"' "$OUT/family.json" 2>/dev/null | grep -q '"mfu"'; }
 banked_spec()     { grep '"cell": "speculative_fresh_draft"' "$OUT/speculative.json" 2>/dev/null \
                     | grep -q '"ms_per_token"'; }
+banked_lora_ab()  { grep -q "speedup_lora_vs_full" "$OUT/lora_ab.json" 2>/dev/null; }
 banked_decode()   { grep -q '"batch": 32, "n_kv_heads": 4' "$OUT/diag_decode.json" 2>/dev/null; }
 banked_bpe()      { grep -q "final_val_loss" "$OUT/bpe_headline.json" 2>/dev/null; }
 banked_longctx()  { grep -q "\"seq\": $1, \"batch\": 1, \"attention\": \"flash\", \"window\": 0, \"backend\": \"tpu\"" \
@@ -69,6 +70,7 @@ open_steps() {
     banked_c128    || [ "$(attempts c128)"    -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
     banked_family  || [ "$(attempts family)"  -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
     banked_spec    || [ "$(attempts spec)"    -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
+    banked_lora_ab || [ "$(attempts lora_ab)" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
     banked_decode  || [ "$(attempts decode)"  -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
     if [ -f runs/pytok8k.json ]; then
         banked_bpe || [ "$(attempts bpe)" -ge "$MAX_ATTEMPTS" ] || n=$((n + 1))
@@ -155,6 +157,16 @@ if should_run spec banked_spec; then
         || log "speculative cells failed/partial"
     tail -2 "$OUT/speculative.json" || true
     gate "post-7b"
+fi
+
+if should_run lora_ab banked_lora_ab; then
+    log "7c/8 LoRA vs full fine-tune A/B (frozen-backward DCE on chip)..."
+    mark_attempt lora_ab
+    timeout 1200 python tools/bench_lora.py \
+        >"$OUT/lora_ab.json" 2>"$OUT/lora_ab.log" \
+        || log "lora A/B failed/partial"
+    tail -1 "$OUT/lora_ab.json" || true
+    gate "post-7c"
 fi
 
 if should_run decode banked_decode; then
