@@ -328,35 +328,35 @@ def _child_main() -> None:
         steps = 3
 
     # Tuning knobs (used by perf sweeps; defaults above are the contract).
-    # Ignored in the watchdog's last-resort CPU child: sweep values are
-    # tuned for the chip and would blow the CPU timeout.
-    loss_impl = "dense"
-    explicit = False
+    # Explicit knobs are honored in EVERY child, including the watchdog's
+    # CPU fallback — a user pinning BATCH/CE on a CPU-only host must get
+    # the shape they asked for (the driver's scoreboard run sets none,
+    # so the fallback defaults stay the contract there). The auto-sweep
+    # stays off under explicit knobs and in fallback children.
     fallback_child = os.environ.get("LLMTRAIN_BENCH_FALLBACK") == "1"
-    if not fallback_child:
-        # Any explicit geometry/CE knob disables the auto-sweep: its
-        # "chunked frees the batch cap" heuristic only holds at the
-        # default shape.
-        explicit = any(
-            os.environ.get(k)
-            for k in (
-                "LLMTRAIN_BENCH_BATCH",
-                "LLMTRAIN_BENCH_CE",
-                "LLMTRAIN_BENCH_SEQ",
-                "LLMTRAIN_BENCH_STEPS",
-            )
+    # Any explicit geometry/CE knob disables the auto-sweep: its
+    # "chunked frees the batch cap" heuristic only holds at the
+    # default shape.
+    explicit = any(
+        os.environ.get(k)
+        for k in (
+            "LLMTRAIN_BENCH_BATCH",
+            "LLMTRAIN_BENCH_CE",
+            "LLMTRAIN_BENCH_SEQ",
+            "LLMTRAIN_BENCH_STEPS",
         )
-        batch = int(os.environ.get("LLMTRAIN_BENCH_BATCH", batch))
-        seq = int(os.environ.get("LLMTRAIN_BENCH_SEQ", seq))
-        steps = int(os.environ.get("LLMTRAIN_BENCH_STEPS", steps))
-        # "chunked" streams the CE over vocab chunks (ops/chunked_ce.py):
-        # no [B,T,V] in HBM, enabling larger batches on the chip.
-        loss_impl = os.environ.get("LLMTRAIN_BENCH_CE", "dense")
-        loss_impl = {"chunked": "chunked_ce"}.get(loss_impl, loss_impl)
-        if loss_impl not in ("dense", "chunked_ce"):
-            raise SystemExit(
-                f"LLMTRAIN_BENCH_CE={loss_impl!r} invalid: use 'dense' or 'chunked'"
-            )
+    )
+    batch = int(os.environ.get("LLMTRAIN_BENCH_BATCH", batch))
+    seq = int(os.environ.get("LLMTRAIN_BENCH_SEQ", seq))
+    steps = int(os.environ.get("LLMTRAIN_BENCH_STEPS", steps))
+    # "chunked" streams the CE over vocab chunks (ops/chunked_ce.py):
+    # no [B,T,V] in HBM, enabling larger batches on the chip.
+    loss_impl = os.environ.get("LLMTRAIN_BENCH_CE", "dense")
+    loss_impl = {"chunked": "chunked_ce"}.get(loss_impl, loss_impl)
+    if loss_impl not in ("dense", "chunked_ce"):
+        raise SystemExit(
+            f"LLMTRAIN_BENCH_CE={loss_impl!r} invalid: use 'dense' or 'chunked'"
+        )
 
     run = lambda a, bb, li: _run(  # noqa: E731
         on_tpu, depth, d_model, n_heads, d_ff, vocab, seq, bb, steps, a, li
@@ -369,11 +369,15 @@ def _child_main() -> None:
     # main measurement. 0 new entries with a warm dir = every program HIT.
     cache_after = _cache_entry_count()
     if cache_after >= 0:
+        # A missing cache dir counts as 0 entries (-1 is the "no dir yet"
+        # sentinel); otherwise a lazily-created dir reports one phantom
+        # compile in the delta.
+        before = max(cache_before, 0)
         verdict = (
-            "all HIT" if 0 <= cache_before == cache_after else f"+{cache_after - cache_before} compiled"
+            "all HIT" if before == cache_after else f"+{cache_after - before} compiled"
         )
         print(
-            f"[bench] compile cache: {max(cache_before, 0)} -> {cache_after} entries ({verdict}); "
+            f"[bench] compile cache: {before} -> {cache_after} entries ({verdict}); "
             f"first measurement {first_cost:.0f}s",
             file=sys.stderr,
             flush=True,

@@ -432,7 +432,6 @@ def _handle_average_checkpoints(args: argparse.Namespace) -> int:
         import jax
         import numpy as np
 
-        from .registry import get_model_adapter
         from .training.checkpoint import (
             CheckpointManager,
             load_inference_params,

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..config.schemas import RunConfig
 from ..data.sampler import DeterministicSampler
-from ..registry import get_data_module, get_model_adapter
+from ..registry import get_data_module
 from ..training.train_step import make_eval_step
 from ..utils.logging import get_logger
 
@@ -33,7 +33,12 @@ class DryRunResult:
 
 def run_dry_run(cfg: RunConfig) -> DryRunResult:
     """Run a few forward-only batches on the default device (no mesh)."""
-    adapter = get_model_adapter(cfg.model.name)()
+    from ..models.lora import build_adapter
+
+    # The same adapter factory the Trainer uses, so the dry run validates
+    # the SAME program train will build (a bad LoRA targets list must
+    # fail here, not five minutes into the real run).
+    adapter = build_adapter(cfg)
     data_module = get_data_module(cfg.data.name)()
 
     tokenizer = None

@@ -183,7 +183,7 @@ class Trainer:
                 sum(
                     int(np.prod(x.shape))
                     for x, keep in zip(
-                        jax.tree.leaves(params), jax.tree.leaves(mask)
+                        jax.tree.leaves(params), jax.tree.leaves(mask), strict=True
                     )
                     if keep
                 )
@@ -358,6 +358,7 @@ class Trainer:
         step = 0
         if resume_from is not None:
             step = self._restore(resume_from)
+        params_override = None
         if use_ema:
             from .optimizer import find_ema_tree
 
@@ -372,18 +373,15 @@ class Trainer:
             is_lora = isinstance(params, dict) and "lora" in params
             target = params["lora"] if is_lora else params
             # Shadow accumulates in f32 (optimizer.py); cast back to the
-            # param dtypes the eval forward expects.
+            # param dtypes the eval forward expects. Passed as an
+            # override — self._state stays untouched, so a later fit()
+            # or raw evaluate() on this Trainer sees the real weights.
             cast = jax.tree.map(
                 lambda p, e: jnp.asarray(e, p.dtype), target, shadow
             )
-            new_params = {**params, "lora": cast} if is_lora else cast
-            self._state = TrainState(
-                step=self._state.step,
-                params=new_params,
-                opt_state=self._state.opt_state,
-            )
+            params_override = {**params, "lora": cast} if is_lora else cast
         with self._mesh, nn.logical_axis_rules(self._rules):
-            return self._evaluate(step, step)
+            return self._evaluate(step, step, params_override)
 
     # ------------------------------------------------------------------ fit
 
@@ -645,7 +643,9 @@ class Trainer:
 
     # ------------------------------------------------------------------ eval
 
-    def _evaluate(self, step: int, max_steps: int) -> dict[str, float] | None:
+    def _evaluate(
+        self, step: int, max_steps: int, params_override: Any | None = None
+    ) -> dict[str, float] | None:
         val_ds = self._data_module.val_dataset()
         if val_ds is None:
             return None
@@ -670,7 +670,11 @@ class Trainer:
         # whole eval pass, at the device_get below (VERDICT r1 weak #6).
         from concurrent.futures import ThreadPoolExecutor
 
-        params = nn_meta.unbox(self._state.params)
+        params = (
+            params_override
+            if params_override is not None
+            else nn_meta.unbox(self._state.params)
+        )
 
         def build(b: int) -> dict:
             real = np.arange(b * eval_bs, min((b + 1) * eval_bs, n))

@@ -188,6 +188,24 @@ class TestEvalEma:
         # Hot LR + decay 0.9 over 20 steps: the shadow lags, losses differ.
         assert raw["val/loss"] != ema["val/loss"]
 
+    def test_evaluate_use_ema_does_not_mutate_trainer(self, tmp_path):
+        """use_ema passes an override — a later raw evaluate on the SAME
+        trainer must still see the real weights."""
+        cfg = _cfg()
+        run_dir = tmp_path / "run"
+        run_dir.mkdir()
+        Trainer(cfg, run_dir=run_dir, tracker=NullTracker()).fit()
+        trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+        ema = trainer.evaluate(
+            resume_from=str(run_dir / "checkpoints"), use_ema=True
+        )
+        raw_after = trainer.evaluate()
+        fresh_raw = Trainer(cfg, run_dir=None, tracker=NullTracker()).evaluate(
+            resume_from=str(run_dir / "checkpoints")
+        )
+        assert raw_after["val/loss"] == fresh_raw["val/loss"]
+        assert raw_after["val/loss"] != ema["val/loss"]
+
     def test_evaluate_use_ema_without_state_raises(self):
         cfg = _cfg(extra={"ema_decay": None})
         trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())

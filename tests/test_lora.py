@@ -311,6 +311,17 @@ class TestLifecycle:
         loss, _ = adapter.compute_loss(model, params, _batch(cfgL))
         assert np.isfinite(float(loss))
 
+    def test_dry_run_validates_the_lora_program(self):
+        """--dry-run must build the SAME adapter train will: a bad
+        targets list fails at the dry run, not mid-real-run; a good
+        LoRA config dry-runs the merged forward."""
+        from llmtrain_tpu.training.dry_run import run_dry_run
+
+        with pytest.raises(ValueError, match="matched no parameters"):
+            run_dry_run(_cfg(lora={"targets": ["qkv_porj"]}))
+        result = run_dry_run(_cfg(lora={"rank": 4}))
+        assert result.steps_executed >= 1
+
     def test_pipeline_family_rejected(self):
         cfg = _cfg(family="gpt_pipeline", lora={"rank": 4})
         with pytest.raises(ValueError, match="pipeline"):
