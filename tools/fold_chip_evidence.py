@@ -108,6 +108,10 @@ def main() -> None:
         "speculative.json", "Speculative bounds",
         ["cell", "ms_per_token", "speedup_vs_plain", "mean_accepted"],
     )
+    handle(
+        "lora_ab.json", "LoRA vs full fine-tune A/B",
+        ["cell", "trainable_params", "tokens_per_sec", "step_time_ms"],
+    )
     handle("bpe_headline.json", "BPE headline train")
 
     compiled = out / "tpu_compiled.log"
