@@ -26,6 +26,7 @@ _PLUGIN_MODULES = (
     "llmtrain_tpu.data.dummy_text",
     "llmtrain_tpu.data.hf_text",
     "llmtrain_tpu.data.local_text",
+    "llmtrain_tpu.data.mixed_text",
 )
 
 

@@ -1,0 +1,226 @@
+"""Weighted corpus mixture (data/mixed_text.py).
+
+The properties that make mixing safe in this framework:
+
+* the epoch is a pure function of (run.seed, sources) — identical on
+  every process and across resume, like data/sampler.py;
+* weights steer the source histogram; a small source with a large
+  weight repeats (wraps) rather than starving;
+* validation is the plain concatenation of the sources' val splits;
+* misconfiguration (no sources, bad weight, unknown keys, disagreeing
+  split_documents) fails loudly at setup.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config.schemas import RunConfig
+from llmtrain_tpu.data.mixed_text import (
+    ConcatDataset,
+    MixedTextDataModule,
+    WeightedMixDataset,
+)
+from llmtrain_tpu.registry import get_data_module, initialize_registries
+
+initialize_registries()
+
+
+class _Toy:
+    """IndexedDataset stub emitting its own id so reads are traceable."""
+
+    def __init__(self, ident: int, n: int, width: int = 4) -> None:
+        self._ident = ident
+        self._n = n
+        self._width = width
+
+    def __len__(self) -> int:
+        return self._n
+
+    def get_examples(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        indices = np.asarray(indices)
+        ids = np.full((len(indices), self._width), self._ident, np.int32)
+        # encode the local index so wraparound is observable
+        ids[:, 0] = indices.astype(np.int32)
+        return {"input_ids": ids, "labels": ids.copy()}
+
+
+class TestWeightedMix:
+    def test_deterministic_across_instances(self):
+        a = WeightedMixDataset([_Toy(0, 50), _Toy(1, 50)], [1.0, 1.0], seed=9)
+        b = WeightedMixDataset([_Toy(0, 50), _Toy(1, 50)], [1.0, 1.0], seed=9)
+        idx = np.arange(len(a))
+        np.testing.assert_array_equal(
+            a.get_examples(idx)["input_ids"], b.get_examples(idx)["input_ids"]
+        )
+
+    def test_weights_are_exact_by_construction(self):
+        mix = WeightedMixDataset(
+            [_Toy(0, 500), _Toy(1, 500)], [3.0, 1.0], seed=0
+        )
+        hist = mix.source_histogram()
+        # epoch = ceil(500 / 0.25) = 2000; exact shares 1500/500
+        assert len(mix) == 2000
+        np.testing.assert_array_equal(hist, [1500, 500])
+
+    def test_under_weighted_source_is_fully_covered(self):
+        """The whole point of the epoch formula: an under-weighted
+        source's TAIL must still be reachable — every one of its local
+        indices appears in the epoch."""
+        mix = WeightedMixDataset(
+            [_Toy(0, 40), _Toy(1, 40)], [3.0, 1.0], seed=2
+        )
+        rows = mix.get_examples(np.arange(len(mix)))["input_ids"]
+        light = rows[rows[:, 1] == 1]
+        assert set(np.unique(light[:, 0])) == set(range(40))
+
+    def test_pathological_weights_fail_loudly(self):
+        with pytest.raises(ValueError, match="rebalance"):
+            WeightedMixDataset(
+                [_Toy(0, 1 << 22), _Toy(1, 4)], [1e-9, 1.0], seed=0
+            )
+
+    def test_small_heavy_source_wraps(self):
+        small, big = _Toy(7, 5), _Toy(8, 200)
+        mix = WeightedMixDataset([small, big], [5.0, 1.0], seed=1)
+        rows = mix.get_examples(np.arange(len(mix)))["input_ids"]
+        small_rows = rows[rows[:, 1] == 7]
+        # far more draws from the small source than it has examples —
+        # local indices must wrap into [0, 5)
+        assert len(small_rows) > 50
+        assert set(np.unique(small_rows[:, 0])) == {0, 1, 2, 3, 4}
+
+    def test_rows_land_in_request_order(self):
+        mix = WeightedMixDataset([_Toy(0, 30), _Toy(1, 30)], [1.0, 1.0], seed=3)
+        idx = np.asarray([5, 0, 17, 2])
+        got = mix.get_examples(idx)["input_ids"][:, 1]
+        want = np.asarray(
+            [mix.get_examples(np.asarray([i]))["input_ids"][0, 1] for i in idx]
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+class TestConcat:
+    def test_spans_boundaries(self):
+        cat = ConcatDataset([_Toy(0, 3), _Toy(1, 4)])
+        assert len(cat) == 7
+        rows = cat.get_examples(np.asarray([0, 2, 3, 6]))["input_ids"]
+        np.testing.assert_array_equal(rows[:, 1], [0, 0, 1, 1])
+        np.testing.assert_array_equal(rows[:, 0], [0, 2, 0, 3])
+
+
+def _cfg(tmp_path, sources):
+    (tmp_path / "a").mkdir(exist_ok=True)
+    (tmp_path / "b").mkdir(exist_ok=True)
+    (tmp_path / "a" / "x.txt").write_text("alpha " * 800)
+    (tmp_path / "b" / "y.txt").write_text("beta " * 800)
+    return RunConfig.model_validate(
+        {
+            "run": {"name": "mix", "device": "cpu", "seed": 4},
+            "model": {
+                "name": "gpt",
+                "block_size": 16,
+                "d_model": 32,
+                "n_layers": 1,
+                "n_heads": 2,
+                "d_ff": 64,
+                "vocab_size": 260,
+                "extra": {"tokenizer": "byte"},
+            },
+            "data": {
+                "name": "mixed_text",
+                "cache_dir": str(tmp_path / "cache"),
+                "extra": {"sources": sources},
+            },
+            "trainer": {"max_steps": 10, "warmup_steps": 0, "micro_batch_size": 2},
+            "mlflow": {"enabled": False},
+        }
+    )
+
+
+class _ByteTok:
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode())
+
+
+class TestModule:
+    def test_end_to_end_mixture(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            [
+                {"globs": [str(tmp_path / "a" / "*.txt")], "weight": 3.0},
+                {"globs": [str(tmp_path / "b" / "*.txt")], "weight": 1.0},
+            ],
+        )
+        module = get_data_module("mixed_text")()
+        assert isinstance(module, MixedTextDataModule)
+        module.setup(cfg, _ByteTok())
+        train = module.train_dataset()
+        assert len(train) > 0
+        batch = train.get_examples(np.arange(min(8, len(train))))
+        assert batch["input_ids"].shape[1] == 16
+        hist = train.source_histogram()
+        assert hist[0] > hist[1]  # weight 3 vs 1
+        val = module.val_dataset()
+        assert val is not None and len(val) > 0
+
+    def test_same_seed_same_epoch(self, tmp_path):
+        sources = [
+            {"globs": [str(tmp_path / "a" / "*.txt")]},
+            {"globs": [str(tmp_path / "b" / "*.txt")]},
+        ]
+        cfg = _cfg(tmp_path, sources)
+        m1, m2 = MixedTextDataModule(), MixedTextDataModule()
+        m1.setup(cfg, _ByteTok())
+        m2.setup(cfg, _ByteTok())
+        idx = np.arange(len(m1.train_dataset()))
+        np.testing.assert_array_equal(
+            m1.train_dataset().get_examples(idx)["input_ids"],
+            m2.train_dataset().get_examples(idx)["input_ids"],
+        )
+
+    @pytest.mark.parametrize(
+        "sources, match",
+        [
+            ([], "non-empty list"),
+            ([{"globs": ["x"], "weight": 0}], "weight"),
+            ([{"globs": ["x"], "wieght": 2}], "unknown keys"),
+            (["just-a-string"], "mapping"),
+        ],
+    )
+    def test_bad_sources_fail_loudly(self, tmp_path, sources, match):
+        cfg = _cfg(tmp_path, sources)
+        module = MixedTextDataModule()
+        with pytest.raises(ValueError, match=match):
+            module.setup(cfg, _ByteTok())
+
+    def test_disagreeing_split_documents_rejected(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            [
+                {
+                    "globs": [str(tmp_path / "a" / "*.txt")],
+                    "split_documents": True,
+                },
+                {"globs": [str(tmp_path / "b" / "*.txt")]},
+            ],
+        )
+        module = MixedTextDataModule()
+        with pytest.raises(ValueError, match="split_documents"):
+            module.setup(cfg, _ByteTok())
+
+    def test_trains_via_trainer(self, tmp_path):
+        from llmtrain_tpu.tracking.base import NullTracker
+        from llmtrain_tpu.training.trainer import Trainer
+
+        cfg = _cfg(
+            tmp_path,
+            [
+                {"globs": [str(tmp_path / "a" / "*.txt")], "weight": 2.0},
+                {"globs": [str(tmp_path / "b" / "*.txt")]},
+            ],
+        )
+        result = Trainer(cfg, run_dir=None, tracker=NullTracker()).fit()
+        assert np.isfinite(result.final_loss)
+        assert result.final_step == 10
