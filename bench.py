@@ -207,8 +207,10 @@ def _watchdog_main() -> None:
             if attempt(env, timeout_sec):
                 return
         if not no_fallback:
-            # The last-resort CPU child must ignore TPU-sweep knobs (a
-            # batch tuned for the chip would blow the CPU timeout).
+            # The CPU child honors explicit BATCH/CE/SEQ knobs (a
+            # CPU-only user pinning them must get that shape); the
+            # driver's scoreboard run pins none, so there the fallback
+            # runs the CPU-sized default geometry within cpu_timeout.
             if attempt({"JAX_PLATFORMS": "cpu", "LLMTRAIN_BENCH_FALLBACK": "1"}, cpu_timeout):
                 return
         give_up()

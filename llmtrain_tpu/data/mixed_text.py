@@ -76,8 +76,7 @@ class WeightedMixDataset:
                 "the under-weighted corpus"
             )
         # Exact per-source slot counts: floor shares, largest-remainder
-        # rounding, then a full-coverage floor (share >= size holds by
-        # the epoch-length formula; rounding must not dip below it).
+        # rounding, then a full-coverage floor.
         shares = np.floor(p * total).astype(np.int64)
         remainder = p * total - shares
         for _ in range(total - int(shares.sum())):

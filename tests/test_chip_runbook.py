@@ -34,10 +34,14 @@ REPO = Path(__file__).resolve().parents[1]
 _BANKED = {
     "tpu_compiled.log": "===== 22 passed in 188.13s (0:03:08) =====\n",
     "mask_ab.json": json.dumps({"mask_overhead_pct+mha": 6.01}) + "\n",
+    # the failed-attempts error line also carries "vs_baseline" (0.0),
+    # so the bench predicates key on the success-only backend detail
     "bench_sweep.json": json.dumps({"metric": "tokens_per_sec_per_chip",
-                                    "vs_baseline": 1.5}) + "\n",
+                                    "vs_baseline": 1.5,
+                                    "detail": {"backend": "tpu"}}) + "\n",
     "bench_c128.json": json.dumps({"metric": "tokens_per_sec_per_chip",
-                                   "vs_baseline": 1.4}) + "\n",
+                                   "vs_baseline": 1.4,
+                                   "detail": {"backend": "tpu"}}) + "\n",
     "family.json": (json.dumps({"family": "gpt", "mfu": 0.45}) + "\n"
                     + json.dumps({"family": "llama", "mfu": 0.41}) + "\n"),
     "speculative.json": json.dumps({"cell": "speculative_fresh_draft",
@@ -112,6 +116,19 @@ def test_failed_suite_log_is_not_banked(tmp_path):
     _write_banked(tmp_path, except_for={"tpu_compiled.log"})
     (tmp_path / "tpu_compiled.log").write_text(
         "==== 2 failed, 20 passed in 201.0s ====\n")
+    proc = _run(tmp_path, fake_dead_probe=True)
+    assert proc.returncode == 1
+    assert "tunnel dead before step start" in proc.stderr
+
+
+def test_failed_bench_error_line_is_not_banked(tmp_path):
+    """bench.py's all-attempts-failed line carries "vs_baseline": 0.0 —
+    it must keep the step open (r5 window 1 banked exactly this)."""
+    _write_banked(tmp_path, except_for={"bench_c128.json"})
+    (tmp_path / "bench_c128.json").write_text(json.dumps(
+        {"metric": "tokens_per_sec_per_chip", "value": 0.0,
+         "vs_baseline": 0.0,
+         "detail": {"error": "all bench attempts failed"}}) + "\n")
     proc = _run(tmp_path, fake_dead_probe=True)
     assert proc.returncode == 1
     assert "tunnel dead before step start" in proc.stderr
