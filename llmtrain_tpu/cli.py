@@ -161,6 +161,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="decode with the EMA shadow weights tracked by "
         "trainer.extra.ema_decay (errors if the checkpoint has none)",
     )
+    gen.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="weight-only quantization applied after checkpoint load "
+        "(ops/quant.py): int8 halves the weight bytes each decoded token "
+        "streams vs bf16 (decode is weight-bandwidth bound); applies to "
+        "the draft model too under speculative decoding",
+    )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
     evalp = sub.add_parser(
@@ -179,6 +188,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="evaluate the EMA shadow weights tracked by "
         "trainer.extra.ema_decay (errors if the checkpoint has none)",
+    )
+    evalp.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="evaluate under weight-only int8 quantization (ops/quant.py) "
+        "— measures the quality cost of the quantized serving path on "
+        "the real validation split (composes with --ema)",
     )
     evalp.add_argument("--json", action="store_true", help="emit metrics as JSON")
     evalp.add_argument("-v", "--verbose", action="store_true", help="DEBUG logging")
@@ -905,7 +922,11 @@ def _handle_eval(args: argparse.Namespace) -> int:
 
         initialize_registries()
         trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
-        metrics = trainer.evaluate(resume_from=args.from_spec, use_ema=args.ema)
+        metrics = trainer.evaluate(
+            resume_from=args.from_spec,
+            use_ema=args.ema,
+            quantize=args.quantize if args.quantize != "none" else None,
+        )
         if metrics is None:
             _emit_error("data module has no validation split to evaluate")
             return EXIT_TRAIN_FAILURE
@@ -1108,6 +1129,18 @@ def _handle_generate(args: argparse.Namespace) -> int:
         model, params = _prepare_decode_model(
             model, params, args.decode_param_dtype, logger
         )
+        if args.quantize == "int8":
+            from .ops.quant import quant_stats, quantize_tree
+
+            params = quantize_tree(params)
+            stats = quant_stats(params)
+            logger.info(
+                "int8 weight quantization: %d/%d params quantized, "
+                "%.2fx weight-byte compression",
+                stats["quantized_params"],
+                stats["total_params"],
+                stats["compression"],
+            )
 
         # --- speculative decoding: load the draft model, then decode each
         # prompt via draft-and-verify (speculative.py). Exact w.r.t. the
@@ -1146,6 +1179,11 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 draft_model, draft_params, args.decode_param_dtype, logger,
                 label="draft ",
             )
+            if args.quantize == "int8":
+                from .ops.quant import quantize_tree
+
+                draft_params = quantize_tree(draft_params)
+                logger.info("draft weights quantized to int8")
             if draft_model.vocab_size != model.vocab_size:
                 _emit_error(
                     f"draft vocab_size ({draft_model.vocab_size}) != target "

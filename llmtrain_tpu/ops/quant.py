@@ -1,0 +1,207 @@
+"""Weight-only int8 quantization for inference.
+
+Beyond-reference capability (the reference has no quantization path;
+its serving story is the f32 notebook forward,
+reference notebooks/trained_vs_random_completion.ipynb). TPU-first
+rationale: single-stream decode is weight-bandwidth bound
+(tools/diag_decode.py attribution), so halving the bytes each weight
+read moves is worth ~1% logit error — and TPU v5e reads int8 natively.
+
+Design: a :class:`QuantizedArray` pytree container holding the int8
+codes plus per-channel f32 scales. It implements ``__jax_array__``, so
+anywhere a weight flows into a jnp/flax op it dequantizes *inside the
+traced graph* — XLA keeps the int8 buffer in HBM and fuses the
+``convert+multiply`` into the consuming matmul's operand read. No model
+changes, no custom modules: ``model.apply(quantize_tree(params), x)``
+just works, eager or jit, for every registered family.
+
+Scales are symmetric per-channel:
+
+* ``embedding`` tables — one scale per row (the lookup/logit channel);
+* everything else (Dense/DenseGeneral kernels, stacked MoE expert
+  kernels) — max over the largest leading axis. In every kernel layout
+  we ship that axis is the contraction/input dimension (e.g. ``d_model``
+  in a ``(d, 3, heads, hd)`` fused qkv kernel), so the scales group by
+  output unit; and because dequant is an exact broadcast multiply, any
+  grouping is *correct* — the choice only affects quality and the
+  scale-tensor overhead, both of which this rule keeps small.
+
+Symmetric (no zero-point) keeps dequant a single fused multiply and
+keeps 0.0 exact, which LayerNorm/RMSNorm-heavy stacks care about.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+Params = Any  # PyTree of arrays
+
+_INT8_MAX = 127.0
+
+
+@tree_util.register_pytree_node_class
+class QuantizedArray:
+    """int8 codes + broadcastable f32 scales, posing as the original array.
+
+    Registered as a pytree *container*: under ``jit``/``tree.map`` it
+    flattens into its two array children, so jitted programs carry the
+    int8 buffer (not a dequantized copy) across the host→device boundary
+    and through donation. ``__jax_array__`` makes every consuming jnp op
+    dequantize in-graph to ``dtype`` (the weight's original dtype).
+    """
+
+    def __init__(self, q: jax.Array, scale: jax.Array, dtype: Any):
+        self.q = q
+        self.scale = scale
+        self._dtype = jnp.dtype(dtype)
+
+    # --- array protocol -------------------------------------------------
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def size(self) -> int:
+        return self.q.size
+
+    @property
+    def nbytes(self) -> int:
+        """Actual storage cost: int8 codes + scale floats."""
+        return int(self.q.size * 1 + self.scale.size * self.scale.dtype.itemsize)
+
+    def dequantize(self) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.scale).astype(self._dtype)
+
+    def __jax_array__(self) -> jax.Array:
+        return self.dequantize()
+
+    def astype(self, dtype) -> "QuantizedArray":
+        """Retarget the *dequantized* dtype; codes and scales are shared."""
+        return QuantizedArray(self.q, self.scale, dtype)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"QuantizedArray(shape={self.shape}, dtype={self._dtype.name}, "
+            f"scale_shape={self.scale.shape})"
+        )
+
+    # --- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.scale), self._dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        return cls(children[0], children[1], dtype)
+
+
+def quantize_array(w: jax.Array, *, reduce_axes: tuple[int, ...]) -> QuantizedArray:
+    """Symmetric per-channel int8: ``scale = amax/127`` over ``reduce_axes``."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes, keepdims=True)
+    # All-zero channels (e.g. a fresh LoRA B factor) get scale 1.0: the
+    # codes are all 0 and dequantize exactly to 0.0 either way, without
+    # a 0/0 NaN in the division below.
+    scale = jnp.where(amax == 0.0, 1.0, amax / _INT8_MAX)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -_INT8_MAX, _INT8_MAX)
+    return QuantizedArray(q.astype(jnp.int8), scale, w.dtype)
+
+
+def _is_embedding_path(path) -> bool:
+    for k in path:
+        name = getattr(k, "key", None) or getattr(k, "name", None)
+        if name is not None and "embedding" in str(name):
+            return True
+    return False
+
+
+def quantize_tree(params: Params, *, min_size: int = 4096) -> Params:
+    """Quantize every weight matrix in a param tree to int8.
+
+    A leaf is quantized iff it is floating, at least 2-D, and has
+    ``size >= min_size`` — norms, biases and tiny projections stay in
+    their original dtype (they are a rounding error of the byte budget
+    and the quality-sensitive part of the stack). Embedding tables get
+    per-row scales; all other kernels per-output-unit scales (max over
+    every axis but the last).
+
+    The result is a same-structure tree whose big leaves are
+    :class:`QuantizedArray` containers — directly consumable by
+    ``model.apply``, ``generation.generate``, ``speculative_generate``
+    and the Trainer's eval ``params_override``.
+    """
+
+    def _leaf(path, a):
+        if isinstance(a, QuantizedArray):
+            raise ValueError("quantize_tree: tree is already quantized")
+        if not hasattr(a, "ndim") or a.ndim < 2:
+            return a
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            return a
+        if a.size < min_size:
+            return a
+        if _is_embedding_path(path):
+            reduce_axes: tuple[int, ...] = (a.ndim - 1,)
+        else:
+            leading = a.shape[:-1]
+            reduce_axes = (leading.index(max(leading)),)
+        return quantize_array(a, reduce_axes=reduce_axes)
+
+    return tree_util.tree_map_with_path(
+        _leaf, params, is_leaf=lambda x: isinstance(x, QuantizedArray)
+    )
+
+
+def dequantize_tree(params: Params) -> Params:
+    """Materialize a quantized tree back to plain arrays (testing/export)."""
+    return jax.tree.map(
+        lambda a: a.dequantize() if isinstance(a, QuantizedArray) else a,
+        params,
+        is_leaf=lambda x: isinstance(x, QuantizedArray),
+    )
+
+
+def quant_stats(params: Params) -> dict[str, int | float]:
+    """Byte accounting for a (possibly) quantized tree.
+
+    ``bytes_dense`` is what the same tree would occupy with every
+    quantized leaf restored to its original dtype — the compression
+    ratio decode cares about, since weight bytes streamed per token is
+    the single-stream bottleneck.
+    """
+    leaves = jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedArray)
+    )
+    n_q = sum(1 for a in leaves if isinstance(a, QuantizedArray))
+    bytes_actual = 0
+    bytes_dense = 0
+    params_q = 0
+    params_total = 0
+    for a in leaves:
+        params_total += int(a.size)
+        if isinstance(a, QuantizedArray):
+            params_q += int(a.size)
+            bytes_actual += a.nbytes
+            bytes_dense += int(a.size * a.dtype.itemsize)
+        else:
+            nbytes = int(a.size * a.dtype.itemsize)
+            bytes_actual += nbytes
+            bytes_dense += nbytes
+    return {
+        "quantized_leaves": n_q,
+        "quantized_params": params_q,
+        "total_params": params_total,
+        "bytes": bytes_actual,
+        "bytes_dense": bytes_dense,
+        "compression": (bytes_dense / bytes_actual) if bytes_actual else 1.0,
+    }

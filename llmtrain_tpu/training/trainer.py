@@ -337,7 +337,11 @@ class Trainer:
         return cached
 
     def evaluate(
-        self, resume_from: str | None = None, *, use_ema: bool = False
+        self,
+        resume_from: str | None = None,
+        *,
+        use_ema: bool = False,
+        quantize: str | None = None,
     ) -> dict[str, float] | None:
         """Eval-only pass: restore ``resume_from`` (if given) and run the
         full validation loop once, without training.
@@ -354,7 +358,15 @@ class Trainer:
         optimizer state, so this swaps the trainable tree in place, no
         extra checkpoint IO. For LoRA runs the shadow replaces the
         factors; the frozen base stays.
+
+        ``quantize="int8"`` evaluates under weight-only int8
+        (ops/quant.py) — the exact serving-path weights, so the reported
+        ``val/loss`` IS the quality cost of quantized decode. Composes
+        with ``use_ema`` (the shadow is quantized). Like the EMA path it
+        is an override: ``self._state`` keeps the full-precision weights.
         """
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantize mode: {quantize!r}")
         step = 0
         if resume_from is not None:
             step = self._restore(resume_from)
@@ -380,6 +392,31 @@ class Trainer:
                 lambda p, e: jnp.asarray(e, p.dtype), target, shadow
             )
             params_override = {**params, "lora": cast} if is_lora else cast
+        if quantize == "int8":
+            from ..ops.quant import quantize_tree
+
+            base = (
+                params_override
+                if params_override is not None
+                else nn_meta.unbox(self._state.params)
+            )
+            if isinstance(base, dict) and "base" in base and "lora" in base:
+                # Serving quantizes the MERGED weights (generate
+                # --quantize merges first, models/lora.py). Mirror that
+                # exactly: quantize(W + sBA) as the base, factors zeroed
+                # so the training model's in-step merge adds nothing —
+                # quantize(W) + sBA would measure a different model.
+                from ..models.lora import to_inference_params
+
+                merged = nn_meta.unbox(
+                    to_inference_params(self._adapter, base)
+                )
+                params_override = {
+                    "base": quantize_tree(merged),
+                    "lora": jax.tree.map(jnp.zeros_like, base["lora"]),
+                }
+            else:
+                params_override = quantize_tree(base)
         with self._mesh, nn.logical_axis_rules(self._rules):
             return self._evaluate(step, step, params_override)
 

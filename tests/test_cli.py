@@ -285,6 +285,23 @@ class TestGenerate:
         # dummy adapter has no tokenizer -> no decoded text
         assert out["text"] is None
 
+    def test_generate_quantized_int8(self, workdir):
+        """--quantize int8 decodes on QuantizedArray weights end to end
+        (ops/quant.py): same output contract, valid token range."""
+        first = _run(["train", "--config", "config.yaml", "--json",
+                      "--run-id", "runQ"], workdir)
+        assert first.returncode == 0, first.stderr
+        proc = _run(
+            ["generate", "--config", "config.yaml", "--from", "runQ",
+             "--prompt-ids", "1,2,3", "--max-new-tokens", "4",
+             "--temperature", "0", "--quantize", "int8", "--json"],
+            workdir,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert len(out["completion_ids"]) == 4
+        assert all(0 <= t < CFG["model"]["vocab_size"] for t in out["output_ids"])
+
     def test_generate_greedy_is_deterministic(self, workdir):
         first = _run(["train", "--config", "config.yaml", "--json", "--run-id", "runH"], workdir)
         assert first.returncode == 0, first.stderr

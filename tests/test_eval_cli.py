@@ -108,6 +108,36 @@ class TestEvalCLI:
         payload = json.loads(ev.stdout)
         assert abs(payload["metrics"]["val/loss"] - trained_val) < 1e-6
 
+    def test_eval_quantized_close_to_full(self, tmp_path):
+        """--quantize int8 reports the serving-path quality: close to the
+        full-precision loss, but not the identical number (the weights
+        really are int8). The model must clear quantize_tree's min_size
+        gate — the default eval test model is below it everywhere."""
+        import yaml
+
+        cfg = _cfg(tmp_path)
+        big = cfg.model_dump(mode="json")
+        big["model"].update({"d_model": 64, "d_ff": 128})
+        cfg_path = str(tmp_path / "cfg_q.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(big, f, sort_keys=False)
+        train = self._run(
+            "train", "--config", cfg_path, "--run-id", "qrun", "--json"
+        )
+        assert train.returncode == 0, train.stderr
+
+        full = self._run("eval", "--config", cfg_path, "--from", "qrun", "--json")
+        assert full.returncode == 0, full.stderr
+        quant = self._run(
+            "eval", "--config", cfg_path, "--from", "qrun",
+            "--quantize", "int8", "--json",
+        )
+        assert quant.returncode == 0, quant.stderr
+        full_loss = json.loads(full.stdout)["metrics"]["val/loss"]
+        quant_loss = json.loads(quant.stdout)["metrics"]["val/loss"]
+        assert abs(quant_loss - full_loss) / full_loss < 0.05
+        assert quant_loss != full_loss
+
     def test_eval_without_checkpoint(self, tmp_path):
         cfg_path = self._write_cfg(tmp_path)
         ev = self._run("eval", "--config", cfg_path, "--json")
