@@ -95,13 +95,28 @@ def llama_params_to_hf_state_dict(params: Params) -> dict[str, np.ndarray]:
             # separately, so split the (D, 3, H, dh) kernel.
             kern = _np(att["qkv_proj"]["kernel"])
             q, k, v = kern[:, 0], kern[:, 1], kern[:, 2]
+            biases = (
+                tuple(_np(att["qkv_proj"]["bias"])[j] for j in range(3))
+                if "bias" in att["qkv_proj"]
+                else None
+            )
         else:
             q = _np(att["q_proj"]["kernel"])
             kv = _np(att["kv_proj"]["kernel"])
             k, v = kv[:, 0], kv[:, 1]
+            if "bias" in att["q_proj"]:
+                kvb = _np(att["kv_proj"]["bias"])
+                biases = (_np(att["q_proj"]["bias"]), kvb[0], kvb[1])
+            else:
+                biases = None
         sd[pre + "self_attn.q_proj.weight"] = q.reshape(d, -1).T
         sd[pre + "self_attn.k_proj.weight"] = k.reshape(d, -1).T
         sd[pre + "self_attn.v_proj.weight"] = v.reshape(d, -1).T
+        if biases is not None:
+            # Qwen2 convention (models/qwen2.py): 1-D torch biases,
+            # head-major flatten matching the kernel rows.
+            for name, b in zip(("q", "k", "v"), biases):
+                sd[pre + f"self_attn.{name}_proj.bias"] = b.reshape(-1)
         sd[pre + "self_attn.o_proj.weight"] = (
             _np(att["out_proj"]["kernel"]).reshape(-1, d).T
         )
@@ -192,6 +207,19 @@ def llama_params_from_hf_state_dict(sd: dict[str, Any], template: Params) -> Par
                 axis=1,
             )
             attn = {"qkv_proj": {"kernel": jnp.asarray(qkv, dtype=like.dtype)}}
+            if "bias" in att_t["qkv_proj"]:
+                # Qwen2 tree: (3, H, dh) fused bias from the 1-D torch ones.
+                bl = att_t["qkv_proj"]["bias"]
+                attn["qkv_proj"]["bias"] = jnp.asarray(
+                    np.stack(
+                        [
+                            take_proj(pre + f"self_attn.{n}_proj.bias", (h, hd))
+                            for n in ("q", "k", "v")
+                        ],
+                        axis=0,
+                    ),
+                    dtype=bl.dtype,
+                )
         else:
             h, hd = np.shape(att_t["q_proj"]["kernel"])[1:3]
             like = att_t["kv_proj"]["kernel"]
@@ -213,6 +241,21 @@ def llama_params_from_hf_state_dict(sd: dict[str, Any], template: Params) -> Par
                 },
                 "kv_proj": {"kernel": jnp.asarray(kv, dtype=like.dtype)},
             }
+            if "bias" in att_t["q_proj"]:
+                attn["q_proj"]["bias"] = jnp.asarray(
+                    take_proj(pre + "self_attn.q_proj.bias", (h, hd)),
+                    dtype=att_t["q_proj"]["bias"].dtype,
+                )
+                attn["kv_proj"]["bias"] = jnp.asarray(
+                    np.stack(
+                        [
+                            take_proj(pre + f"self_attn.{n}_proj.bias", (hkv, hd))
+                            for n in ("k", "v")
+                        ],
+                        axis=0,
+                    ),
+                    dtype=att_t["kv_proj"]["bias"].dtype,
+                )
         attn["out_proj"] = {
             "kernel": put(
                 pre + "self_attn.o_proj.weight",

@@ -87,6 +87,10 @@ class CausalSelfAttention(nn.Module):
     # keys are written (cached keys are stored rotated; queries at later
     # steps then compare directly). GPT defaults leave both off.
     use_bias: bool = True
+    # Qwen2-style bias split (models/qwen2.py): bias on the q/k/v
+    # projections only, out_proj follows ``use_bias``. None = q/k/v
+    # follow ``use_bias`` too (GPT fully biased, Llama fully bias-free).
+    qkv_bias: bool | None = None
     rope: bool = False
     rope_theta: float = 10000.0
     # Sliding-window attention (Mistral semantics: query i attends keys in
@@ -112,6 +116,7 @@ class CausalSelfAttention(nn.Module):
     ) -> jax.Array:
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
+        qkv_use_bias = self.use_bias if self.qkv_bias is None else self.qkv_bias
         if self.sliding_window and self.attention in ("ring", "ulysses"):
             raise ValueError(
                 f"sliding_window is not supported with attention="
@@ -122,7 +127,7 @@ class CausalSelfAttention(nn.Module):
             qkv = nn.DenseGeneral(
                 features=(3, self.n_heads, head_dim),
                 axis=-1,
-                use_bias=self.use_bias,
+                use_bias=qkv_use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),
@@ -141,7 +146,7 @@ class CausalSelfAttention(nn.Module):
             q = nn.DenseGeneral(
                 features=(self.n_heads, head_dim),
                 axis=-1,
-                use_bias=self.use_bias,
+                use_bias=qkv_use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "heads", "kv")),
@@ -153,7 +158,7 @@ class CausalSelfAttention(nn.Module):
             kv = nn.DenseGeneral(
                 features=(2, kv_heads, head_dim),
                 axis=-1,
-                use_bias=self.use_bias,
+                use_bias=qkv_use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "qkv", "heads", "kv")),

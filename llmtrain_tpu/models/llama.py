@@ -87,6 +87,9 @@ class LlamaBlock(nn.Module):
     assume_packed: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    # Qwen2 convention (models/qwen2.py): bias on q/k/v only; out_proj
+    # and the MLP stay bias-free either way.
+    qkv_bias: bool = False
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
@@ -125,6 +128,7 @@ class LlamaBlock(nn.Module):
             n_kv_heads=self.n_kv_heads,
             assume_packed=self.assume_packed,
             use_bias=False,
+            qkv_bias=self.qkv_bias or None,
             rope=True,
             rope_theta=self.rope_theta,
             sliding_window=self.sliding_window,
@@ -205,6 +209,8 @@ class Llama(nn.Module):
     assume_packed: bool = False
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    # Qwen2 convention: bias on the q/k/v projections only.
+    qkv_bias: bool = False
     # Sliding-window attention (model.extra.sliding_window, the Mistral
     # architecture knob): O(T·W) attention on the flash path.
     sliding_window: int = 0
@@ -292,6 +298,7 @@ class Llama(nn.Module):
                 assume_packed=self.assume_packed,
                 rope_theta=self.rope_theta,
                 rms_norm_eps=self.rms_norm_eps,
+                qkv_bias=self.qkv_bias,
                 sliding_window=self.sliding_window,
                 ring_slack=self.ring_slack if self.decode else 0,
                 n_experts=self.n_experts,

@@ -1,0 +1,44 @@
+"""Qwen2-family adapter: the Llama stack with attention-input biases.
+
+Beyond-reference model family (the reference ships GPT only,
+``src/llmtrain/models/gpt.py``; SURVEY §2.1). Architecturally Qwen2 is
+Llama — RMSNorm, RoPE, SwiGLU, GQA, untied head — with exactly two
+conventions changed:
+
+* **bias on the q/k/v projections** (and only there: o_proj and the
+  MLP stay bias-free) — the ``qkv_bias`` knob threaded through
+  ``models/llama.py`` → ``models/gpt.py::CausalSelfAttention``;
+* **rope_theta defaults to 1e6** (Qwen2's long-context base frequency;
+  ``model.extra.rope_theta`` still wins).
+
+Everything else — attention kernel dispatch, KV-cache decode, chunked
+CE, remat, logical-axis sharding, LoRA/EMA/quantization composition —
+is the shared llama/gpt machinery, so there is still exactly one
+attention implementation in the package. The param tree is the llama
+tree plus ``attn/{qkv,q,kv}_proj/bias`` leaves; HF interop
+(``interop/llama_hf.py``) maps them to ``self_attn.{q,k,v}_proj.bias``,
+which makes the exported dict load into HF ``Qwen2ForCausalLM``
+(same state-dict names as Llama plus those biases). Numerics are
+parity-tested against HF transformers' torch Qwen2 in
+tests/test_qwen2.py.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .llama import LlamaAdapter
+
+
+@register_model("qwen2")
+class Qwen2Adapter(LlamaAdapter):
+    """Adapter for the Qwen2 family (llama + qkv biases + 1e6 rope base)."""
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        base = super().build_model(cfg)  # full llama validation stack
+        updates: dict = {"qkv_bias": True}
+        if "rope_theta" not in cfg.model.extra:
+            updates["rope_theta"] = 1_000_000.0
+        return base.clone(**updates)

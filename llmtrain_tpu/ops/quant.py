@@ -125,6 +125,16 @@ def _is_embedding_path(path) -> bool:
     return False
 
 
+def _is_bias_path(path) -> bool:
+    """Multi-dim bias leaves (Qwen2's (3, H, dh) fused qkv bias) pass the
+    ndim gate but are exactly the quality-sensitive additive params the
+    'biases stay float' contract promises to preserve."""
+    if not path:
+        return False
+    name = getattr(path[-1], "key", None) or getattr(path[-1], "name", None)
+    return name is not None and str(name) == "bias"
+
+
 def quantize_tree(params: Params, *, min_size: int = 4096) -> Params:
     """Quantize every weight matrix in a param tree to int8.
 
@@ -149,6 +159,8 @@ def quantize_tree(params: Params, *, min_size: int = 4096) -> Params:
         if not jnp.issubdtype(a.dtype, jnp.floating):
             return a
         if a.size < min_size:
+            return a
+        if _is_bias_path(path):
             return a
         if _is_embedding_path(path):
             reduce_axes: tuple[int, ...] = (a.ndim - 1,)

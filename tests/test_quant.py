@@ -138,6 +138,40 @@ class TestQuantizeTree:
             )
         )
 
+    def test_qwen2_biases_stay_float(self):
+        """Multi-dim qkv biases (Qwen2) pass the ndim gate but must stay
+        float — they're the family's quality-sensitive additive params."""
+        from llmtrain_tpu.registry.models import get_model_adapter
+
+        cfg = _cfg(
+            model={
+                "name": "qwen2",
+                "block_size": 8,
+                "vocab_size": 64,
+                "dropout": 0.0,
+                "d_model": 64,
+                "n_heads": 4,
+                "d_ff": 128,
+                "n_layers": 1,
+                "tie_embeddings": False,
+            }
+        )
+        adapter = get_model_adapter("qwen2")()
+        model = adapter.build_model(cfg)
+        from flax.core import meta as nn_meta
+
+        params = nn_meta.unbox(
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+                deterministic=True,
+            )["params"]
+        )
+        # min_size=1 forces every gate except the bias skip.
+        qt = quantize_tree(params, min_size=1)
+        att = qt["block_0"]["attn"]
+        assert not isinstance(att["qkv_proj"]["bias"], QuantizedArray)
+        assert isinstance(att["qkv_proj"]["kernel"], QuantizedArray)
+
     def test_double_quantize_raises(self):
         _, params = _tiny_gpt()
         qt = quantize_tree(params, min_size=1024)

@@ -1,0 +1,230 @@
+"""Qwen2-family tests (models/qwen2.py).
+
+Beyond-reference model family (the reference ships GPT only). Qwen2 is
+the llama stack with q/k/v biases and a 1e6 rope base, so these tests
+cover exactly the deltas — bias placement, adapter defaults, HF
+round-trip incl. the bias tensors — plus numerical parity against HF
+transformers' torch Qwen2, the family's ground truth (mirroring
+tests/test_llama.py's HF-parity strategy).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.registry.models import get_model_adapter
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training.trainer import Trainer
+
+V, T, D, H, F = 64, 16, 32, 4, 88
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+def _cfg(_max_steps=25, **model_extra):
+    return RunConfig.model_validate(
+        {
+            "run": {"name": "qwen2-t", "seed": 0, "device": "cpu"},
+            "model": {
+                "name": "qwen2",
+                "block_size": T,
+                "d_model": D,
+                "n_layers": 2,
+                "n_heads": H,
+                "d_ff": F,
+                "dropout": 0.0,
+                "vocab_size": V,
+                "tie_embeddings": False,
+                "extra": model_extra,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": _max_steps,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "lr": 5e-3,
+                "warmup_steps": 0,
+                "log_every_steps": 10,
+                "eval_every_steps": 100,
+                "save_every_steps": 100,
+            },
+            "mlflow": {"enabled": False},
+        }
+    )
+
+
+def _build(**model_extra):
+    cfg = _cfg(**model_extra)
+    adapter = get_model_adapter("qwen2")()
+    model = adapter.build_model(cfg)
+    params = nn_meta.unbox(
+        model.init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32), deterministic=True
+        )["params"]
+    )
+    return cfg, adapter, model, params
+
+
+class TestArchitecture:
+    def test_bias_on_qkv_only(self):
+        _, _, model, params = _build()
+        att = params["block_0"]["attn"]
+        assert "bias" in att["qkv_proj"]
+        assert att["qkv_proj"]["bias"].shape == (3, H, D // H)
+        assert "bias" not in att["out_proj"]
+        assert "bias" not in params["block_0"]["mlp_gate"]
+        assert "bias" not in params["block_0"]["mlp_down"]
+
+    def test_gqa_split_tree_biases(self):
+        _, _, model, params = _build(n_kv_heads=2)
+        att = params["block_0"]["attn"]
+        assert att["q_proj"]["bias"].shape == (H, D // H)
+        assert att["kv_proj"]["bias"].shape == (2, 2, D // H)
+        assert "bias" not in att["out_proj"]
+
+    def test_llama_stays_bias_free(self):
+        """The qkv_bias knob must not leak into the llama family."""
+        from llmtrain_tpu.models.llama import Llama
+
+        m = Llama(
+            vocab_size=V, block_size=T, d_model=D, n_layers=1, n_heads=H,
+            d_ff=F, dropout=0.0,
+        )
+        p = nn_meta.unbox(
+            m.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+        )
+        assert "bias" not in p["block_0"]["attn"]["qkv_proj"]
+
+    def test_rope_theta_defaults_to_1e6(self):
+        _, _, model, _ = _build()
+        assert model.rope_theta == 1_000_000.0
+        _, _, override, _ = _build(rope_theta=5000.0)
+        assert override.rope_theta == 5000.0
+
+    def test_loss_decreases_under_trainer(self):
+        trainer = Trainer(_cfg(), None, NullTracker(), None)
+        res = trainer.fit()
+        assert res.final_loss < res.first_step_loss
+
+
+class TestHFRoundtrip:
+    def test_export_import_identity_with_biases(self):
+        from llmtrain_tpu.interop import (
+            llama_params_from_hf_state_dict,
+            llama_params_to_hf_state_dict,
+        )
+
+        _, _, _, params = _build(n_kv_heads=2)
+        sd = llama_params_to_hf_state_dict(params)
+        for n in ("q", "k", "v"):
+            assert f"model.layers.0.self_attn.{n}_proj.bias" in sd
+        assert "model.layers.0.self_attn.o_proj.bias" not in sd
+        back = llama_params_from_hf_state_dict(sd, params)
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0],
+        ):
+            assert pa == pb
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+    def test_exported_dict_loads_into_hf_qwen2(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        from llmtrain_tpu.interop import llama_params_to_hf_state_dict
+
+        _, _, _, params = _build(n_kv_heads=2)
+        sd = {
+            k: torch.from_numpy(v)
+            for k, v in llama_params_to_hf_state_dict(params).items()
+        }
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=V,
+            hidden_size=D,
+            intermediate_size=F,
+            num_hidden_layers=2,
+            num_attention_heads=H,
+            num_key_value_heads=2,
+            max_position_embeddings=T,
+            rms_norm_eps=1e-6,
+            rope_theta=1_000_000.0,
+            use_sliding_window=False,
+            tie_word_embeddings=False,
+        )
+        hf = transformers.Qwen2ForCausalLM(hf_cfg)
+        hf.load_state_dict(sd, strict=True)
+
+
+class TestHFParity:
+    """Numerics pinned against transformers' torch Qwen2 (fwd logits)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        initialize_registries()
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=V,
+            hidden_size=D,
+            intermediate_size=F,
+            num_hidden_layers=2,
+            num_attention_heads=H,
+            num_key_value_heads=2,
+            max_position_embeddings=T,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            use_sliding_window=False,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+
+        cfg = _cfg(n_kv_heads=2, rope_theta=10000.0)
+        adapter = get_model_adapter("qwen2")()
+        ours = adapter.build_model(cfg)
+        p = nn_meta.unbox(
+            ours.init(
+                jax.random.key(0), jnp.zeros((1, 4), jnp.int32),
+                deterministic=True,
+            )["params"]
+        )
+
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
+
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        new = llama_params_from_hf_state_dict(sd, p)
+        assert jax.tree.map(jnp.shape, p) == jax.tree.map(jnp.shape, new)
+        return hf, ours, new
+
+    def test_logits_match(self, pair):
+        torch = pytest.importorskip("torch")
+        hf, ours, params = pair
+        ids = np.asarray([[1, 5, 9, 2, 40, 3, 0, 63]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.from_numpy(ids).long()).logits.numpy()
+        got = np.asarray(
+            ours.apply({"params": params}, jnp.asarray(ids), deterministic=True)
+        )
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_generate_greedy_runs(self, pair):
+        """KV-cache decode works with biased projections end to end."""
+        from llmtrain_tpu.generation import generate
+
+        _, ours, params = pair
+        out = generate(
+            ours,
+            params,
+            np.array([[1, 2, 3]], np.int32),
+            max_new_tokens=4,
+            temperature=0.0,
+        )
+        assert np.asarray(out).shape == (1, 7)
