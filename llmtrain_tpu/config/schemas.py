@@ -190,7 +190,9 @@ class MLflowConfig(BaseModel):
     Divergence: ``backend`` selects the tracking implementation —
     ``auto`` (default) uses the MLflow client when the extra is
     importable and falls back to the dependency-free native SQLite store
-    (tracking/sqlite.py) otherwise; ``mlflow``/``native`` force one. The
+    (tracking/sqlite.py) otherwise; ``mlflow``/``native`` force one, and
+    ``tensorboard`` writes native TensorBoard event files
+    (tracking/tensorboard.py, ``tracking_uri`` is the logdir). The
     reference always requires the mlflow package when enabled.
     """
 
@@ -199,7 +201,7 @@ class MLflowConfig(BaseModel):
     experiment: str = "llm-train-k8s"
     run_name: str | None = None
     log_models: bool = False
-    backend: Literal["auto", "mlflow", "native"] = "auto"
+    backend: Literal["auto", "mlflow", "native", "tensorboard"] = "auto"
 
     model_config = _STRICT
 

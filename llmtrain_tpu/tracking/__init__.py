@@ -7,6 +7,7 @@ from typing import Any
 from .base import NullTracker, Tracker
 from .mlflow import MLflowTracker
 from .sqlite import SqliteTracker
+from .tensorboard import TensorBoardTracker
 
 
 def _mlflow_available() -> bool:
@@ -56,6 +57,8 @@ def build_tracker(mlflow_cfg: Any, run_id: str) -> Tracker:
     * ``mlflow`` — the MLflow client (raises a clear error at start_run
       when the extra is missing; reference behavior).
     * ``native`` — the stdlib SQLite store (tracking/sqlite.py).
+    * ``tensorboard`` — native event-file writer
+      (tracking/tensorboard.py); ``tracking_uri`` is the logdir root.
     * ``auto`` (default) — MLflow when importable, else the native store
       pointed at the same tracking URI, so tracking works out of the box
       on hosts without the extra (air-gapped TPU images included). The
@@ -64,6 +67,10 @@ def build_tracker(mlflow_cfg: Any, run_id: str) -> Tracker:
     """
     backend = getattr(mlflow_cfg, "backend", "auto")
     run_name = mlflow_cfg.run_name or run_id
+    if backend == "tensorboard":
+        return TensorBoardTracker(
+            mlflow_cfg.tracking_uri, mlflow_cfg.experiment, run_name=run_name
+        )
     if backend == "mlflow" or (backend == "auto" and _mlflow_available()):
         _reject_native_owned_db(mlflow_cfg.tracking_uri)
         return MLflowTracker(
@@ -86,6 +93,7 @@ __all__ = [
     "MLflowTracker",
     "NullTracker",
     "SqliteTracker",
+    "TensorBoardTracker",
     "Tracker",
     "build_tracker",
 ]
