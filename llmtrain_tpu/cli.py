@@ -172,6 +172,57 @@ def build_parser() -> argparse.ArgumentParser:
     )
     gen.add_argument("--json", action="store_true", help="emit the result as JSON")
 
+    serve = sub.add_parser(
+        "serve",
+        help="HTTP inference server over the compiled decode loop "
+        "(GET /healthz, POST /v1/generate)",
+    )
+    serve.add_argument("--config", required=True, help="path to the YAML run config")
+    serve.add_argument(
+        "--from",
+        dest="from_spec",
+        required=True,
+        help="checkpoint file, checkpoint dir, or run id to serve",
+    )
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument(
+        "--port",
+        type=int,
+        default=8000,
+        help="0 binds an ephemeral port (printed on the ready line)",
+    )
+    serve.add_argument(
+        "--max-new-tokens-cap",
+        type=int,
+        default=256,
+        help="upper bound a request's max_new_tokens may ask for",
+    )
+    serve.add_argument(
+        "--decode-param-dtype",
+        choices=("compute", "param"),
+        default="compute",
+        help="as in generate: 'compute' streams half the weight bytes "
+        "per token for bf16-compute models",
+    )
+    serve.add_argument(
+        "--ema",
+        action="store_true",
+        help="serve the EMA shadow weights (errors if the checkpoint has none)",
+    )
+    serve.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="serve weight-only int8 quantized weights (ops/quant.py)",
+    )
+    serve.add_argument(
+        "--eos-token-id",
+        type=int,
+        default=None,
+        help="default stop token (requests may override; default: the "
+        "tokenizer's EOS, if any)",
+    )
+
     evalp = sub.add_parser(
         "eval", help="run the validation loop on a checkpoint, no training"
     )
@@ -895,6 +946,154 @@ def _agree_flag(local_ok: bool, dist_state: DistState | None) -> bool:
     return bool(np.asarray(agreed))
 
 
+def _build_decode_stack(cfg, logger, label: str = ""):
+    """Adapter + (optional) tokenizer + model for an inference command.
+
+    One implementation for generate/serve (and generate's draft model)
+    so they stay bit-identical; raises with the actionable remediation
+    when the model needs a vocab size the absent tokenizer would supply.
+    """
+    from .models.lora import build_adapter
+
+    adapter = build_adapter(cfg)
+    tokenizer = None
+    try:
+        tokenizer = adapter.build_tokenizer(cfg)
+    except Exception as exc:  # offline environments: tokenizer optional
+        logger.warning(
+            "%sbuild_tokenizer failed (%s); continuing without one", label, exc
+        )
+    try:
+        model = adapter.build_model(cfg)
+    except Exception:
+        if cfg.model.vocab_size is None and tokenizer is None:
+            # e.g. gpt derives vocab_size from the tokenizer, which this
+            # environment could not build (gpt.py:330-336).
+            raise ValueError(
+                "building the model needs a vocab size but no tokenizer is "
+                "available; set model.vocab_size explicitly in the config"
+            ) from None
+        raise
+    return adapter, tokenizer, model
+
+
+def _load_decode_params(
+    cfg,
+    adapter,
+    model,
+    from_spec: str,
+    *,
+    ema: bool,
+    decode_param_dtype: str,
+    quantize: str,
+    logger,
+    label: str = "",
+):
+    """Checkpoint → decode-ready (model, params): the shared load tail.
+
+    LoRA merge, EMA extraction, pipeline→gpt conversion, decode dtype
+    cast, optional int8 quantization — generate, its draft branch, and
+    serve all run THIS function, so a served model is bit-identical to
+    the one ``generate`` would run.
+    """
+    from .models.lora import to_inference_params
+
+    ckpt_path, params, step = _load_checkpoint_params(
+        cfg, adapter, model, from_spec, ema=ema
+    )
+    logger.info("%sloaded checkpoint %s (step %d)", label, ckpt_path, step)
+    if ema:
+        logger.info("%susing EMA shadow weights", label)
+    # LoRA checkpoints decode on the merged weights (models/lora.py).
+    params = to_inference_params(adapter, params)
+    model, params = _prepare_decode_model(
+        model, params, decode_param_dtype, logger, label=label
+    )
+    if quantize == "int8":
+        from .ops.quant import quant_stats, quantize_tree
+
+        params = quantize_tree(params)
+        stats = quant_stats(params)
+        logger.info(
+            "%sint8 weight quantization: %d/%d params quantized, "
+            "%.2fx weight-byte compression",
+            label,
+            stats["quantized_params"],
+            stats["total_params"],
+            stats["compression"],
+        )
+    return model, params, ckpt_path, step
+
+
+def _handle_serve(args: argparse.Namespace) -> int:
+    """Checkpoint → compiled decode loop → stdlib HTTP server (serving.py).
+
+    Loading mirrors ``generate`` exactly (LoRA merge, EMA extraction,
+    pipeline→gpt conversion, decode dtype cast, int8 quantization) so a
+    served model is bit-identical to the one ``generate`` would run.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_compilation_cache()
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    try:
+        from .serving import ServerState, make_server
+
+        initialize_registries()
+        adapter, tokenizer, model = _build_decode_stack(cfg, logger)
+        model, params, ckpt_path, step = _load_decode_params(
+            cfg,
+            adapter,
+            model,
+            args.from_spec,
+            ema=args.ema,
+            decode_param_dtype=args.decode_param_dtype,
+            quantize=args.quantize,
+            logger=logger,
+        )
+        eos = args.eos_token_id
+        if eos is None and tokenizer is not None:
+            eos = getattr(tokenizer, "eot_token", None)
+
+        state = ServerState(
+            model=model,
+            params=params,
+            tokenizer=tokenizer,
+            step=step,
+            checkpoint=str(ckpt_path),
+            eos_token_id=eos,
+            max_new_tokens_cap=args.max_new_tokens_cap,
+        )
+        httpd = make_server(state, args.host, args.port)
+        host, port = httpd.server_address[:2]
+        # Machine-readable ready line: tests (and orchestration) read the
+        # bound port from here, which is what makes --port 0 usable.
+        print(
+            json.dumps({"serving": str(ckpt_path), "host": host, "port": port}),
+            flush=True,
+        )
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"serve failed: {exc}")
+        return EXIT_TRAIN_FAILURE
+
+
 def _handle_eval(args: argparse.Namespace) -> int:
     """Eval-only: restore a checkpoint and run the validation loop once.
 
@@ -1060,29 +1259,10 @@ def _handle_generate(args: argparse.Namespace) -> int:
         import numpy as np
 
         from .generation import generate
-        from .models.lora import build_adapter, to_inference_params
+        from .models.lora import build_adapter
 
         initialize_registries()
-        adapter = build_adapter(cfg)
-
-        tokenizer = None
-        try:
-            tokenizer = adapter.build_tokenizer(cfg)
-        except Exception as exc:  # offline environments: tokenizer optional
-            logger.warning("build_tokenizer failed (%s); continuing without one", exc)
-
-        try:
-            model = adapter.build_model(cfg)
-        except Exception:
-            if cfg.model.vocab_size is None and tokenizer is None:
-                # e.g. gpt derives vocab_size from the tokenizer, which this
-                # environment could not build (gpt.py:330-336).
-                _emit_error(
-                    "building the model needs a vocab size but no tokenizer is "
-                    "available; set model.vocab_size explicitly in the config"
-                )
-                return EXIT_TRAIN_FAILURE
-            raise
+        adapter, tokenizer, model = _build_decode_stack(cfg, logger)
 
         prompts: list[str] | None = None  # text prompts (file mode keeps all)
         if args.prompt_ids is not None:
@@ -1118,29 +1298,16 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 )
                 return EXIT_CONFIG_ERROR
 
-        ckpt_path, params, step = _load_checkpoint_params(
-            cfg, adapter, model, args.from_spec, ema=args.ema
+        model, params, ckpt_path, step = _load_decode_params(
+            cfg,
+            adapter,
+            model,
+            args.from_spec,
+            ema=args.ema,
+            decode_param_dtype=args.decode_param_dtype,
+            quantize=args.quantize,
+            logger=logger,
         )
-        logger.info("loaded checkpoint %s (step %d)", ckpt_path, step)
-        if args.ema:
-            logger.info("decoding with EMA shadow weights")
-        # LoRA checkpoints decode on the merged weights (models/lora.py).
-        params = to_inference_params(adapter, params)
-        model, params = _prepare_decode_model(
-            model, params, args.decode_param_dtype, logger
-        )
-        if args.quantize == "int8":
-            from .ops.quant import quant_stats, quantize_tree
-
-            params = quantize_tree(params)
-            stats = quant_stats(params)
-            logger.info(
-                "int8 weight quantization: %d/%d params quantized, "
-                "%.2fx weight-byte compression",
-                stats["quantized_params"],
-                stats["total_params"],
-                stats["compression"],
-            )
 
         # --- speculative decoding: load the draft model, then decode each
         # prompt via draft-and-verify (speculative.py). Exact w.r.t. the
@@ -1168,22 +1335,17 @@ def _handle_generate(args: argparse.Namespace) -> int:
                 return EXIT_CONFIG_ERROR
             draft_adapter = build_adapter(draft_cfg)
             draft_model = draft_adapter.build_model(draft_cfg)
-            draft_ckpt, draft_params, draft_step = _load_checkpoint_params(
-                draft_cfg, draft_adapter, draft_model, args.draft_from
-            )
-            draft_params = to_inference_params(draft_adapter, draft_params)
-            logger.info(
-                "loaded draft checkpoint %s (step %d)", draft_ckpt, draft_step
-            )
-            draft_model, draft_params = _prepare_decode_model(
-                draft_model, draft_params, args.decode_param_dtype, logger,
+            draft_model, draft_params, _, _ = _load_decode_params(
+                draft_cfg,
+                draft_adapter,
+                draft_model,
+                args.draft_from,
+                ema=False,
+                decode_param_dtype=args.decode_param_dtype,
+                quantize=args.quantize,
+                logger=logger,
                 label="draft ",
             )
-            if args.quantize == "int8":
-                from .ops.quant import quantize_tree
-
-                draft_params = quantize_tree(draft_params)
-                logger.info("draft weights quantized to int8")
             if draft_model.vocab_size != model.vocab_size:
                 _emit_error(
                     f"draft vocab_size ({draft_model.vocab_size}) != target "
@@ -1456,6 +1618,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_train(args)
     if args.command == "generate":
         return _handle_generate(args)
+    if args.command == "serve":
+        return _handle_serve(args)
     if args.command == "eval":
         return _handle_eval(args)
     if args.command == "train-tokenizer":

@@ -1,0 +1,228 @@
+"""HTTP inference server over the compiled decode loop.
+
+Beyond-reference serving surface (the reference's only inference story
+is eager notebook cells, reference
+notebooks/trained_vs_random_completion.ipynb). ``llmtrain_tpu serve``
+loads a checkpoint once, then serves JSON over stdlib
+``http.server`` — no new dependencies, which keeps the air-gapped TPU
+image story intact:
+
+* ``GET /healthz`` — liveness + model/checkpoint metadata.
+* ``POST /v1/generate`` — ``{"prompt": ...}`` or
+  ``{"prompt_ids": [...]}`` plus the generate() sampling knobs; returns
+  completion ids, decoded text when a tokenizer exists, and latency.
+
+Device discipline: one TPU chip runs one decode at a time, so requests
+serialize through a lock (no fake concurrency that would interleave
+XLA programs); ``jax.jit``'s compile cache makes repeated
+(prompt_len, max_new_tokens) shapes reuse their compiled loop, so
+steady-state serving pays compile once per shape bucket. The CLI layer
+(cli.py ``_handle_serve``) owns checkpoint loading/quantization; this
+module owns only the HTTP surface, so it is testable with an in-memory
+model.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import jax
+import numpy as np
+
+
+@dataclass
+class ServerState:
+    """Everything a request needs; built once by the CLI before serving."""
+
+    model: Any
+    params: Any
+    tokenizer: Any | None
+    step: int
+    checkpoint: str
+    eos_token_id: int | None = None
+    max_new_tokens_cap: int = 256
+    default_max_new_tokens: int = 48
+    # One decode at a time: a TPU chip is a serial device and generate()
+    # is not re-entrant across identical jit cache entries anyway.
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    requests_served: int = 0
+
+
+def _bad_request(msg: str) -> tuple[int, dict]:
+    return 400, {"error": msg}
+
+
+def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]:
+    """Pure request logic (no HTTP): validate -> decode -> respond."""
+    from .generation import generate
+
+    if not isinstance(body, dict):
+        return _bad_request("request body must be a JSON object")
+    unknown = set(body) - {
+        "prompt", "prompt_ids", "max_new_tokens", "temperature",
+        "top_k", "top_p", "seed", "eos_token_id",
+    }
+    if unknown:
+        return _bad_request(f"unknown fields: {sorted(unknown)}")
+    if ("prompt" in body) == ("prompt_ids" in body):
+        return _bad_request("provide exactly one of 'prompt' or 'prompt_ids'")
+
+    vocab = int(getattr(state.model, "vocab_size", 0) or 0)
+    if "prompt" in body:
+        if state.tokenizer is None:
+            return _bad_request(
+                "this server has no tokenizer; send 'prompt_ids' instead"
+            )
+        if not isinstance(body["prompt"], str) or not body["prompt"]:
+            return _bad_request("'prompt' must be a non-empty string")
+        ids = np.asarray(state.tokenizer.encode(body["prompt"]), dtype=np.int32)
+    else:
+        raw = body["prompt_ids"]
+        if (
+            not isinstance(raw, list)
+            or not raw
+            or not all(isinstance(t, int) for t in raw)
+        ):
+            return _bad_request("'prompt_ids' must be a non-empty list of ints")
+        bound = vocab or 2**31 - 1  # int32 dtype bound when vocab unknown
+        if not all(0 <= t < bound for t in raw):
+            return _bad_request(f"prompt token ids must be in [0, {bound})")
+        ids = np.asarray(raw, dtype=np.int32)
+    if ids.size == 0:
+        return _bad_request("prompt encodes to zero tokens")
+
+    # A server started with a cap below the default must still accept
+    # knob-less requests: the effective default is min(default, cap).
+    max_new = body.get(
+        "max_new_tokens",
+        min(state.default_max_new_tokens, state.max_new_tokens_cap),
+    )
+    if not isinstance(max_new, int) or max_new < 1:
+        return _bad_request("'max_new_tokens' must be a positive int")
+    if max_new > state.max_new_tokens_cap:
+        return _bad_request(
+            f"'max_new_tokens' exceeds the server cap "
+            f"({state.max_new_tokens_cap})"
+        )
+    block_size = int(getattr(state.model, "block_size", 10**9))
+    if ids.size + max_new > block_size:
+        return _bad_request(
+            f"prompt ({ids.size}) + max_new_tokens ({max_new}) exceeds the "
+            f"model block_size ({block_size})"
+        )
+    temperature = body.get("temperature", 1.0)
+    if not isinstance(temperature, (int, float)) or isinstance(temperature, bool):
+        return _bad_request("'temperature' must be a number")
+    if temperature < 0:
+        return _bad_request("'temperature' must be >= 0")
+    top_k = body.get("top_k")
+    if top_k is not None and (not isinstance(top_k, int) or isinstance(top_k, bool)):
+        return _bad_request("'top_k' must be an int")
+    top_p = body.get("top_p")
+    if top_p is not None and (
+        not isinstance(top_p, (int, float)) or isinstance(top_p, bool)
+    ):
+        return _bad_request("'top_p' must be a number")
+    seed = body.get("seed", 0)
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        return _bad_request("'seed' must be an int")
+    eos = body.get("eos_token_id", state.eos_token_id)
+    if eos is not None and (not isinstance(eos, int) or isinstance(eos, bool)):
+        return _bad_request("'eos_token_id' must be an int")
+
+    t0 = time.monotonic()
+    with state.lock:
+        out = generate(
+            state.model,
+            state.params,
+            ids[None, :],
+            max_new_tokens=max_new,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos,
+            rng=jax.random.key(seed),
+        )
+        state.requests_served += 1
+    latency_ms = (time.monotonic() - t0) * 1000.0
+
+    completion = [int(t) for t in np.asarray(out)[0, ids.size :]]
+    if eos is not None and eos in completion:
+        completion = completion[: completion.index(eos) + 1]
+    text = None
+    if state.tokenizer is not None:
+        try:
+            text = state.tokenizer.decode(completion)
+        except Exception:  # noqa: BLE001 — decode is best-effort for ids
+            text = None
+    return 200, {
+        "completion_ids": completion,
+        "text": text,
+        "prompt_tokens": int(ids.size),
+        "latency_ms": round(latency_ms, 3),
+    }
+
+
+def _handle_health(state: ServerState) -> tuple[int, dict]:
+    return 200, {
+        "status": "ok",
+        "model": type(state.model).__name__,
+        "step": state.step,
+        "checkpoint": state.checkpoint,
+        "requests_served": state.requests_served,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by make_server().
+    state: ServerState = None  # type: ignore[assignment]
+
+    def _respond(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            self._respond(*_handle_health(self.state))
+        else:
+            self._respond(404, {"error": f"no route for GET {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path != "/v1/generate":
+            self._respond(404, {"error": f"no route for POST {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"null")
+        except (ValueError, json.JSONDecodeError):
+            self._respond(400, {"error": "body is not valid JSON"})
+            return
+        try:
+            self._respond(*_handle_generate_request(self.state, body))
+        except Exception as exc:  # noqa: BLE001 — server must not die
+            self._respond(500, {"error": f"generation failed: {exc}"})
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        from .utils.logging import get_logger
+
+        get_logger().info("serve: %s", fmt % args)
+
+
+def make_server(
+    state: ServerState, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral; read ``server_address[1]``), don't serve."""
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+__all__ = ["ServerState", "make_server"]
