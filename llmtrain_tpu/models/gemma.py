@@ -1,0 +1,53 @@
+"""Gemma-family adapter: the llama stack with Gemma's three conventions.
+
+Beyond-reference model family (the reference ships GPT only,
+``src/llmtrain/models/gpt.py``; SURVEY §2.1). Gemma (v1) is
+architecturally llama — RMSNorm, RoPE, GQA, gated MLP, bias-free
+projections — with three parameterization changes, each a knob threaded
+through ``models/llama.py``:
+
+* **GeGLU** MLP: ``gelu_tanh(gate) * up`` (HF ``gelu_pytorch_tanh``)
+  instead of SiLU;
+* **(1 + scale) RMSNorm**: the stored scale is a zero-init delta — the
+  layout HF Gemma checkpoints use, so interop needs no transform;
+* **sqrt(d_model)-scaled input embeddings** (the tied lm_head read is
+  not scaled), with **tied embeddings the family default**.
+
+Everything else — attention dispatch, KV-cache decode, chunked CE,
+remat, sharding, LoRA/EMA/quantization composition — is the shared
+machinery; still exactly one attention implementation in the package.
+The param tree is the llama tree (norm deltas instead of norm scales),
+so ``interop/llama_hf.py`` exports/imports HF ``GemmaForCausalLM``
+state dicts unchanged. Known limitation: head_dim is derived as
+``d_model // n_heads`` (the whole-package convention), so checkpoints
+with a decoupled head_dim — Gemma-7B's 16 heads × 256 at hidden 3072 —
+do not import; Gemma-2B geometry (head_dim == d_model/n_heads) does.
+Numerics are parity-tested against transformers' torch Gemma in
+tests/test_gemma.py.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..config.schemas import RunConfig
+from ..registry.models import register_model
+from .llama import LlamaAdapter
+
+
+@register_model("gemma")
+class GemmaAdapter(LlamaAdapter):
+    """Adapter for the Gemma family (GeGLU + offset norms + scaled embed)."""
+
+    def build_model(self, cfg: RunConfig) -> nn.Module:
+        base = super().build_model(cfg)  # full llama validation stack
+        updates: dict = {
+            "mlp_act": "gelu_tanh",
+            "norm_offset": True,
+            "embed_scale": True,
+        }
+        if "tie_embeddings" not in cfg.model.model_fields_set:
+            # Gemma convention: tied head (llama's unset-default is
+            # untied; an explicit config value wins either way).
+            updates["tie_embeddings"] = True
+        return base.clone(**updates)

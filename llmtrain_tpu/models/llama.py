@@ -46,11 +46,18 @@ from .gpt_moe import GPTMoEAdapter as _GPTMoEAdapter
 
 
 class RMSNorm(nn.Module):
-    """Root-mean-square norm, f32 statistics, scale-only (no bias)."""
+    """Root-mean-square norm, f32 statistics, scale-only (no bias).
+
+    ``offset=True`` is the Gemma parameterization: the stored scale is a
+    zero-initialized delta and the output multiplies by ``1 + scale`` —
+    the identity transform at init, and the exact layout HF Gemma
+    checkpoints store (models/gemma.py).
+    """
 
     eps: float = 1e-6
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -59,9 +66,13 @@ class RMSNorm(nn.Module):
         # "embed"→fsdp makes XLA reshard the residual-stream grads
         # embed-wise for the dscale reduction — an involuntary-full-
         # rematerialization path on fsdp×tensor meshes.
+        init = (
+            nn.initializers.zeros_init() if self.offset
+            else nn.initializers.ones_init()
+        )
         scale = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            nn.with_logical_partitioning(init, ("norm",)),
             (x.shape[-1],),
             self.param_dtype,
         )
@@ -69,7 +80,10 @@ class RMSNorm(nn.Module):
         norm = xf * jax.lax.rsqrt(
             jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps
         )
-        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+        mult = scale.astype(jnp.float32)
+        if self.offset:
+            mult = 1.0 + mult
+        return (norm * mult).astype(self.dtype)
 
 
 class LlamaBlock(nn.Module):
@@ -90,6 +104,10 @@ class LlamaBlock(nn.Module):
     # Qwen2 convention (models/qwen2.py): bias on q/k/v only; out_proj
     # and the MLP stay bias-free either way.
     qkv_bias: bool = False
+    # Gemma conventions (models/gemma.py): tanh-GELU GeGLU MLP and the
+    # (1 + scale) RMSNorm parameterization.
+    mlp_act: str = "silu"
+    norm_offset: bool = False
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
@@ -107,7 +125,10 @@ class LlamaBlock(nn.Module):
         deterministic: bool = True,
     ) -> jax.Array:
         norm_kw = dict(
-            eps=self.rms_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype
+            eps=self.rms_norm_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            offset=self.norm_offset,
         )
         # Pin the norm outputs' sharding: without the constraint XLA's
         # backward pass reshards the residual-stream grads through a
@@ -169,7 +190,16 @@ class LlamaBlock(nn.Module):
                 name="mlp_up",
                 **dense_kw,
             )(h)
-            h = nn.silu(gate) * up
+            if self.mlp_act == "silu":
+                h = nn.silu(gate) * up
+            elif self.mlp_act == "gelu_tanh":
+                # Gemma's GeGLU: HF hidden_activation gelu_pytorch_tanh.
+                h = nn.gelu(gate, approximate=True) * up
+            else:
+                raise ValueError(
+                    f"mlp_act {self.mlp_act!r} unknown; expected 'silu' "
+                    "or 'gelu_tanh'"
+                )
             h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
             h = nn.Dense(
                 self.d_model,
@@ -211,6 +241,12 @@ class Llama(nn.Module):
     rms_norm_eps: float = 1e-6
     # Qwen2 convention: bias on the q/k/v projections only.
     qkv_bias: bool = False
+    # Gemma conventions: tanh-GELU GeGLU, (1 + scale) RMSNorm, and
+    # sqrt(d_model)-scaled input embeddings (the tied lm_head read is
+    # NOT scaled — HF Gemma semantics).
+    mlp_act: str = "silu"
+    norm_offset: bool = False
+    embed_scale: bool = False
     # Sliding-window attention (model.extra.sliding_window, the Mistral
     # architecture knob): O(T·W) attention on the flash path.
     sliding_window: int = 0
@@ -266,6 +302,11 @@ class Llama(nn.Module):
         # model-level position_index variable GPT keeps (gpt.py:506-514)
         # has no Llama analogue.
         x = token_embedding(input_ids)
+        if self.embed_scale:
+            # HF Gemma casts the sqrt(d) normalizer to the activation
+            # dtype BEFORE multiplying (a bf16 rounding the parity tests
+            # would catch if skipped).
+            x = x * jnp.asarray(self.d_model**0.5, dtype=x.dtype)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
@@ -299,6 +340,8 @@ class Llama(nn.Module):
                 rope_theta=self.rope_theta,
                 rms_norm_eps=self.rms_norm_eps,
                 qkv_bias=self.qkv_bias,
+                mlp_act=self.mlp_act,
+                norm_offset=self.norm_offset,
                 sliding_window=self.sliding_window,
                 ring_slack=self.ring_slack if self.decode else 0,
                 n_experts=self.n_experts,
@@ -313,6 +356,7 @@ class Llama(nn.Module):
             eps=self.rms_norm_eps,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            offset=self.norm_offset,
         )(x)
 
         if return_hidden:

@@ -24,6 +24,7 @@ _PLUGIN_MODULES = (
     "llmtrain_tpu.models.gpt_pipeline",
     "llmtrain_tpu.models.llama",
     "llmtrain_tpu.models.qwen2",
+    "llmtrain_tpu.models.gemma",
     "llmtrain_tpu.data.dummy_text",
     "llmtrain_tpu.data.hf_text",
     "llmtrain_tpu.data.local_text",

@@ -1,0 +1,270 @@
+"""Gemma-family tests (models/gemma.py).
+
+Beyond-reference model family. Gemma is the llama stack with GeGLU,
+(1 + scale) RMSNorm, and sqrt(d)-scaled input embeddings, so these
+tests cover exactly those deltas plus HF-torch-Gemma numerical parity
+and the HF state-dict round-trip (mirroring tests/test_qwen2.py's
+strategy for the qkv-bias delta).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.registry.models import get_model_adapter
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training.trainer import Trainer
+
+V, T, D, H, F = 64, 16, 32, 4, 88
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+def _cfg(_max_steps=25, _model_overrides=None, **model_extra):
+    model = {
+        "name": "gemma",
+        "block_size": T,
+        "d_model": D,
+        "n_layers": 2,
+        "n_heads": H,
+        "d_ff": F,
+        "dropout": 0.0,
+        "vocab_size": V,
+        "extra": model_extra,
+    }
+    model.update(_model_overrides or {})
+    return RunConfig.model_validate(
+        {
+            "run": {"name": "gemma-t", "seed": 0, "device": "cpu"},
+            "model": model,
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": _max_steps,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "lr": 5e-3,
+                "warmup_steps": 0,
+                "log_every_steps": 10,
+                "eval_every_steps": 100,
+                "save_every_steps": 100,
+            },
+            "mlflow": {"enabled": False},
+        }
+    )
+
+
+def _build(_model_overrides=None, **model_extra):
+    cfg = _cfg(_model_overrides=_model_overrides, **model_extra)
+    adapter = get_model_adapter("gemma")()
+    model = adapter.build_model(cfg)
+    params = nn_meta.unbox(
+        model.init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32), deterministic=True
+        )["params"]
+    )
+    return cfg, adapter, model, params
+
+
+class TestArchitecture:
+    def test_gemma_knobs_set(self):
+        _, _, model, _ = _build()
+        assert model.mlp_act == "gelu_tanh"
+        assert model.norm_offset is True
+        assert model.embed_scale is True
+        assert model.tie_embeddings is True  # family default
+        _, _, untied, _ = _build(_model_overrides={"tie_embeddings": False})
+        assert untied.tie_embeddings is False
+
+    def test_norm_deltas_init_to_zero(self):
+        """(1 + scale) parameterization: stored scales are zero deltas."""
+        _, _, _, params = _build()
+        assert float(jnp.abs(params["norm_f"]["scale"]).max()) == 0.0
+        assert float(
+            jnp.abs(params["block_0"]["attn_norm"]["scale"]).max()
+        ) == 0.0
+
+    def test_embeddings_scaled_at_input_only(self):
+        """sqrt(d) enters the forward exactly once, at the input: a
+        zero-block gemma-configured Llama equals the embedding rows
+        scaled, rms-normed (identity-at-init offset norm), and read
+        against the UNSCALED tied head."""
+        from llmtrain_tpu.models.llama import Llama
+
+        model = Llama(
+            vocab_size=V, block_size=T, d_model=D, n_layers=0, n_heads=H,
+            d_ff=F, dropout=0.0, tie_embeddings=True,
+            mlp_act="gelu_tanh", norm_offset=True, embed_scale=True,
+        )
+        params = nn_meta.unbox(
+            model.init(jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+        )
+        ids = jnp.asarray([[3, 9]], jnp.int32)
+        logits = model.apply({"params": params}, ids, deterministic=True)
+        emb = params["token_embedding"]["embedding"]
+        x = np.asarray(emb)[np.asarray(ids)[0]] * (D**0.5)
+        x = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        want = x @ np.asarray(emb).T  # tied head reads UNSCALED embeddings
+        np.testing.assert_allclose(np.asarray(logits)[0], want, atol=1e-4)
+
+    def test_llama_unaffected(self):
+        """The gemma knobs must not leak into the llama family."""
+        from llmtrain_tpu.models.llama import Llama
+
+        m = Llama(
+            vocab_size=V, block_size=T, d_model=D, n_layers=1, n_heads=H,
+            d_ff=F, dropout=0.0,
+        )
+        assert m.mlp_act == "silu" and not m.norm_offset and not m.embed_scale
+        p = nn_meta.unbox(
+            m.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+        )
+        assert float(jnp.abs(p["norm_f"]["scale"]).max()) == 1.0  # ones init
+
+    def test_loss_decreases_under_trainer(self):
+        trainer = Trainer(_cfg(), None, NullTracker(), None)
+        res = trainer.fit()
+        assert res.final_loss < res.first_step_loss
+
+    def test_bad_mlp_act_rejected(self):
+        from llmtrain_tpu.models.llama import Llama
+
+        m = Llama(
+            vocab_size=V, block_size=T, d_model=D, n_layers=1, n_heads=H,
+            d_ff=F, dropout=0.0, mlp_act="tanh",
+        )
+        with pytest.raises(ValueError, match="mlp_act"):
+            m.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+
+class TestGemmaSharded:
+    def test_train_step_on_fsdp_tp_mesh(self):
+        """One Trainer step under {data:2, fsdp:2, tensor:2} — the gemma
+        knobs (offset norms, scaled embed, GeGLU) must shard through the
+        shared logical-axis rules without pjit errors."""
+        cfg = _cfg(_max_steps=2, n_kv_heads=2)
+        cfg = RunConfig.model_validate(
+            {
+                **cfg.model_dump(),
+                "distributed": {
+                    "enabled": False,
+                    "mesh": {"data": 2, "fsdp": 2, "tensor": 2},
+                },
+            }
+        )
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert np.isfinite(res.final_loss)
+
+
+class TestHFParity:
+    """Numerics pinned against transformers' torch Gemma (fwd logits)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        initialize_registries()
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=V,
+            hidden_size=D,
+            intermediate_size=F,
+            num_hidden_layers=2,
+            num_attention_heads=H,
+            num_key_value_heads=2,
+            head_dim=D // H,
+            max_position_embeddings=T,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            hidden_activation="gelu_pytorch_tanh",
+            tie_word_embeddings=True,
+        )
+        torch.manual_seed(0)
+        hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+
+        cfg = _cfg(n_kv_heads=2, rope_theta=10000.0)
+        adapter = get_model_adapter("gemma")()
+        ours = adapter.build_model(cfg)
+        p = nn_meta.unbox(
+            ours.init(
+                jax.random.key(0), jnp.zeros((1, 4), jnp.int32),
+                deterministic=True,
+            )["params"]
+        )
+
+        from llmtrain_tpu.interop import llama_params_from_hf_state_dict
+
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        new = llama_params_from_hf_state_dict(sd, p)
+        assert jax.tree.map(jnp.shape, p) == jax.tree.map(jnp.shape, new)
+        return hf, ours, new
+
+    def test_logits_match(self, pair):
+        torch = pytest.importorskip("torch")
+        hf, ours, params = pair
+        ids = np.asarray([[1, 5, 9, 2, 40, 3, 0, 63]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.from_numpy(ids).long()).logits.numpy()
+        got = np.asarray(
+            ours.apply({"params": params}, jnp.asarray(ids), deterministic=True)
+        )
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_generate_greedy_runs(self, pair):
+        """KV-cache decode carries the scaled-embed path end to end."""
+        from llmtrain_tpu.generation import generate
+
+        _, ours, params = pair
+        out = generate(
+            ours,
+            params,
+            np.array([[1, 2, 3]], np.int32),
+            max_new_tokens=4,
+            temperature=0.0,
+        )
+        assert np.asarray(out).shape == (1, 7)
+
+
+class TestHFRoundtrip:
+    def test_export_loads_into_hf_gemma(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        from llmtrain_tpu.interop import llama_params_to_hf_state_dict
+
+        _, _, _, params = _build(n_kv_heads=2)
+        sd = {
+            k: torch.from_numpy(v)
+            for k, v in llama_params_to_hf_state_dict(params).items()
+        }
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=V,
+            hidden_size=D,
+            intermediate_size=F,
+            num_hidden_layers=2,
+            num_attention_heads=H,
+            num_key_value_heads=2,
+            head_dim=D // H,
+            max_position_embeddings=T,
+            rms_norm_eps=1e-6,
+            hidden_activation="gelu_pytorch_tanh",
+            tie_word_embeddings=True,
+        )
+        hf = transformers.GemmaForCausalLM(hf_cfg)
+        result = hf.load_state_dict(sd, strict=False)
+        # strict=False only because the tied lm_head may dedupe — nothing
+        # else may be missing, and no exported tensor may go unconsumed.
+        assert result.unexpected_keys == []
+        assert set(result.missing_keys) <= {"lm_head.weight"}
+        # The loaded embedding matches ours bit-for-bat.
+        np.testing.assert_allclose(
+            hf.model.embed_tokens.weight.detach().numpy(),
+            np.asarray(params["token_embedding"]["embedding"], np.float32),
+            atol=0,
+        )
