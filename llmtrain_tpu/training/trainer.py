@@ -19,6 +19,8 @@ the step, and (at log boundaries) pull small scalars off device.
 from __future__ import annotations
 
 import math
+import signal
+import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -68,6 +70,9 @@ class TrainResult:
     parameter_count: int
     trainable_parameter_count: int
     total_tokens: int = 0
+    # True when SIGTERM cut the run short: the last checkpoint is the
+    # preemption save and final_step is where training actually stopped.
+    preempted: bool = False
 
 
 class Trainer:
@@ -470,7 +475,35 @@ class Trainer:
         interval_start = time.perf_counter()
         start_time = time.perf_counter()
 
+        # Preemption-safe checkpointing (the k8s spot/maintenance story,
+        # docs/k8s.md): SIGTERM sets a flag; the loop saves a durable
+        # checkpoint and returns cleanly (exit 0) inside the pod's
+        # termination grace period, so `train --resume`/`--auto-resume`
+        # continues exactly where the evicted pod stopped. Single-process
+        # runs honor the flag at every step. Multi-process runs decide at
+        # the log-interval boundary via an ALL-GATHER of the local flags:
+        # OS signal delivery gives no cross-rank timing guarantee, so
+        # without the consensus a rank whose signal landed just before
+        # its boundary check would break into the collective host-gather
+        # while another rank ran step N+1's collectives — a deadlock the
+        # grace period would turn into a SIGKILL with no checkpoint. The
+        # boundary already syncs on the interval's last loss, so the
+        # one-byte collective costs nothing extra.
+        preempted = False
+        old_term = None
+        multi_process = (
+            self._dist_state is not None and self._dist_state.num_processes > 1
+        )
+
+        def _on_sigterm(signum, frame):  # pragma: no cover - exercised via kill
+            nonlocal preempted
+            preempted = True
+
+        if threading.current_thread() is threading.main_thread():
+            old_term = signal.signal(signal.SIGTERM, _on_sigterm)
+
         past_end_loss: float | None = None
+        final_step_override: int | None = None
         loop_completed = False
         try:
             with self._mesh, nn.logical_axis_rules(self._rules):
@@ -498,8 +531,40 @@ class Trainer:
                     if step == 1:
                         first_step_loss = float(jax.device_get(metrics["loss"]))
 
-                    if step % save_every == 0 or step == max_steps:
+                    if multi_process and step % log_every == 0:
+                        from jax.experimental import multihost_utils
+
+                        stop_now = bool(
+                            multihost_utils.process_allgather(
+                                np.asarray([preempted])
+                            ).any()
+                        )
+                    else:
+                        stop_now = preempted and not multi_process
+                    # A signal during the very last step changes nothing:
+                    # the run is completing anyway — let the normal
+                    # save/log/eval tail report an un-preempted result.
+                    stop_now = stop_now and step < max_steps
+                    if step % save_every == 0 or step == max_steps or stop_now:
                         self._save_checkpoint(step)
+
+                    if stop_now:
+                        if self._ckpt_mgr is not None:
+                            logger.warning(
+                                "SIGTERM received: preemption checkpoint "
+                                "saved at step %d; stopping cleanly (resume "
+                                "with --resume)",
+                                step,
+                            )
+                        else:
+                            logger.warning(
+                                "SIGTERM received: stopping cleanly at step "
+                                "%d WITHOUT a checkpoint (no run dir / "
+                                "checkpoint manager on this process)",
+                                step,
+                            )
+                        final_step_override = step
+                        break
 
                     if step % log_every == 0 or step == max_steps:
                         # Steps dispatch asynchronously; sync on the
@@ -532,6 +597,8 @@ class Trainer:
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
             loop_completed = True
         finally:
+            if old_term is not None:
+                signal.signal(signal.SIGTERM, old_term)
             profiler.close(sync=step_loss_dev)
             if self._ckpt_mgr is not None:
                 # Final save must be durable. When an exception is unwinding
@@ -549,7 +616,7 @@ class Trainer:
                         )
         total_time = time.perf_counter() - start_time
         final_loss = float(jax.device_get(step_loss_dev)) if step_loss_dev is not None else 0.0
-        final_step = max_steps
+        final_step = final_step_override or max_steps
         if start_step > max_steps:
             # No steps ran: report the restored step and its measured loss
             # rather than pretending training reached max_steps.
@@ -569,6 +636,7 @@ class Trainer:
             parameter_count=self._param_count,
             trainable_parameter_count=self._trainable_count,
             total_tokens=total_tokens,
+            preempted=final_step_override is not None,
         )
 
     def _probe_seqlen(self, dataset) -> int:

@@ -60,6 +60,7 @@ def format_run_summary(
             "trainable_parameter_count": train_result.trainable_parameter_count,
             "val_metrics": dict(train_result.val_metrics or {}),
             "resumed_from_step": train_result.resumed_from_step,
+            "preempted": getattr(train_result, "preempted", False),
         }
 
     if as_json:
