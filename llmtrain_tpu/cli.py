@@ -1179,6 +1179,7 @@ def _prepare_decode_model(model, params, decode_param_dtype: str, logger, label=
             # A windowed pipeline checkpoint must keep its window at
             # decode time (rolling cache + masked reads).
             sliding_window=getattr(model, "sliding_window", 0),
+            kv_cache_dtype=getattr(model, "kv_cache_dtype", "model"),
         )
         logger.info(
             "%spipeline checkpoint converted to the gpt tree for KV-cache "

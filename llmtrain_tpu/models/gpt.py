@@ -105,6 +105,16 @@ class CausalSelfAttention(nn.Module):
     # every evicted position is provably outside all future queries'
     # windows (evicted = p - C <= row - W).
     ring_slack: int = 0
+    # KV-cache storage dtype (decode only): "model" keeps the compute
+    # dtype; "int8" stores codes + one f32 scale per written (batch,
+    # position, kv-head) — amax over head_dim — halving cache HBM vs
+    # bf16 (4x vs f32). Long-generation serving memory is KV-bound, so
+    # this is the cache-side sibling of weight-only quantization
+    # (ops/quant.py). Dequant happens in-graph at the attention read;
+    # XLA fuses it into the score einsum's operand load. Speculative
+    # rollback (cursor-only) is unaffected: rolled-back slots are
+    # simply rewritten, codes and scales together.
+    kv_cache_dtype: str = "model"
 
     @nn.compact
     def __call__(
@@ -299,6 +309,12 @@ class CausalSelfAttention(nn.Module):
         """
         if self.cache_len <= 0:
             raise ValueError("decode=True requires cache_len > 0 (the block size)")
+        if self.kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {self.kv_cache_dtype!r} unknown; expected "
+                "'model' or 'int8'"
+            )
+        quant_cache = self.kv_cache_dtype == "int8"
         batch, t, n_heads, head_dim = q.shape
         kv_width = k.shape[2]  # n_kv_heads under GQA, else n_heads
         ring = (self.sliding_window + self.ring_slack) if self.sliding_window else 0
@@ -309,15 +325,36 @@ class CausalSelfAttention(nn.Module):
             "cached_key",
             jnp.zeros,
             (batch, cap, kv_width, head_dim),
-            k.dtype,
+            jnp.int8 if quant_cache else k.dtype,
         )
         cached_value = self.variable(
             "cache",
             "cached_value",
             jnp.zeros,
             (batch, cap, kv_width, head_dim),
-            v.dtype,
+            jnp.int8 if quant_cache else v.dtype,
         )
+        if quant_cache:
+            # One f32 scale per written (batch, slot, kv-head); zero on
+            # never-written slots (dequantizes to 0.0, and the liveness
+            # mask excludes those slots anyway).
+            key_scale = self.variable(
+                "cache", "key_scale", jnp.zeros,
+                (batch, cap, kv_width, 1), jnp.float32,
+            )
+            value_scale = self.variable(
+                "cache", "value_scale", jnp.zeros,
+                (batch, cap, kv_width, 1), jnp.float32,
+            )
+
+            def _q8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+                # ONE quantization recipe in the package: the weight
+                # quantizer's math, reduced over head_dim per position.
+                from ..ops.quant import quantize_array
+
+                qa = quantize_array(x, reduce_axes=(x.ndim - 1,))
+                return qa.q, qa.scale
+
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
@@ -344,25 +381,57 @@ class CausalSelfAttention(nn.Module):
             keep = min(t, cap)
             pos = idx + t - keep + jnp.arange(keep)  # absolute positions kept
             slots = pos % cap
-            cached_key.value = cached_key.value.at[:, slots].set(
-                k[:, t - keep :].astype(cached_key.value.dtype)
-            )
-            cached_value.value = cached_value.value.at[:, slots].set(
-                v[:, t - keep :].astype(cached_value.value.dtype)
-            )
+            if quant_cache:
+                kc, ks = _q8(k[:, t - keep :])
+                vc, vs = _q8(v[:, t - keep :])
+                cached_key.value = cached_key.value.at[:, slots].set(kc)
+                cached_value.value = cached_value.value.at[:, slots].set(vc)
+                key_scale.value = key_scale.value.at[:, slots].set(ks)
+                value_scale.value = value_scale.value.at[:, slots].set(vs)
+            else:
+                cached_key.value = cached_key.value.at[:, slots].set(
+                    k[:, t - keep :].astype(cached_key.value.dtype)
+                )
+                cached_value.value = cached_value.value.at[:, slots].set(
+                    v[:, t - keep :].astype(cached_value.value.dtype)
+                )
             cached_pos1.value = cached_pos1.value.at[slots].set(pos + 1)
             col_pos = cached_pos1.value - 1  # (C,): -1 = empty slot
         else:
-            cached_key.value = jax.lax.dynamic_update_slice(
-                cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
-            )
-            cached_value.value = jax.lax.dynamic_update_slice(
-                cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0)
-            )
+            if quant_cache:
+                kc, ks = _q8(k)
+                vc, vs = _q8(v)
+                cached_key.value = jax.lax.dynamic_update_slice(
+                    cached_key.value, kc, (0, idx, 0, 0)
+                )
+                cached_value.value = jax.lax.dynamic_update_slice(
+                    cached_value.value, vc, (0, idx, 0, 0)
+                )
+                key_scale.value = jax.lax.dynamic_update_slice(
+                    key_scale.value, ks, (0, idx, 0, 0)
+                )
+                value_scale.value = jax.lax.dynamic_update_slice(
+                    value_scale.value, vs, (0, idx, 0, 0)
+                )
+            else:
+                cached_key.value = jax.lax.dynamic_update_slice(
+                    cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
+                )
+                cached_value.value = jax.lax.dynamic_update_slice(
+                    cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0)
+                )
             col_pos = None
         cache_index.value = idx + t
 
         keys, values = cached_key.value, cached_value.value
+        if quant_cache:
+            # In-graph dequant: XLA streams the int8 codes from HBM (the
+            # bandwidth win) and fuses convert+multiply into the einsum
+            # operand reads.
+            keys = (keys.astype(jnp.float32) * key_scale.value).astype(q.dtype)
+            values = (values.astype(jnp.float32) * value_scale.value).astype(
+                q.dtype
+            )
         scale = 1.0 / math.sqrt(head_dim)
         # Grouped-query decode (g=1 is classic MHA): the cache holds
         # n_kv_heads (the memory win) and stays narrow at read too —
@@ -466,6 +535,7 @@ class TransformerBlock(nn.Module):
     assume_packed: bool = False  # drop the flash mask operand (packed data)
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
+    kv_cache_dtype: str = "model"  # "int8": quantized decode cache
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -500,6 +570,7 @@ class TransformerBlock(nn.Module):
             assume_packed=self.assume_packed,
             sliding_window=self.sliding_window,
             ring_slack=self.ring_slack,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -593,6 +664,9 @@ class GPT(nn.Module):
     # Extra rolling-cache slots for speculative decode rollback safety
     # (see CausalSelfAttention.ring_slack); set via for_decoding().
     ring_slack: int = 0
+    # Decode-cache storage dtype (model.extra.kv_cache_dtype): "int8"
+    # halves KV-cache HBM vs bf16 (see CausalSelfAttention).
+    kv_cache_dtype: str = "model"
 
     def for_decoding(
         self, cache_len: int | None = None, *, ring_slack: int = 0
@@ -694,6 +768,7 @@ class GPT(nn.Module):
                 assume_packed=self.assume_packed,
                 sliding_window=self.sliding_window,
                 ring_slack=self.ring_slack if self.decode else 0,
+                kv_cache_dtype=self.kv_cache_dtype,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
@@ -737,7 +812,8 @@ class GPTAdapter(ModelAdapter):
 
     known_extra_keys = frozenset(
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
-         "assume_packed", "remat_policy", "sliding_window"}
+         "assume_packed", "remat_policy", "sliding_window",
+         "kv_cache_dtype"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -780,6 +856,12 @@ class GPTAdapter(ModelAdapter):
                 "attention-probability dropout; set model.dropout to 0.0 or "
                 "use attention='dense'"
             )
+        kv_cache_dtype = str(cfg.model.extra.get("kv_cache_dtype", "model"))
+        if kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"model.extra.kv_cache_dtype {kv_cache_dtype!r} unknown; "
+                "expected 'model' or 'int8'"
+            )
         sliding_window = int(cfg.model.extra.get("sliding_window", 0))
         if sliding_window < 0:
             raise ValueError(
@@ -810,6 +892,7 @@ class GPTAdapter(ModelAdapter):
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             remat_policy=remat_policy,
             sliding_window=sliding_window,
+            kv_cache_dtype=kv_cache_dtype,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

@@ -179,6 +179,11 @@ class PipelineGPT(nn.Module):
     # Sliding-window attention (model.extra.sliding_window, Mistral
     # semantics — see models/gpt.py); 0 = full causal.
     sliding_window: int = 0
+    # Decode-cache storage dtype: the pipeline model never decodes
+    # itself, but carries the knob so the decode-time conversion to the
+    # plain GPT tree (interop/pipeline_convert.py via cli.py
+    # _prepare_decode_model) preserves it.
+    kv_cache_dtype: str = "model"
     # Grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = MQA), the
     # same semantics and param naming family as models/gpt.py — flash
     # consumes the narrow K/V natively, dense broadcasts.
@@ -473,6 +478,7 @@ class PipelineGPTAdapter(ModelAdapter):
             "pipeline_microbatches",
             "pipeline_virtual_chunks",
             "sliding_window",
+            "kv_cache_dtype",
         }
     )
 
@@ -536,6 +542,7 @@ class PipelineGPTAdapter(ModelAdapter):
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             n_kv_heads=n_kv_heads,
             sliding_window=sliding_window,
+            kv_cache_dtype=str(cfg.model.extra.get("kv_cache_dtype", "model")),
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

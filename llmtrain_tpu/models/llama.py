@@ -110,6 +110,7 @@ class LlamaBlock(nn.Module):
     norm_offset: bool = False
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
+    kv_cache_dtype: str = "model"  # "int8": quantized decode cache
     # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
     # mlp_type="swiglu" — the Mixtral layout); 0 = dense SwiGLU.
     n_experts: int = 0
@@ -154,6 +155,7 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta,
             sliding_window=self.sliding_window,
             ring_slack=self.ring_slack,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(h, attention_mask, deterministic=deterministic)
 
@@ -250,6 +252,8 @@ class Llama(nn.Module):
     # Sliding-window attention (model.extra.sliding_window, the Mistral
     # architecture knob): O(T·W) attention on the flash path.
     sliding_window: int = 0
+    # Decode-cache storage dtype (model.extra.kv_cache_dtype).
+    kv_cache_dtype: str = "model"
     # Extra rolling-cache slots for speculative decode rollback safety
     # (models/gpt.py CausalSelfAttention.ring_slack).
     ring_slack: int = 0
@@ -343,6 +347,7 @@ class Llama(nn.Module):
                 mlp_act=self.mlp_act,
                 norm_offset=self.norm_offset,
                 sliding_window=self.sliding_window,
+                kv_cache_dtype=self.kv_cache_dtype,
                 ring_slack=self.ring_slack if self.decode else 0,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
@@ -439,6 +444,7 @@ class LlamaAdapter(GPTAdapter):
             rope_theta=rope_theta,
             rms_norm_eps=rms_norm_eps,
             sliding_window=base.sliding_window,
+            kv_cache_dtype=base.kv_cache_dtype,
         )
 
 

@@ -59,6 +59,11 @@ def build_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
       ``weight_decay_rate`` would apply ``wd*param`` per step unscaled:
       the schema default 0.1 would shrink params 10%/step and destroy
       training). ``max_grad_norm`` still applies (outer clip).
+    * ``"lion"`` — sign-momentum (Chen et al. 2023): HALF the optimizer
+      state of AdamW (one moment, no second), updates are ±lr·sign —
+      bf16-friendly magnitudes. Published recipe: ~3-10x lower lr and
+      ~3-10x higher weight_decay than AdamW for the same effective
+      decay strength (wd is lr-scaled here, same decoupled semantics).
     """
     name = str(cfg.extra.get("optimizer", "adamw"))
     ema_decay = cfg.extra.get("ema_decay")
@@ -88,10 +93,17 @@ def build_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
             opt = optax.chain(
                 opt, _scheduled_decoupled_decay(cfg.weight_decay, schedule)
             )
+    elif name == "lion":
+        opt = optax.lion(
+            learning_rate=schedule,
+            b1=0.9,
+            b2=0.99,
+            weight_decay=cfg.weight_decay,
+        )
     else:
         raise ValueError(
-            f"trainer.extra.optimizer {name!r} unknown; expected 'adamw' "
-            "or 'adafactor'"
+            f"trainer.extra.optimizer {name!r} unknown; expected 'adamw', "
+            "'adafactor', or 'lion'"
         )
     parts = [optax.clip_by_global_norm(cfg.max_grad_norm), opt]
     if ema_decay is not None:

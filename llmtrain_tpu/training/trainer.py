@@ -490,6 +490,10 @@ class Trainer:
         # boundary already syncs on the interval's last loss, so the
         # one-byte collective costs nothing extra.
         preempted = False
+        # Distinct sentinel, not `old_term is None`: signal.signal()
+        # legitimately returns None when the previous handler was
+        # installed by C code, and that handler must be restored too.
+        handler_installed = False
         old_term = None
         multi_process = (
             self._dist_state is not None and self._dist_state.num_processes > 1
@@ -501,6 +505,7 @@ class Trainer:
 
         if threading.current_thread() is threading.main_thread():
             old_term = signal.signal(signal.SIGTERM, _on_sigterm)
+            handler_installed = True
 
         past_end_loss: float | None = None
         final_step_override: int | None = None
@@ -597,8 +602,15 @@ class Trainer:
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
             loop_completed = True
         finally:
-            if old_term is not None:
-                signal.signal(signal.SIGTERM, old_term)
+            if handler_installed:
+                # old_term None = the previous handler was installed by C
+                # code; Python cannot re-install it, but SIG_DFL at least
+                # keeps SIGTERM lethal instead of latched into our dead
+                # closure.
+                signal.signal(
+                    signal.SIGTERM,
+                    old_term if old_term is not None else signal.SIG_DFL,
+                )
             profiler.close(sync=step_loss_dev)
             if self._ckpt_mgr is not None:
                 # Final save must be durable. When an exception is unwinding

@@ -141,6 +141,34 @@ class TestAdafactor:
         assert adamw >= 2 * 256 * 512  # two dense moments
         assert adafactor < 256 * 512  # factored: ~n+m per matrix
 
+    def test_lion_loss_decreases_with_half_the_state(self):
+        """trainer.extra.optimizer: lion — sign-momentum, one moment."""
+        import jax
+        import jax.numpy as jnp
+
+        from llmtrain_tpu.config.schemas import TrainerConfig
+        from llmtrain_tpu.training.optimizer import build_optimizer
+
+        cfg = _cfg(trainer={"max_steps": 20, "lr": 1e-3,
+                            "extra": {"optimizer": "lion"}})
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.final_loss < res.first_step_loss
+
+        params = {"w": jnp.zeros((256, 512))}
+
+        def state_size(extra):
+            tx = build_optimizer(
+                TrainerConfig(max_steps=10, warmup_steps=0, extra=extra)
+            )
+            return sum(
+                int(np.prod(np.shape(leaf)))
+                for leaf in jax.tree.leaves(tx.init(params))
+                if hasattr(leaf, "shape")
+            )
+
+        # One moment vs AdamW's two.
+        assert state_size({"optimizer": "lion"}) <= state_size({}) - 256 * 512
+
     def test_resume_matches_continuous(self, tmp_path):
         """The factored optimizer state survives checkpoint save/resume
         with the flagship guarantee: 20 straight == 10 + resume 10."""
