@@ -522,6 +522,15 @@ class PipelineGPTAdapter(ModelAdapter):
             raise ValueError(
                 f"model.extra.sliding_window must be >= 0, got {sliding_window}"
             )
+        kv_cache_dtype = str(cfg.model.extra.get("kv_cache_dtype", "model"))
+        if kv_cache_dtype not in ("model", "int8"):
+            # Same config-time check as GPTAdapter: the pipeline model
+            # never decodes, so a typo would otherwise surface only at
+            # serve/generate conversion time, after the training run.
+            raise ValueError(
+                f"model.extra.kv_cache_dtype {kv_cache_dtype!r} unknown; "
+                "expected 'model' or 'int8'"
+            )
         return PipelineGPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -542,7 +551,7 @@ class PipelineGPTAdapter(ModelAdapter):
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             n_kv_heads=n_kv_heads,
             sliding_window=sliding_window,
-            kv_cache_dtype=str(cfg.model.extra.get("kv_cache_dtype", "model")),
+            kv_cache_dtype=kv_cache_dtype,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

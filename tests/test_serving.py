@@ -16,7 +16,6 @@ import urllib.request
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 from flax.linen import meta as nn_meta
 

@@ -78,7 +78,7 @@ def test_bench_family_smoke():
     proc = _run(["tools/bench_family.py", "--cpu-smoke", "--steps", "1"])
     assert proc.returncode == 0, proc.stderr
     rows = [json.loads(x) for x in proc.stdout.splitlines() if x.strip()]
-    assert {r.get("family") for r in rows} == {"gpt", "llama"}
+    assert {r.get("family") for r in rows} == {"gpt", "llama", "qwen2", "gemma"}
     assert all("error" not in r and r["tokens_per_sec"] > 0 for r in rows)
 
 

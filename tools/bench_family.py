@@ -1,4 +1,4 @@
-"""Model-family throughput cells: gpt vs llama at matched scale.
+"""Model-family throughput cells: gpt vs llama (vs qwen2 vs gemma).
 
 The llama family (models/llama.py) shares the attention kernels and the
 train step with gpt but differs where it costs: SwiGLU (3 MLP matmuls,
@@ -40,14 +40,15 @@ def _cell(family: str, *, cpu_smoke: bool, steps: int, batch: int) -> dict:
     if cpu_smoke:
         dims = dict(d_model=64, n_layers=2, n_heads=4, vocab_size=256)
         seq = 128
-        d_ff = 128 if family == "gpt" else 88
+        d_ff = 128 if family == "gpt" else 88  # gated MLPs: 3 matmuls
     else:
         dims = dict(d_model=768, n_layers=12, n_heads=12, vocab_size=50257)
         seq = 512
         # Matched MLP params: GELU 2·d·3072 ≈ SwiGLU 3·d·2048.
         d_ff = 3072 if family == "gpt" else 2048
     extra: dict = {"tokenizer": "byte"}
-    if family == "llama":
+    if family != "gpt":
+        # llama-stack families (llama/qwen2/gemma): GQA narrow K/V.
         extra["n_kv_heads"] = dims["n_heads"] // 3 if cpu_smoke else 4
     cfg = RunConfig.model_validate(
         {
@@ -104,7 +105,7 @@ def _cell(family: str, *, cpu_smoke: bool, steps: int, batch: int) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--families", default="gpt,llama")
+    ap.add_argument("--families", default="gpt,llama,qwen2,gemma")
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--batch", type=int, default=0, help="0 = auto per mode")
     ap.add_argument("--cpu-smoke", action="store_true")
