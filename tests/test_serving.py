@@ -149,6 +149,30 @@ class TestLiveServer:
         assert status == 200
         assert len(out["completion_ids"]) == 3
 
+    def test_concurrent_requests_serialize(self, server):
+        """Two simultaneous posts both succeed: the device lock queues
+        them instead of interleaving decodes."""
+        results = []
+
+        def post():
+            results.append(
+                self._post(
+                    server,
+                    {"prompt_ids": [1, 2], "max_new_tokens": 2,
+                     "temperature": 0.0},
+                )
+            )
+
+        threads = [threading.Thread(target=post) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert len(results) == 2
+        assert all(status == 200 for status, _ in results)
+        # Identical greedy requests: identical outputs.
+        assert results[0][1]["completion_ids"] == results[1][1]["completion_ids"]
+
     def test_bad_json_is_400(self, server):
         req = urllib.request.Request(
             server + "/v1/generate", data=b"{not json", method="POST"

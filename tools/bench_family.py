@@ -112,9 +112,9 @@ def main() -> None:
     args = ap.parse_args()
     batch = args.batch or (4 if args.cpu_smoke else 64)
     steps = min(args.steps, 3) if args.cpu_smoke else args.steps
-    for family in args.families.split(","):
+    for family in (f.strip() for f in args.families.split(",")):
         try:
-            print(json.dumps(_cell(family.strip(), cpu_smoke=args.cpu_smoke,
+            print(json.dumps(_cell(family, cpu_smoke=args.cpu_smoke,
                                    steps=steps, batch=batch)), flush=True)
         except Exception as exc:  # noqa: BLE001 — per-cell isolation
             print(json.dumps({"family": family, "error": str(exc)[:500]}),
