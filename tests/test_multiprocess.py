@@ -149,6 +149,42 @@ def test_two_process_data_parallel_train(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_tensor_axis_spans_processes(tmp_path):
+    """2-process run whose TENSOR axis covers all 8 devices: every
+    head/mlp/vocab matmul's psum crosses the process boundary (on real
+    hardware: DCN, the first pod-slice failure mode VERDICT r4 flagged
+    as untested). gpt with 8 heads so heads/mlp/vocab all shard 8-way."""
+    tp_cfg = {
+        **CFG,
+        "run": {"name": "mp-tp", "seed": 7, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 1,
+            "n_heads": 8,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+        },
+        "trainer": {**CFG["trainer"], "max_steps": 2, "save_every_steps": 2,
+                    "eval_every_steps": 2, "log_every_steps": 2},
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 120,
+            "mesh": {"data": -1, "fsdp": 1, "tensor": 8, "sequence": 1},
+        },
+    }
+    (tmp_path / "tp.yaml").write_text(yaml.safe_dump(tp_cfg))
+    outs = _launch_procs(tmp_path, "tp.yaml", "mp_tp")
+    for rc, _, err in outs:
+        assert rc == 0, f"tensor-spanning run failed: {err[-2000:]}"
+    result = _summary(outs)["train_result"]
+    assert result["final_step"] == 2
+    assert math.isfinite(result["final_loss"]) and result["final_loss"] > 0
+
+
+@pytest.mark.slow
 def test_two_process_fsdp_sharded_checkpoint_resume(tmp_path):
     """2-process GPT run with fsdp:2 spanning the process boundary: save at
     step 2, resume in fresh processes, final loss within 1e-5 of the
