@@ -185,6 +185,43 @@ def test_two_process_tensor_axis_spans_processes(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_sequence_axis_spans_processes(tmp_path):
+    """Ring attention with sequence=8 over 2 procs x 4 devices: the
+    shard-3 <-> shard-4 ppermute hop crosses the process boundary every
+    ring step (DCN on real hardware) — the sequence-parallel sibling of
+    the tensor-spanning case above."""
+    sp_cfg = {
+        **CFG,
+        "run": {"name": "mp-sp", "seed": 7, "device": "cpu", "deterministic": True},
+        "model": {
+            "name": "gpt",
+            "block_size": 8,
+            "d_model": 32,
+            "n_layers": 1,
+            "n_heads": 8,
+            "d_ff": 64,
+            "dropout": 0.0,
+            "vocab_size": 64,
+            "attention": "ring",
+        },
+        "trainer": {**CFG["trainer"], "max_steps": 2, "save_every_steps": 2,
+                    "eval_every_steps": 2, "log_every_steps": 2},
+        "distributed": {
+            "enabled": True,
+            "timeout_sec": 120,
+            "mesh": {"data": -1, "fsdp": 1, "tensor": 1, "sequence": 8},
+        },
+    }
+    (tmp_path / "sp.yaml").write_text(yaml.safe_dump(sp_cfg))
+    outs = _launch_procs(tmp_path, "sp.yaml", "mp_sp")
+    for rc, _, err in outs:
+        assert rc == 0, f"sequence-spanning run failed: {err[-2000:]}"
+    result = _summary(outs)["train_result"]
+    assert result["final_step"] == 2
+    assert math.isfinite(result["final_loss"]) and result["final_loss"] > 0
+
+
+@pytest.mark.slow
 def test_two_process_fsdp_sharded_checkpoint_resume(tmp_path):
     """2-process GPT run with fsdp:2 spanning the process boundary: save at
     step 2, resume in fresh processes, final loss within 1e-5 of the
