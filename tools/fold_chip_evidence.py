@@ -101,7 +101,7 @@ def main() -> None:
         ["batch", "n_kv_heads", "ms_per_token", "attribution_ms"],
     )
     handle(
-        "family.json", "Family cells (gpt vs llama)",
+        "family.json", "Family cells (gpt/llama/qwen2/gemma)",
         ["family", "tokens_per_sec", "mfu", "step_time_ms", "params"],
     )
     handle(
