@@ -1,4 +1,5 @@
-.PHONY: test test-all lint train-smoke train-multiproc bench chip-evidence mlflow \
+.PHONY: test test-all lint verify-resilience train-smoke train-multiproc bench \
+	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
@@ -10,6 +11,13 @@ test:
 
 test-serial:
 	python -m pytest tests/ -q -m "not slow"
+
+# Fast fault-injection suite: every resilience recovery path (non-finite
+# guard, spike rollback, checkpoint integrity, SIGTERM, retry) end to end.
+# These tests are deliberately unmarked so plain `make test` runs them too.
+verify-resilience:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+		tests/test_checkpoint.py tests/test_preemption.py -q -m "not slow"
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

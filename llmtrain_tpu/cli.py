@@ -1478,7 +1478,21 @@ def _handle_train(args: argparse.Namespace) -> int:
     configure_compilation_cache()
     dist_state: DistState | None = None
     if cfg.distributed.enabled:
-        dist_state = setup_distributed(cfg.distributed)
+        # Rendezvous against a coordinator that is still coming up (k8s pods
+        # start in arbitrary order) is retried with exponential backoff
+        # instead of failing the pod; the flaky() wrapper is the
+        # fault-injection hook exercising this path in tests.
+        from .resilience import FaultPlan, retry
+
+        plan = FaultPlan.from_config(cfg.resilience.faults)
+        dist_state = retry(
+            plan.flaky(
+                "distributed_init", lambda: setup_distributed(cfg.distributed)
+            ),
+            attempts=cfg.resilience.retry_attempts,
+            base_delay=cfg.resilience.retry_base_delay,
+            description="distributed init",
+        )
     is_main = dist_state is None or dist_state.is_main
 
     logger = get_logger()

@@ -9,11 +9,13 @@ from .loader import (
 from .schemas import (
     DataConfig,
     DistributedConfig,
+    FaultInjectionConfig,
     LoggingConfig,
     MeshConfig,
     MLflowConfig,
     ModelConfig,
     OutputConfig,
+    ResilienceConfig,
     RunConfig,
     RunSectionConfig,
     TrainerConfig,
@@ -23,11 +25,13 @@ __all__ = [
     "ConfigLoadError",
     "DataConfig",
     "DistributedConfig",
+    "FaultInjectionConfig",
     "LoggingConfig",
     "MeshConfig",
     "MLflowConfig",
     "ModelConfig",
     "OutputConfig",
+    "ResilienceConfig",
     "RunConfig",
     "RunSectionConfig",
     "TrainerConfig",

@@ -15,9 +15,14 @@ Parity target: reference ``src/llmtrain/config/schemas.py`` (8 frozen sections,
   (the reference has no mixed precision at all, SURVEY §2.4).
 """
 
-from typing import Any, Literal, Self
+from typing import Any, Literal
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+try:  # typing.Self is 3.11+; typing_extensions covers the 3.10 floor
+    from typing import Self
+except ImportError:  # pragma: no cover - exercised on 3.10 runtimes
+    from typing_extensions import Self
 
 _STRICT = ConfigDict(extra="forbid", frozen=True, validate_default=True)
 
@@ -184,6 +189,69 @@ class DistributedConfig(BaseModel):
     model_config = _STRICT
 
 
+class FaultInjectionConfig(BaseModel):
+    """Deterministic fault injection for exercising the recovery paths.
+
+    Every field defaults to "inject nothing" — production configs never set
+    these; tests and chaos drills do. Step-indexed faults use 1-based
+    optimizer-step numbering, matching the trainer's loop and log lines.
+    """
+
+    # Poison loss AND grads with NaN inside the jitted train step for
+    # ``nan_loss_steps`` consecutive optimizer steps starting at this one.
+    nan_loss_at_step: int | None = Field(None, ge=1)
+    nan_loss_steps: int = Field(1, ge=1)
+    # Scale the host-observed loss of exactly this step (one-shot, so the
+    # replayed step after a rollback is not re-poisoned).
+    spike_loss_at_step: int | None = Field(None, ge=1)
+    spike_loss_scale: float = Field(100.0, gt=1.0)
+    # Deliver SIGTERM to this process right after dispatching this step.
+    sigterm_at_step: int | None = Field(None, ge=1)
+    # After the checkpoint save at/after this step, damage the newest
+    # checkpoint file on disk (one-shot).
+    corrupt_checkpoint_at_step: int | None = Field(None, ge=1)
+    corrupt_mode: Literal["truncate", "garbage"] = "truncate"
+    # Make the first N attempts of these operations raise, to exercise the
+    # exponential-backoff retry() wiring.
+    dataset_load_failures: int = Field(0, ge=0)
+    distributed_init_failures: int = Field(0, ge=0)
+
+    model_config = _STRICT
+
+
+class ResilienceConfig(BaseModel):
+    """Fault-tolerance knobs (llmtrain_tpu/resilience/).
+
+    New subsystem over the reference, which has no recovery machinery at
+    all (SURVEY §5; PAPER.md §2.4 lists elastic recovery as absent): a
+    non-finite guard inside the jitted train step, a loss-spike detector
+    with checkpoint auto-rollback, and retry policy for flaky
+    initialization. Checkpoint sha-256 integrity sidecars are always on —
+    they need no configuration.
+    """
+
+    # Mask the optimizer update (optax apply_if_finite style) whenever loss
+    # or any gradient is non-finite; the step still advances so the data
+    # stream moves past the poisonous batch.
+    nonfinite_guard: bool = False
+    # Abort the run once this many CONSECUTIVE updates were skipped —
+    # persistent NaN means divergence, not a bad batch.
+    max_consecutive_nonfinite: int = Field(25, ge=1)
+    # Rolling-EWMA loss-spike detector; on a spike, restore the newest
+    # verified checkpoint and advance the sampler past the bad window.
+    spike_detection: bool = False
+    spike_factor: float = Field(4.0, gt=1.0)
+    spike_ewma_beta: float = Field(0.9, gt=0.0, lt=1.0)
+    spike_min_history: int = Field(20, ge=1)
+    max_rollbacks: int = Field(2, ge=0)
+    # Exponential-backoff retry for distributed init and dataset loading.
+    retry_attempts: int = Field(3, ge=1)
+    retry_base_delay: float = Field(0.05, ge=0.0)
+    faults: FaultInjectionConfig = Field(default_factory=FaultInjectionConfig)
+
+    model_config = _STRICT
+
+
 class MLflowConfig(BaseModel):
     """MLflow tracking options (reference schemas.py:123-136).
 
@@ -240,6 +308,7 @@ class RunConfig(BaseModel):
     data: DataConfig
     trainer: TrainerConfig
     distributed: DistributedConfig = Field(default_factory=DistributedConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)

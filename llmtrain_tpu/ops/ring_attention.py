@@ -140,12 +140,23 @@ def attention_shard_map(
         specs.append(
             P(_ax(dim_axes[0]), None if mask_replicated else _ax(dim_axes[1]))
         )
-    return jax.shard_map(
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=tuple(specs),
+            out_specs=spec,
+            check_vma=False,
+        )
+    # jax < 0.5: top-level alias and the check_vma spelling don't exist yet.
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(specs),
         out_specs=spec,
-        check_vma=False,
+        check_rep=False,
     )
 
 

@@ -232,8 +232,10 @@ def gpipe_apply(
         # scan carry types line up under shard_map's vma tracking.
         if hasattr(jax.lax, "pcast"):
             mark_varying = lambda a: jax.lax.pcast(a, (axis,), to="varying")  # noqa: E731
-        else:  # older jax spells it pvary
+        elif hasattr(jax.lax, "pvary"):  # older jax spells it pvary
             mark_varying = lambda a: jax.lax.pvary(a, (axis,))  # noqa: E731
+        else:  # pre-vma jax (< 0.5): no varying-type tracking to satisfy
+            mark_varying = lambda a: a  # noqa: E731
         # v == 1 never banks (round 0 reads fresh microbatches only), so the
         # return buffer shrinks to one slot; out-of-range dynamic indices
         # clamp per XLA semantics and the clamped reads are never selected.

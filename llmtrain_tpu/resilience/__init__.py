@@ -1,0 +1,25 @@
+"""Fault-tolerance layer: non-finite guard, loss-spike rollback, fault
+injection, and retry — see docs/robustness.md.
+
+The reference framework (and PAPER.md §2.4) has no elastic-recovery
+machinery: a NaN loss corrupts the optimizer state, a truncated checkpoint
+kills resume, a flaky rendezvous kills the pod. This package supplies the
+survivable-failure semantics production pre-training treats as table
+stakes, wired through config (``resilience:`` section), the jitted train
+step, the trainer loop, and the checkpoint manager — with every recovery
+path exercised end to end by the config-driven fault-injection harness.
+"""
+
+from .faults import FaultPlan, InjectedFault, retry
+from .guard import NonFiniteLossError, tree_all_finite
+from .spike import LossSpikeDetector, RollbackBudgetExceededError
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "LossSpikeDetector",
+    "NonFiniteLossError",
+    "RollbackBudgetExceededError",
+    "retry",
+    "tree_all_finite",
+]

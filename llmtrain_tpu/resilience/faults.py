@@ -1,0 +1,198 @@
+"""Config-driven fault injection + exponential-backoff retry.
+
+Recovery code that is never executed is recovery code that does not work.
+This module turns every failure mode the resilience layer claims to survive
+into a deterministic, config-driven injection so tier-1 tests (and chaos
+drills on real clusters) exercise the ACTUAL recovery paths end to end:
+
+* ``nan_loss_at_step`` — compiled into the jitted train step (see
+  ``training/train_step.py``): loss and grads are poisoned with NaN for a
+  window of optimizer steps, driving the real non-finite guard.
+* ``spike_loss_at_step`` — one-shot host-side scaling of the observed loss,
+  driving the real spike detector → checkpoint rollback. One-shot by
+  design: the replayed step after the rollback must not re-spike.
+* ``sigterm_at_step`` — ``os.kill(os.getpid(), SIGTERM)``, driving the real
+  preemption handler, durable save, and clean exit.
+* ``corrupt_checkpoint_at_step`` — truncates or garbles the newest
+  checkpoint file on disk after its save, driving sidecar verification,
+  ``latest_valid_checkpoint`` backward scan, and prune protection.
+* ``dataset_load_failures`` / ``distributed_init_failures`` — make the
+  first N attempts raise :class:`InjectedFault`, driving the
+  :func:`retry` wiring in the trainer and CLI.
+
+Everything defaults to "inject nothing"; a default-constructed plan is a
+set of cheap no-op calls in the trainer loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Callable, TypeVar
+
+from ..config.schemas import FaultInjectionConfig
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+T = TypeVar("T")
+
+
+class InjectedFault(RuntimeError):
+    """The exception every injected flaky-operation failure raises —
+    distinct from real errors so tests can assert the injection fired."""
+
+
+def retry(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 5.0,
+    description: str = "operation",
+    exceptions: tuple[type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``fn`` with exponential backoff: delays base, 2·base, 4·base, ...
+
+    capped at ``max_delay``. The final failure re-raises the original
+    exception unchanged so callers' error handling (CLI exit codes, test
+    asserts) sees the real cause, not a retry wrapper.
+    """
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except exceptions as exc:
+            if attempt == attempts:
+                raise
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            logger.warning(
+                "%s failed (attempt %d/%d: %s); retrying in %.2fs",
+                description,
+                attempt,
+                attempts,
+                exc,
+                delay,
+            )
+            if delay > 0:
+                sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+class FaultPlan:
+    """Mutable one-shot bookkeeping over a frozen FaultInjectionConfig."""
+
+    def __init__(self, cfg: FaultInjectionConfig | None) -> None:
+        self._cfg = cfg or FaultInjectionConfig()
+        self._sigterm_fired = False
+        self._corrupt_fired = False
+        self._spike_fired = False
+        self._flaky_counts: dict[str, int] = {}
+
+    @classmethod
+    def from_config(cls, cfg: FaultInjectionConfig | None) -> "FaultPlan":
+        return cls(cfg)
+
+    # ----------------------------------------------------------- train step
+
+    def nan_window(self) -> tuple[int, int] | None:
+        """(first poisoned optimizer step, window length) for the jitted
+        step, or None when NaN injection is off."""
+        if self._cfg.nan_loss_at_step is None:
+            return None
+        return (self._cfg.nan_loss_at_step, self._cfg.nan_loss_steps)
+
+    # ------------------------------------------------------------ host side
+
+    def maybe_sigterm(self, step: int) -> None:
+        """Deliver SIGTERM to ourselves once, at EXACTLY the configured step
+        — through the real OS signal path so the trainer's preemption
+        handler (and nothing else) turns it into a durable save. Exact
+        equality, not >=: a resumed run starting past the step must not
+        re-fire the injection."""
+        at = self._cfg.sigterm_at_step
+        if at is None or self._sigterm_fired or step != at:
+            return
+        self._sigterm_fired = True
+        logger.warning("fault injection: delivering SIGTERM at step %d", step)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def poison_host_losses(self, losses: Any, first_step: int) -> Any:
+        """Scale the configured step's host-observed loss (one-shot).
+
+        ``losses`` is the interval's loss vector; ``first_step`` is the
+        optimizer step its first entry belongs to. Returns the (possibly
+        copied and modified) vector.
+        """
+        at = self._cfg.spike_loss_at_step
+        if at is None or self._spike_fired:
+            return losses
+        idx = at - first_step
+        if 0 <= idx < len(losses):
+            self._spike_fired = True
+            losses = losses.copy()
+            losses[idx] = losses[idx] * self._cfg.spike_loss_scale
+            logger.warning(
+                "fault injection: scaled observed loss of step %d by x%g",
+                at,
+                self._cfg.spike_loss_scale,
+            )
+        return losses
+
+    def maybe_corrupt_checkpoint(self, step: int, ckpt_mgr: Any) -> None:
+        """Damage the newest checkpoint file after its save (one-shot).
+
+        Drains the manager's async write first so the damage lands on the
+        completed file, not a half-written tmp.
+        """
+        at = self._cfg.corrupt_checkpoint_at_step
+        if at is None or self._corrupt_fired or step < at or ckpt_mgr is None:
+            return
+        ckpt_mgr.wait_pending()
+        newest = ckpt_mgr.latest_checkpoint()
+        if newest is None:
+            return
+        self._corrupt_fired = True
+        data = newest.read_bytes()
+        if self._cfg.corrupt_mode == "truncate":
+            newest.write_bytes(data[: max(1, len(data) // 2)])
+        else:  # garbage: flip a swath of bytes mid-file
+            mid = len(data) // 2
+            newest.write_bytes(
+                data[:mid] + bytes(b ^ 0xFF for b in data[mid : mid + 64]) + data[mid + 64 :]
+            )
+        logger.warning(
+            "fault injection: %s newest checkpoint %s after step-%d save",
+            self._cfg.corrupt_mode + "d",
+            newest.name,
+            step,
+        )
+
+    # --------------------------------------------------------- flaky wiring
+
+    def flaky(self, kind: str, fn: Callable[[], T]) -> Callable[[], T]:
+        """Wrap ``fn`` so its first N calls raise InjectedFault, where N is
+        the configured failure count for ``kind`` ("dataset_load" or
+        "distributed_init"). With N == 0 the original callable is returned
+        untouched."""
+        budget = {
+            "dataset_load": self._cfg.dataset_load_failures,
+            "distributed_init": self._cfg.distributed_init_failures,
+        }.get(kind, 0)
+        if budget <= 0:
+            return fn
+
+        def wrapped() -> T:
+            used = self._flaky_counts.get(kind, 0)
+            if used < budget:
+                self._flaky_counts[kind] = used + 1
+                raise InjectedFault(
+                    f"injected {kind} failure {used + 1}/{budget}"
+                )
+            return fn()
+
+        return wrapped
+
+
+__all__ = ["FaultPlan", "InjectedFault", "retry"]

@@ -1,0 +1,45 @@
+"""Non-finite guard primitives for the jitted train step.
+
+A single NaN/Inf in the loss or gradients — an overflow in bf16 attention
+logits, a poisonous batch, a flaky chip — would otherwise flow through the
+optimizer and corrupt the params AND the Adam moments irreversibly. The
+guard computes one ``all_finite`` flag over loss and every gradient leaf and
+masks the whole optimizer update behind ``jax.lax.cond`` (the optax
+``apply_if_finite`` pattern): a skipped step keeps params/opt_state
+bit-identical while ``step`` still advances, so the deterministic sampler
+moves past the bad batch instead of re-feeding it forever.
+
+The trainer counts CONSECUTIVE skipped updates on device (a scalar in the
+TrainState, so the hot loop stays sync-free) and aborts with
+:class:`NonFiniteLossError` once the run of skips crosses the configured
+cap — persistent non-finiteness means divergence, not a bad batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by the trainer when ``max_consecutive_nonfinite`` optimizer
+    updates in a row had to be skipped by the non-finite guard."""
+
+
+def tree_all_finite(*trees: Any) -> jax.Array:
+    """Scalar bool: every leaf of every tree is fully finite.
+
+    Per-leaf ``isfinite().all()`` reductions are combined with ``&`` so XLA
+    fuses them into the step's existing epilogue; no host sync happens here.
+    """
+    flag = jnp.bool_(True)
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                flag = flag & jnp.isfinite(leaf).all()
+    return flag
+
+
+__all__ = ["NonFiniteLossError", "tree_all_finite"]

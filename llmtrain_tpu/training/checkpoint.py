@@ -19,6 +19,7 @@ where the reference's skip-ahead replay was single-process-only
 
 from __future__ import annotations
 
+import hashlib
 import re
 from pathlib import Path
 from typing import Any
@@ -32,6 +33,25 @@ from flax.linen import meta as nn_meta
 CHECKPOINT_VERSION = 1
 _STEP_RE = re.compile(r"^step_(\d{6,})\.ckpt$")
 _REQUIRED_KEYS = {"checkpoint_version", "step", "params", "opt_state", "config_yaml"}
+
+
+def sidecar_path(ckpt: Path) -> Path:
+    """``step_NNNNNN.ckpt`` → its ``step_NNNNNN.ckpt.sha256`` sidecar."""
+    return ckpt.with_name(ckpt.name + ".sha256")
+
+
+def _read_sidecar_digest(ckpt: Path) -> str | None:
+    """Hex digest recorded for ``ckpt``, or None when no sidecar exists.
+
+    Sidecar format is ``sha256sum`` output (``<hex>  <name>``) so integrity
+    is also checkable by hand: ``cd checkpoints && sha256sum -c *.sha256``.
+    """
+    side = sidecar_path(ckpt)
+    try:
+        first = side.read_text(encoding="utf-8").split()
+    except OSError:
+        return None
+    return first[0].lower() if first else None
 
 
 def _to_host(tree: Any) -> Any:
@@ -91,6 +111,10 @@ class CheckpointManager:
         self._keep_last_k = max(1, keep_last_k)
         self._pending: Any = None  # in-flight async write (Future)
         self._executor: Any = None
+        # Verification results keyed by (path, size, mtime_ns): pruning and
+        # rollback re-verify the same unchanged files every save; hashing a
+        # multi-GB checkpoint repeatedly would be pure waste.
+        self._verify_cache: dict[tuple[str, int, int], bool] = {}
 
     @property
     def directory(self) -> Path:
@@ -107,7 +131,12 @@ class CheckpointManager:
         return self.save_host(step, host_state, resolved_config)
 
     def save_host(
-        self, step: int, host_state: dict[str, Any], resolved_config: dict[str, Any]
+        self,
+        step: int,
+        host_state: dict[str, Any],
+        resolved_config: dict[str, Any],
+        *,
+        resilience: dict[str, Any] | None = None,
     ) -> Path:
         self._dir.mkdir(parents=True, exist_ok=True)
         payload = {
@@ -117,15 +146,40 @@ class CheckpointManager:
             "opt_state": host_state["opt_state"],
             "config_yaml": yaml.safe_dump(resolved_config, sort_keys=False),
         }
+        if resilience:
+            # Optional small scalar dict (guard skip counter, rollback
+            # bookkeeping, spike-detector EWMA) — not in _REQUIRED_KEYS, so
+            # checkpoints stay readable both ways across versions.
+            payload["resilience"] = {k: np.asarray(v) for k, v in resilience.items()}
         target = self._dir / f"step_{step:06d}.ckpt"
+        blob = serialization.msgpack_serialize(payload)
+        digest = hashlib.sha256(blob).hexdigest()
         tmp = target.with_suffix(".ckpt.tmp")
-        tmp.write_bytes(serialization.msgpack_serialize(payload))
+        tmp.write_bytes(blob)
+        # Re-saving a step (rollback replay): drop the stale sidecar BEFORE
+        # the payload rename, so no crash window pairs the new payload with
+        # the old digest — absent sidecar degrades to deep-parse verify.
+        sidecar_path(target).unlink(missing_ok=True)
         tmp.replace(target)
+        # Sidecar AFTER the payload rename: a crash between the two leaves a
+        # checkpoint without a sidecar (verified by deep parse), never a
+        # sidecar pointing at a half-written file.
+        side = sidecar_path(target)
+        side_tmp = side.with_name(side.name + ".tmp")
+        side_tmp.write_text(f"{digest}  {target.name}\n", encoding="utf-8")
+        side_tmp.replace(side)
+        stat = target.stat()
+        self._verify_cache[(str(target), stat.st_size, stat.st_mtime_ns)] = True
         self._prune()
         return target
 
     def save_host_async(
-        self, step: int, host_state: dict[str, Any], resolved_config: dict[str, Any]
+        self,
+        step: int,
+        host_state: dict[str, Any],
+        resolved_config: dict[str, Any],
+        *,
+        resilience: dict[str, Any] | None = None,
     ) -> None:
         """Queue ``save_host`` on a background thread (one write in flight).
 
@@ -144,7 +198,7 @@ class CheckpointManager:
                 max_workers=1, thread_name_prefix="ckpt-write"
             )
         self._pending = self._executor.submit(
-            self.save_host, step, host_state, resolved_config
+            self.save_host, step, host_state, resolved_config, resilience=resilience
         )
 
     def poll(self) -> None:
@@ -174,9 +228,77 @@ class CheckpointManager:
                 executor.shutdown(wait=True)
 
     def _prune(self) -> None:
+        """Keep the last k checkpoints by step — but NEVER delete the newest
+        VERIFIED one. Retention keyed on file count alone would, with a
+        corrupt newest file, delete the only restorable checkpoint and leave
+        the run with nothing but garbage to resume from."""
         ckpts = self.all_checkpoints()
-        for path in ckpts[: -self._keep_last_k]:
+        doomed = ckpts[: -self._keep_last_k]
+        if not doomed:
+            return
+        newest_valid = next(
+            (p for p in reversed(ckpts) if self.verify(p)), None
+        )
+        for path in doomed:
+            if path == newest_valid:
+                continue
             path.unlink(missing_ok=True)
+            sidecar_path(path).unlink(missing_ok=True)
+
+    def verify(self, path: str | Path) -> bool:
+        """True when ``path`` is a restorable checkpoint.
+
+        With a sha-256 sidecar present the file digest must match; without
+        one (pre-integrity checkpoints, or a crash between payload and
+        sidecar rename) fall back to a deep parse — msgpack restore plus the
+        required-key check. Results are cached by (path, size, mtime).
+        """
+        path = Path(path)
+        try:
+            stat = path.stat()
+        except OSError:
+            return False
+        key = (str(path), stat.st_size, stat.st_mtime_ns)
+        cached = self._verify_cache.get(key)
+        if cached is not None:
+            return cached
+        ok = _verify_uncached(path)
+        self._verify_cache[key] = ok
+        return ok
+
+    def latest_valid_checkpoint(self, *, before_step: int | None = None) -> Path | None:
+        """Newest checkpoint that passes :meth:`verify`, scanning backward
+        past truncated/corrupt files (each skip logs a warning).
+
+        ``before_step`` restricts the scan to checkpoints saved strictly
+        before that step — the loss-spike rollback uses it so a periodic
+        save that landed inside the spiking window (valid by integrity,
+        poisoned by value) cannot become the restore point; with the
+        restriction active, no fallback applies and None means "nothing
+        restorable".
+
+        Unrestricted scans where NO file verifies fall back to the plain
+        newest so legacy layouts and hand-assembled dirs still resolve — a
+        genuinely broken file then fails at ``load`` with a precise error.
+        """
+        ckpts = self.all_checkpoints()
+        if before_step is not None:
+            ckpts = [
+                p for p in ckpts if int(_STEP_RE.match(p.name).group(1)) < before_step
+            ]
+        for path in reversed(ckpts):
+            if self.verify(path):
+                return path
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "checkpoint %s failed integrity verification; "
+                "falling back to the previous one",
+                path,
+            )
+        if before_step is not None:
+            return None
+        return ckpts[-1] if ckpts else None
 
     def all_checkpoints(self) -> list[Path]:
         """Checkpoints sorted by parsed step number, oldest first."""
@@ -195,17 +317,60 @@ class CheckpointManager:
 
     @staticmethod
     def load(path: str | Path) -> dict[str, Any]:
-        """Read and validate a checkpoint payload (host numpy trees)."""
+        """Read and validate a checkpoint payload (host numpy trees).
+
+        When a sha-256 sidecar exists the file content is verified against
+        it first, so a truncated or bit-flipped checkpoint fails with a
+        precise integrity error instead of a deep msgpack traceback (or —
+        worse — silently restoring garbage arrays).
+        """
         path = Path(path)
         if not path.is_file():
             raise CheckpointError(f"Checkpoint file not found: {path}")
-        payload = serialization.msgpack_restore(path.read_bytes())
+        blob = path.read_bytes()
+        expected = _read_sidecar_digest(path)
+        if expected is not None:
+            actual = hashlib.sha256(blob).hexdigest()
+            if actual != expected:
+                raise CheckpointError(
+                    f"Checkpoint {path} failed sha-256 integrity verification "
+                    f"(expected {expected[:12]}…, got {actual[:12]}…): the file "
+                    "is truncated or corrupt"
+                )
+        try:
+            payload = serialization.msgpack_restore(blob)
+        except Exception as exc:
+            raise CheckpointError(
+                f"Checkpoint {path} is not a parseable msgpack payload "
+                f"(truncated or corrupt): {exc}"
+            ) from exc
+        if not isinstance(payload, dict):
+            raise CheckpointError(
+                f"Checkpoint {path} does not hold a payload mapping"
+            )
         missing = _REQUIRED_KEYS - set(payload)
         if missing:
             raise CheckpointError(
                 f"Checkpoint {path} is missing required keys: {sorted(missing)}"
             )
         return payload
+
+
+def _verify_uncached(path: Path) -> bool:
+    """One verification pass: sidecar digest when present, deep parse
+    (msgpack restore + required keys) otherwise."""
+    try:
+        blob = path.read_bytes()
+    except OSError:
+        return False
+    expected = _read_sidecar_digest(path)
+    if expected is not None:
+        return hashlib.sha256(blob).hexdigest() == expected
+    try:
+        payload = serialization.msgpack_restore(blob)
+    except Exception:
+        return False
+    return isinstance(payload, dict) and not (_REQUIRED_KEYS - set(payload))
 
 
 def load_inference_params(
@@ -301,20 +466,27 @@ def warn_on_config_mismatch(
 def resolve_resume_path(resume_spec: str, output_root: str | Path) -> Path:
     """Resolve a ``--resume`` spec (reference trainer.py:215-241).
 
-    file → itself; dir → latest inside (falling back to the dir's
+    file → itself; dir → newest VALID inside (falling back to the dir's
     ``checkpoints/`` subdir, so a run DIRECTORY path works like its run
     id); bare ``*.ckpt``/``*.pt`` string → FileNotFoundError; anything
     else → treated as a run id under ``{output_root}/{run_id}/checkpoints``.
+
+    Directory and run-id resolution go through ``latest_valid_checkpoint``:
+    a run whose newest checkpoint was truncated by a mid-write eviction
+    warns and resumes from the previous verified one instead of dying
+    mid-restore — the auto-resume loop must never wedge on its own save.
     """
     candidate = Path(resume_spec)
     if candidate.is_file():
         return candidate
     if candidate.is_dir():
-        latest = CheckpointManager(candidate).latest_checkpoint()
+        latest = CheckpointManager(candidate).latest_valid_checkpoint()
         if latest is None and (candidate / "checkpoints").is_dir():
             # A run DIRECTORY (not just a run id): descend into its
             # checkpoints/ subdir, same shape as the run-id branch below.
-            latest = CheckpointManager(candidate / "checkpoints").latest_checkpoint()
+            latest = CheckpointManager(
+                candidate / "checkpoints"
+            ).latest_valid_checkpoint()
         if latest is None:
             raise FileNotFoundError(f"No checkpoints found in directory: {candidate}")
         return latest
@@ -326,7 +498,7 @@ def resolve_resume_path(resume_spec: str, output_root: str | Path) -> Path:
             f"Resume spec {resume_spec!r} is neither a file, a directory, "
             f"nor a run id with checkpoints under {run_ckpt_dir}"
         )
-    latest = CheckpointManager(run_ckpt_dir).latest_checkpoint()
+    latest = CheckpointManager(run_ckpt_dir).latest_valid_checkpoint()
     if latest is None:
         raise FileNotFoundError(f"No checkpoints found for run id {resume_spec!r}")
     return latest

@@ -32,11 +32,16 @@ from ..models.base import ModelAdapter
 class TrainState:
     """Pytree holding everything the step updates. ``step`` counts completed
     optimizer steps (0 = fresh init); training step N uses LR multiplier
-    schedule(N-1), matching the reference's post-step LambdaLR."""
+    schedule(N-1), matching the reference's post-step LambdaLR.
+
+    ``nonfinite_count`` is the non-finite guard's consecutive-skip counter
+    (resilience/guard.py) — an int32 scalar when the guard is enabled, None
+    otherwise so unguarded runs keep the exact seed pytree structure."""
 
     step: jax.Array
     params: Any
     opt_state: Any
+    nonfinite_count: Any = None
 
 
 def create_train_state(params: Any, tx: optax.GradientTransformation) -> TrainState:
@@ -82,8 +87,22 @@ def make_train_step(
     *,
     grad_accum_steps: int,
     use_dropout: bool,
+    nonfinite_guard: bool = False,
+    inject_nan_window: tuple[int, int] | None = None,
 ) -> Callable:
-    """Build the pure train step: (state, batch(A,B,T), run_key) -> (state, metrics)."""
+    """Build the pure train step: (state, batch(A,B,T), run_key) -> (state, metrics).
+
+    ``nonfinite_guard`` masks the optimizer update behind ``lax.cond`` on an
+    all-finite flag over loss and grads (resilience/guard.py): a non-finite
+    step leaves params/opt_state untouched, advances ``step`` (so the
+    deterministic sampler moves past the bad batch), and bumps the
+    consecutive-skip counter the trainer aborts on.
+
+    ``inject_nan_window=(start, n)`` is the fault-injection hook
+    (resilience/faults.py): loss and grads are poisoned with NaN for
+    optimizer steps ``start .. start+n-1``, compiled into the step so the
+    guard's recovery is exercised inside the real XLA program.
+    """
     loss_fn = make_loss_fn(adapter, model, use_dropout=use_dropout)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -104,19 +123,63 @@ def make_train_step(
         )
         grads = jax.tree.map(lambda g: g / grad_accum_steps, grads_sum)
 
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
-        )
+        if inject_nan_window is not None:
+            first, length = inject_nan_window
+            current = state.step + 1  # 1-based optimizer step being taken
+            in_window = (current >= first) & (current < first + length)
+            poison = jnp.where(in_window, jnp.float32(jnp.nan), jnp.float32(1.0))
+            grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
+            losses = losses * poison.astype(losses.dtype)
+
         metrics = {
             # mean over accum steps of per-micro-batch token-weighted means,
             # matching reference step_loss (trainer.py:389).
             "loss": jnp.mean(losses),
-            "grad_norm": optax.global_norm(grads),
             "per_example_loss_sum": loss_sums,  # (A, B)
             "per_example_tokens": token_counts,  # (A, B)
         }
+
+        if nonfinite_guard:
+            from ..resilience.guard import tree_all_finite
+
+            all_finite = tree_all_finite(grads) & jnp.isfinite(losses).all()
+
+            def _apply(operand):
+                g, opt_state, params = operand
+                updates, new_opt = tx.update(g, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            def _skip(operand):
+                _, opt_state, params = operand
+                return params, opt_state
+
+            # lax.cond, not a per-leaf where-select: the skip branch must
+            # not evaluate tx.update at all — optax transforms divide by
+            # grad moments and a NaN would infect the selected-away branch
+            # under value-level masking.
+            new_params, new_opt_state = jax.lax.cond(
+                all_finite, _apply, _skip, (grads, state.opt_state, state.params)
+            )
+            prev = state.nonfinite_count
+            if prev is None:
+                prev = jnp.zeros((), jnp.int32)
+            new_count = jnp.where(all_finite, 0, prev + 1).astype(jnp.int32)
+            # grad_norm of NaN grads is NaN — honest, and only read at log
+            # boundaries.
+            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["nonfinite_count"] = new_count
+        else:
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_count = state.nonfinite_count
+            metrics["grad_norm"] = optax.global_norm(grads)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            nonfinite_count=new_count,
+        )
         return new_state, metrics
 
     return train_step

@@ -44,6 +44,13 @@ from ..parallel.sharding import (
     state_shardings,
 )
 from ..registry import get_data_module
+from ..resilience import (
+    FaultPlan,
+    LossSpikeDetector,
+    NonFiniteLossError,
+    RollbackBudgetExceededError,
+    retry,
+)
 from ..tracking.base import Tracker
 from ..utils.hw import mfu as compute_mfu
 from ..utils.hw import peak_flops_per_chip
@@ -73,6 +80,9 @@ class TrainResult:
     # True when SIGTERM cut the run short: the last checkpoint is the
     # preemption save and final_step is where training actually stopped.
     preempted: bool = False
+    # Loss-spike rollbacks performed (cumulative across resumes — the
+    # counter round-trips through the checkpoint's resilience payload).
+    rollbacks: int = 0
 
 
 class Trainer:
@@ -94,12 +104,33 @@ class Trainer:
         self._adapter = build_adapter(cfg)
         self._data_module = get_data_module(cfg.data.name)()
 
+        # Fault-tolerance wiring (resilience/, docs/robustness.md): the
+        # fault plan is inert unless the config injects something; rollback
+        # bookkeeping lives on the instance so checkpoint saves can
+        # round-trip it.
+        self._resilience = cfg.resilience
+        self._faults = FaultPlan.from_config(cfg.resilience.faults)
+        self._rollback_count = 0
+        self._data_offset = 0
+        self._spike_detector: LossSpikeDetector | None = None
+        self._last_restored_resilience: dict[str, Any] = {}
+
         tokenizer = None
         try:
             tokenizer = self._adapter.build_tokenizer(cfg)
         except Exception as exc:  # offline environments: tokenizer optional
             logger.warning("build_tokenizer failed (%s); continuing without one", exc)
-        self._data_module.setup(cfg, tokenizer)
+        # Dataset loading is the one init stage that touches network/disk
+        # caches — transient failures (HF hub hiccup, NFS blip) get
+        # exponential-backoff retries instead of killing the pod.
+        retry(
+            self._faults.flaky(
+                "dataset_load", lambda: self._data_module.setup(cfg, tokenizer)
+            ),
+            attempts=cfg.resilience.retry_attempts,
+            base_delay=cfg.resilience.retry_base_delay,
+            description="dataset setup",
+        )
 
         self._model = self._adapter.build_model(cfg)
 
@@ -163,6 +194,8 @@ class Trainer:
                 self._tx,
                 grad_accum_steps=cfg.trainer.grad_accum_steps,
                 use_dropout=use_dropout,
+                nonfinite_guard=cfg.resilience.nonfinite_guard,
+                inject_nan_window=self._faults.nan_window(),
             ),
             donate_argnums=(0,),
             out_shardings=(self._state_shardings, replicated(self._mesh)),
@@ -214,6 +247,14 @@ class Trainer:
                 step=jnp.zeros((), jnp.int32),
                 params=params,
                 opt_state=self._tx.init(params),
+                # The guard's consecutive-skip counter rides in the state so
+                # the hot loop never syncs on it; None keeps unguarded runs'
+                # pytree structure identical to the pre-resilience layout.
+                nonfinite_count=(
+                    jnp.zeros((), jnp.int32)
+                    if cfg.resilience.nonfinite_guard
+                    else None
+                ),
             )
 
         abstract = jax.eval_shape(create, init_rng)
@@ -245,9 +286,15 @@ class Trainer:
     # ------------------------------------------------------------------ data
 
     def _global_batch(self, sampler: DeterministicSampler, dataset, step: int) -> dict:
-        """Assemble the (A, Bg, T) sharded global batch for optimizer step ``step``."""
+        """Assemble the (A, Bg, T) sharded global batch for optimizer step ``step``.
+
+        ``_data_offset`` (normally 0) shifts the deterministic stream after a
+        loss-spike rollback: the replayed steps consume the batches that
+        FOLLOW the poisonous window instead of re-feeding it. The offset
+        round-trips through the checkpoint so resume stays exact.
+        """
         accum = self._cfg.trainer.grad_accum_steps
-        base_index = (step - 1) * accum
+        base_index = (step - 1) * accum + self._data_offset
         keys, seqlen = self._dataset_spec(dataset)
         sharding = batch_sharding(self._mesh, with_accum_dim=True)
 
@@ -445,9 +492,29 @@ class Trainer:
             shuffle=not cfg.run.deterministic,
         )
 
+        res_cfg = self._resilience
+        self._spike_detector = (
+            LossSpikeDetector(
+                factor=res_cfg.spike_factor,
+                beta=res_cfg.spike_ewma_beta,
+                min_history=res_cfg.spike_min_history,
+            )
+            if res_cfg.spike_detection
+            else None
+        )
+        self._rollback_count = 0
+        self._data_offset = 0
+
         resumed_from_step: int | None = None
         if resume_from is not None:
             resumed_from_step = self._restore(resume_from)
+            # Rollback/sampler bookkeeping and the spike detector's trend
+            # continue exactly where the checkpointed run left them.
+            resil = self._last_restored_resilience
+            self._rollback_count = int(resil.get("rollback_count", 0))
+            self._data_offset = int(resil.get("data_offset", 0))
+            if self._spike_detector is not None:
+                self._spike_detector.load_state(resil)
         start_step = (resumed_from_step or 0) + 1
         if start_step > max_steps:
             logger.warning(
@@ -456,7 +523,8 @@ class Trainer:
                 max_steps,
             )
 
-        run_key = jax.random.key(cfg.run.seed)
+        base_run_key = jax.random.key(cfg.run.seed)
+        run_key = self._active_run_key(base_run_key)
         self._train_seqlen = self._probe_seqlen(train_ds)
         tokens_per_step = accum * self._global_micro * self._train_seqlen
         profiler = _StepProfiler(cfg, self._run_dir if self._is_main else None)
@@ -498,6 +566,17 @@ class Trainer:
         multi_process = (
             self._dist_state is not None and self._dist_state.num_processes > 1
         )
+        if self._spike_detector is not None and multi_process:
+            # Rollback needs every rank to restore the same file, but only
+            # the main rank owns a checkpoint manager — a main-only rollback
+            # would deadlock the next collective. Single-process (the k8s
+            # one-pod story) is where auto-rollback operates today.
+            logger.warning(
+                "spike rollback is single-process only for now; disabling "
+                "the detector on this %d-process run",
+                self._dist_state.num_processes,
+            )
+            self._spike_detector = None
 
         def _on_sigterm(signum, frame):  # pragma: no cover - exercised via kill
             nonlocal preempted
@@ -519,13 +598,20 @@ class Trainer:
                     past_end_loss = self._restored_step_loss(
                         sampler, train_ds, resumed_from_step
                     )
-                for step in range(start_step, max_steps + 1):
+                nonfinite_dev = None
+                step = start_step - 1
+                while step < max_steps:
+                    step += 1
                     profiler.maybe_start(step)
                     batch = self._global_batch(sampler, train_ds, step)
                     self._state, metrics = self._train_step_fn(self._state, batch, run_key)
                     profiler.maybe_stop(step, sync=metrics["loss"])
+                    # Injected preemption goes through the real OS signal
+                    # path, so everything below sees a genuine SIGTERM.
+                    self._faults.maybe_sigterm(step)
 
                     step_loss_dev = metrics["loss"]
+                    nonfinite_dev = metrics.get("nonfinite_count")
                     interval_losses.append(metrics["loss"])
                     interval_shard.append(
                         (metrics["per_example_loss_sum"], metrics["per_example_tokens"])
@@ -552,6 +638,7 @@ class Trainer:
                     stop_now = stop_now and step < max_steps
                     if step % save_every == 0 or step == max_steps or stop_now:
                         self._save_checkpoint(step)
+                        self._faults.maybe_corrupt_checkpoint(step, self._ckpt_mgr)
 
                     if stop_now:
                         if self._ckpt_mgr is not None:
@@ -579,12 +666,38 @@ class Trainer:
                         # tokens_per_sec/mfu are nonsense. (device_get, not
                         # block_until_ready: on remote-tunnel platforms the
                         # latter can return before execution finishes.)
-                        jax.device_get(metrics["loss"])
+                        losses_host = np.asarray(
+                            jax.device_get(jnp.stack(interval_losses))
+                        )
+                        first_interval_step = step - len(interval_losses) + 1
+                        losses_host = self._faults.poison_host_losses(
+                            losses_host, first_interval_step
+                        )
+                        self._check_nonfinite_guard(nonfinite_dev, losses_host, step)
+                        rolled_back_to = self._maybe_rollback(
+                            losses_host, first_interval_step, step
+                        )
+                        if rolled_back_to is not None:
+                            # Replay from the restored step with the sampler
+                            # advanced past the bad window and a fresh
+                            # rollback-folded RNG stream. Rewind the token
+                            # odometer so it stays consistent with what a
+                            # resume from the restored step would report.
+                            total_tokens -= (step - rolled_back_to) * tokens_per_step
+                            run_key = self._active_run_key(base_run_key)
+                            interval_losses = []
+                            interval_shard = []
+                            interval_tokens = 0
+                            interval_start = time.perf_counter()
+                            step_loss_dev = None
+                            nonfinite_dev = None
+                            step = rolled_back_to
+                            continue
                         interval_time = time.perf_counter() - interval_start
                         self._log_train_interval(
                             step=step,
                             max_steps=max_steps,
-                            interval_losses=interval_losses,
+                            losses_host=losses_host,
                             interval_shard=interval_shard,
                             interval_tokens=interval_tokens,
                             interval_time=interval_time,
@@ -649,10 +762,152 @@ class Trainer:
             trainable_parameter_count=self._trainable_count,
             total_tokens=total_tokens,
             preempted=final_step_override is not None,
+            rollbacks=self._rollback_count,
         )
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]
+
+    # ------------------------------------------------------------ resilience
+
+    def _active_run_key(self, base_run_key: jax.Array) -> jax.Array:
+        """The RNG key the train step folds per-step keys from.
+
+        With zero rollbacks this is exactly the seed key (bit-compatible
+        with pre-resilience runs); each rollback folds the rollback count in
+        so replayed steps draw fresh dropout streams alongside their fresh
+        batches."""
+        if self._rollback_count == 0:
+            return base_run_key
+        return jax.random.fold_in(base_run_key, self._rollback_count)
+
+    def _check_nonfinite_guard(
+        self, nonfinite_dev, losses_host: np.ndarray, step: int
+    ) -> None:
+        """Boundary-cadence guard bookkeeping: warn about skipped updates in
+        the interval, abort once the consecutive-skip cap is crossed.
+
+        Runs where the losses already synced to host, so it adds no device
+        round-trips beyond the scalar counter."""
+        if nonfinite_dev is None:
+            return
+        consecutive = int(jax.device_get(nonfinite_dev))
+        # Non-finite host losses catch mid-interval skips; the device
+        # counter catches the finite-loss/non-finite-grads case (bf16
+        # backward overflow) the loss vector cannot see.
+        skipped = max(
+            int(np.count_nonzero(~np.isfinite(losses_host))),
+            min(consecutive, len(losses_host)),
+        )
+        if skipped:
+            logger.warning(
+                "non-finite loss/grads: %d optimizer update(s) skipped by the "
+                "guard in the last %d step(s)",
+                skipped,
+                len(losses_host),
+            )
+        cap = self._resilience.max_consecutive_nonfinite
+        if consecutive >= cap:
+            raise NonFiniteLossError(
+                f"aborting at step {step}: {consecutive} consecutive optimizer "
+                f"updates were non-finite (cap {cap}) — the run has diverged; "
+                "params/opt_state are untouched since the last finite step and "
+                "the newest checkpoint remains restorable"
+            )
+
+    def _maybe_rollback(
+        self, losses_host: np.ndarray, first_interval_step: int, step: int
+    ) -> int | None:
+        """Feed the interval's losses to the spike detector; on a spike,
+        restore the newest verified checkpoint saved BEFORE the spiking step
+        and advance the data stream past the consumed window.
+
+        Returns the restored step (the loop replays from there), or None.
+        """
+        detector = self._spike_detector
+        if detector is None:
+            return None
+        spike_step = None
+        spike_loss = trend = None
+        for i, value in enumerate(np.asarray(losses_host)):
+            if detector.observe(float(value)):
+                spike_step = first_interval_step + i
+                spike_loss, trend = float(value), detector.trend
+                break
+        if spike_step is None:
+            return None
+        if self._ckpt_mgr is None:
+            logger.error(
+                "loss spike at step %d (%.4f vs trend %.4f) but no checkpoint "
+                "manager on this process; spike rollback disabled for the "
+                "rest of the run",
+                spike_step,
+                spike_loss,
+                trend or 0.0,
+            )
+            self._spike_detector = None
+            return None
+        if self._rollback_count >= self._resilience.max_rollbacks:
+            raise RollbackBudgetExceededError(
+                f"loss spike at step {spike_step} ({spike_loss:.4f} vs trend "
+                f"{trend:.4f}) after exhausting the rollback budget "
+                f"({self._resilience.max_rollbacks}) — the run diverges "
+                "deterministically; change the config instead of retrying"
+            )
+        # The rollback target must PREDATE the spike: a periodic save can
+        # land inside a spiking interval, and that checkpoint — valid by
+        # integrity, poisoned by value — must not become the restore point.
+        self._ckpt_mgr.wait_pending()
+        target = self._ckpt_mgr.latest_valid_checkpoint(before_step=spike_step)
+        if target is None:
+            # Early spike, before the first periodic save: nothing to
+            # restore, so train through it (same stance as the
+            # no-checkpoint-manager path above — a missing restore point
+            # must not kill a run that would otherwise continue).
+            logger.warning(
+                "loss spike at step %d (%.4f vs trend %.4f) but no verified "
+                "checkpoint predates it; continuing without rollback",
+                spike_step,
+                spike_loss,
+                trend or 0.0,
+            )
+            return None
+        restored_step = self._restore(str(target))
+        accum = self._cfg.trainer.grad_accum_steps
+        # Accumulate onto the LIVE offset, not the checkpoint's stored one:
+        # a second rollback landing on a checkpoint that predates the first
+        # must keep advancing the stream, not rewind onto the
+        # already-consumed window.
+        self._data_offset += (step - restored_step) * accum
+        self._rollback_count += 1
+        logger.warning(
+            "loss spike at step %d (%.4f vs trend %.4f): rolled back to "
+            "checkpoint step %d (rollback %d/%d); sampler advanced %d "
+            "micro-batches past the bad window",
+            spike_step,
+            spike_loss,
+            trend or 0.0,
+            restored_step,
+            self._rollback_count,
+            self._resilience.max_rollbacks,
+            (step - restored_step) * accum,
+        )
+        return restored_step
+
+    def _resilience_payload(self) -> dict[str, Any] | None:
+        """Small scalar dict saved alongside the state so guard counter,
+        rollback bookkeeping, and the spike detector's trend survive
+        preemption + resume."""
+        out: dict[str, Any] = {}
+        if self._state.nonfinite_count is not None:
+            out["nonfinite_count"] = int(jax.device_get(self._state.nonfinite_count))
+        if self._rollback_count:
+            out["rollback_count"] = self._rollback_count
+        if self._data_offset:
+            out["data_offset"] = self._data_offset
+        if self._spike_detector is not None:
+            out.update(self._spike_detector.state())
+        return out or None
 
     def _save_checkpoint(self, step: int) -> None:
         """Host-gather on every process (collective for multi-host sharded
@@ -668,7 +923,12 @@ class Trainer:
         if self._ckpt_mgr is not None and self._is_main:
             # Async: msgpack + disk IO overlap the next steps (the collective
             # device→host gather above already completed synchronously).
-            self._ckpt_mgr.save_host_async(step, host_state, self._cfg.model_dump())
+            self._ckpt_mgr.save_host_async(
+                step,
+                host_state,
+                self._cfg.model_dump(),
+                resilience=self._resilience_payload(),
+            )
 
     # ------------------------------------------------------------------ metrics
 
@@ -692,7 +952,7 @@ class Trainer:
         *,
         step: int,
         max_steps: int,
-        interval_losses: list[jax.Array],
+        losses_host: np.ndarray,
         interval_shard: list[tuple[jax.Array, jax.Array]],
         interval_tokens: int,
         interval_time: float,
@@ -702,7 +962,7 @@ class Trainer:
             # Surface a failed async checkpoint write within one log
             # interval instead of at the next save or at close().
             self._ckpt_mgr.poll()
-        losses = np.asarray(jax.device_get(jnp.stack(interval_losses)))
+        losses = losses_host
         avg_loss = float(losses.mean())
         steps_in_interval = len(losses)
         avg_step_time = interval_time / steps_in_interval if steps_in_interval else 0.0
@@ -857,8 +1117,21 @@ class Trainer:
         )
         boxed_params = _rebox_like(self._state.params, host_params)
         boxed_opt = _rebox_like(self._state.opt_state, host_opt)
+        # Resilience scalars (guard counter, rollback/data-offset, spike
+        # trend) ride in an optional payload key; absent in pre-resilience
+        # checkpoints, which restore with zeroed guard state.
+        resil = payload.get("resilience") or {}
+        self._last_restored_resilience = {k: v for k, v in resil.items()}
+        nonfinite_count = None
+        if self._resilience.nonfinite_guard:
+            nonfinite_count = jnp.asarray(
+                int(resil.get("nonfinite_count", 0)), jnp.int32
+            )
         restored = TrainState(
-            step=jnp.asarray(step, jnp.int32), params=boxed_params, opt_state=boxed_opt
+            step=jnp.asarray(step, jnp.int32),
+            params=boxed_params,
+            opt_state=boxed_opt,
+            nonfinite_count=nonfinite_count,
         )
         self._state = jax.jit(lambda s: s, out_shardings=self._state_shardings)(restored)
         logger.info("resumed from %s at step %d", path, step)

@@ -34,6 +34,7 @@ def format_run_summary(
         "data": cfg.data.model_dump(),
         "trainer": cfg.trainer.model_dump(),
         "distributed": cfg.distributed.model_dump(),
+        "resilience": cfg.resilience.model_dump(),
         "mlflow": cfg.mlflow.model_dump(),
         "logging": cfg.logging.model_dump(),
         "output": cfg.output.model_dump(),
@@ -61,6 +62,7 @@ def format_run_summary(
             "val_metrics": dict(train_result.val_metrics or {}),
             "resumed_from_step": train_result.resumed_from_step,
             "preempted": getattr(train_result, "preempted", False),
+            "rollbacks": getattr(train_result, "rollbacks", 0),
         }
 
     if as_json:
@@ -72,7 +74,17 @@ def _render_text(summary: dict[str, Any]) -> str:
     lines: list[str] = ["Planned run:" if summary["dry_run"] else "Run summary:"]
     lines.append(f"  run_id: {summary['run_id']}")
     lines.append(f"  run_dir: {summary['run_dir']}")
-    for section in ("run", "model", "data", "trainer", "distributed", "mlflow", "logging", "output"):
+    for section in (
+        "run",
+        "model",
+        "data",
+        "trainer",
+        "distributed",
+        "resilience",
+        "mlflow",
+        "logging",
+        "output",
+    ):
         lines.append(f"  {section}:")
         _render_mapping(lines, summary[section], indent=2)
     env = summary.get("distributed_env") or {}

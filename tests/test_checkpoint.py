@@ -68,15 +68,19 @@ class TestCheckpointManager:
         run_dir = _run_dir(tmp_path)
         cfg = _cfg(tmp_path)
         Trainer(cfg, run_dir, NullTracker(), None).fit()
-        names = [p.name for p in (run_dir / "checkpoints").iterdir()]
+        names = [p.name for p in (run_dir / "checkpoints").glob("step_*.ckpt")]
         # save_every=5, max=20, keep_last_k default 3 -> steps 10, 15, 20
         assert sorted(names) == ["step_000010.ckpt", "step_000015.ckpt", "step_000020.ckpt"]
+        # Every retained checkpoint carries its sha-256 integrity sidecar;
+        # pruned ones took their sidecars with them.
+        sidecars = [p.name for p in (run_dir / "checkpoints").glob("*.sha256")]
+        assert sorted(sidecars) == [n + ".sha256" for n in sorted(names)]
 
     def test_keep_last_k_override(self, tmp_path):
         run_dir = _run_dir(tmp_path)
         cfg = _cfg(tmp_path, trainer={"extra": {"keep_last_k": 1}})
         Trainer(cfg, run_dir, NullTracker(), None).fit()
-        names = [p.name for p in (run_dir / "checkpoints").iterdir()]
+        names = [p.name for p in (run_dir / "checkpoints").glob("step_*.ckpt")]
         assert names == ["step_000020.ckpt"]
 
     def test_latest_checkpoint(self, tmp_path):
@@ -147,14 +151,14 @@ class TestCheckpointManager:
         seen_at_step2 = []
         real_save = CheckpointManager.save_host
 
-        def slow_save(self, step, host_state, cfg):
+        def slow_save(self, step, host_state, cfg, **kwargs):
             if step == 1:
                 release.wait(timeout=10)
             if step == 2:
                 # Snapshot on the worker thread itself — no race with main.
                 seen_at_step2.append(list(order))
             order.append(step)
-            return real_save(self, step, host_state, cfg)
+            return real_save(self, step, host_state, cfg, **kwargs)
 
         monkeypatch.setattr(CheckpointManager, "save_host", slow_save)
         mgr = CheckpointManager(tmp_path / "c", keep_last_k=5)
@@ -169,8 +173,108 @@ class TestCheckpointManager:
         # Save 1 had fully completed before save 2 began.
         assert seen_at_step2 == [[1]]
         assert order == [1, 2]
-        names = sorted(p.name for p in (tmp_path / "c").iterdir())
+        names = sorted(p.name for p in (tmp_path / "c").glob("step_*.ckpt"))
         assert names == ["step_000001.ckpt", "step_000002.ckpt"]
+
+
+def _host_state(step):
+    return {"step": step, "params": {"w": np.full(4, step, np.float32)}, "opt_state": {}}
+
+
+class TestCheckpointIntegrity:
+    """sha-256 sidecars, backward-scanning latest_valid_checkpoint, and the
+    prune rule that must never delete the last verified checkpoint."""
+
+    def test_save_writes_verifiable_sidecar(self, tmp_path):
+        import hashlib
+
+        mgr = CheckpointManager(tmp_path / "c")
+        target = mgr.save_host(1, _host_state(1), {"a": 1})
+        side = target.with_name(target.name + ".sha256")
+        assert side.is_file()
+        digest, name = side.read_text().split()
+        assert name == target.name
+        assert digest == hashlib.sha256(target.read_bytes()).hexdigest()
+        assert mgr.verify(target)
+
+    def test_verify_detects_truncation_and_garbage(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "c")
+        target = mgr.save_host(1, _host_state(1), {})
+        data = target.read_bytes()
+        target.write_bytes(data[: len(data) // 2])
+        assert not mgr.verify(target)
+        with pytest.raises(CheckpointError, match="integrity"):
+            CheckpointManager.load(target)
+
+    def test_verify_without_sidecar_deep_parses(self, tmp_path):
+        """Legacy checkpoints (pre-sidecar) verify via a full msgpack parse;
+        arbitrary junk does not."""
+        mgr = CheckpointManager(tmp_path / "c")
+        target = mgr.save_host(1, _host_state(1), {})
+        target.with_name(target.name + ".sha256").unlink()
+        # New manager: no warm verify cache.
+        assert CheckpointManager(tmp_path / "c").verify(target)
+        junk = tmp_path / "c" / "step_000002.ckpt"
+        junk.write_bytes(b"not a checkpoint")
+        assert not CheckpointManager(tmp_path / "c").verify(junk)
+
+    def test_latest_valid_skips_corrupt_newest(self, tmp_path, caplog):
+        mgr = CheckpointManager(tmp_path / "c")
+        mgr.save_host(1, _host_state(1), {})
+        newest = mgr.save_host(2, _host_state(2), {})
+        data = newest.read_bytes()
+        newest.write_bytes(data[: len(data) // 2])
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            got = CheckpointManager(tmp_path / "c").latest_valid_checkpoint()
+        assert got.name == "step_000001.ckpt"
+        assert any("integrity" in r.message for r in caplog.records)
+
+    def test_latest_valid_before_step_restriction(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "c", keep_last_k=10)
+        for step in (1, 2, 3):
+            mgr.save_host(step, _host_state(step), {})
+        assert mgr.latest_valid_checkpoint(before_step=3).name == "step_000002.ckpt"
+        assert mgr.latest_valid_checkpoint(before_step=1) is None
+
+    def test_resolve_resume_tolerates_truncated_newest(self, tmp_path, caplog):
+        """--resume on a dir whose newest checkpoint was cut mid-write must
+        warn and restore the previous valid one, not raise mid-restore."""
+        d = tmp_path / "ckpts"
+        mgr = CheckpointManager(d)
+        mgr.save_host(3, _host_state(3), {})
+        newest = mgr.save_host(9, _host_state(9), {})
+        newest.write_bytes(newest.read_bytes()[:10])
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            got = resolve_resume_path(str(d), tmp_path)
+        assert got.name == "step_000003.ckpt"
+        payload = CheckpointManager.load(got)
+        assert int(payload["step"]) == 3
+
+    def test_prune_never_deletes_last_verified_checkpoint(self, tmp_path):
+        """keep_last_k retention alone would leave only the corrupt newest
+        file; the verified-valid rule keeps the restorable one alive."""
+        d = tmp_path / "c"
+        mgr = CheckpointManager(d, keep_last_k=3)
+        mgr.save_host(1, _host_state(1), {})
+        newest = mgr.save_host(2, _host_state(2), {})
+        newest.write_bytes(b"garbage")
+
+        pruner = CheckpointManager(d, keep_last_k=1)
+        pruner._prune()
+        survivors = sorted(p.name for p in d.glob("step_*.ckpt"))
+        # step 1 (the only verified file) survives despite k=1.
+        assert "step_000001.ckpt" in survivors
+        assert pruner.latest_valid_checkpoint().name == "step_000001.ckpt"
+
+    def test_prune_removes_sidecars_with_their_checkpoints(self, tmp_path):
+        d = tmp_path / "c"
+        mgr = CheckpointManager(d, keep_last_k=1)
+        for step in (1, 2, 3):
+            mgr.save_host(step, _host_state(step), {})
+        assert sorted(p.name for p in d.glob("step_*.ckpt")) == ["step_000003.ckpt"]
+        assert sorted(p.name for p in d.glob("*.sha256")) == [
+            "step_000003.ckpt.sha256"
+        ]
 
 
 class TestResumeResolution:

@@ -116,8 +116,11 @@ class TestTrain:
         assert (run_dir / "config.yaml").is_file()
         assert (run_dir / "meta.json").is_file()
         assert (run_dir / "logs" / "train.log").is_file()
-        ckpts = sorted(p.name for p in (run_dir / "checkpoints").iterdir())
+        ckpts = sorted(p.name for p in (run_dir / "checkpoints").glob("step_*.ckpt"))
         assert ckpts == ["step_000003.ckpt", "step_000006.ckpt"]
+        # Each checkpoint ships with its sha-256 integrity sidecar.
+        sidecars = sorted(p.name for p in (run_dir / "checkpoints").glob("*.sha256"))
+        assert sidecars == [n + ".sha256" for n in ckpts]
         # --json keeps stdout pure JSON; logs went to stderr/file
         assert proc.stdout.strip().startswith("{")
 

@@ -31,6 +31,30 @@ def test_minimal_config_materializes_defaults():
     assert cfg.output.root_dir == "runs"
 
 
+def test_resilience_defaults_inject_nothing():
+    cfg = RunConfig.model_validate(MINIMAL)
+    assert cfg.resilience.nonfinite_guard is False
+    assert cfg.resilience.spike_detection is False
+    assert cfg.resilience.max_consecutive_nonfinite == 25
+    assert cfg.resilience.retry_attempts == 3
+    faults = cfg.resilience.faults
+    assert faults.nan_loss_at_step is None
+    assert faults.sigterm_at_step is None
+    assert faults.corrupt_checkpoint_at_step is None
+    assert faults.dataset_load_failures == 0
+
+
+def test_resilience_validation_bounds():
+    with pytest.raises(Exception):
+        RunConfig.model_validate(
+            {**MINIMAL, "resilience": {"spike_factor": 1.0}}
+        )
+    with pytest.raises(Exception):
+        RunConfig.model_validate(
+            {**MINIMAL, "resilience": {"faults": {"corrupt_mode": "evaporate"}}}
+        )
+
+
 def test_extra_top_level_field_rejected():
     bad = dict(MINIMAL, bogus=1)
     with pytest.raises(Exception):

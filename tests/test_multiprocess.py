@@ -144,7 +144,10 @@ def test_two_process_data_parallel_train(tmp_path):
     # Exactly one run dir, created by rank 0 only, with the expected ckpts.
     runs = list((tmp_path / "runs").iterdir())
     assert [p.name for p in runs] == ["mp_run"]
-    ckpts = sorted(p.name for p in (tmp_path / "runs" / "mp_run" / "checkpoints").iterdir())
+    ckpts = sorted(
+        p.name
+        for p in (tmp_path / "runs" / "mp_run" / "checkpoints").glob("step_*.ckpt")
+    )
     assert ckpts == ["step_000002.ckpt", "step_000004.ckpt"]
 
 

@@ -1,0 +1,459 @@
+"""Fault-tolerance subsystem tests (llmtrain_tpu/resilience/).
+
+Every recovery path is exercised END TO END through the config-driven
+fault-injection harness, not just claimed:
+
+* non-finite guard — NaN injected INSIDE the jitted step is survived with a
+  skipped update; persistent NaN aborts after the consecutive-skip cap; the
+  guard counter round-trips through the checkpoint.
+* loss-spike rollback — an injected spike restores the newest verified
+  checkpoint saved before the spike and the run completes; the rollback
+  budget bounds repeated spikes.
+* checkpoint integrity — a corrupted newest checkpoint is skipped by
+  resume, which restores the previous valid one.
+* SIGTERM injection — a durable preemption save that resumes to loss
+  parity with a continuous run, guard enabled on both sides.
+* retry — flaky dataset loading and distributed init recover under the
+  exponential-backoff helper.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.resilience import (
+    FaultPlan,
+    InjectedFault,
+    LossSpikeDetector,
+    NonFiniteLossError,
+    RollbackBudgetExceededError,
+    retry,
+)
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training import CheckpointManager, Trainer
+
+pytestmark = []  # deliberately unmarked: tier-1 must exercise recovery paths
+
+
+def _cfg(tmp_path=None, **overrides):
+    base = {
+        "run": {"name": "resil", "seed": 11},
+        "model": {
+            "name": "dummy_gpt",
+            "block_size": 8,
+            "vocab_size": 32,
+            "dropout": 0.0,
+            "d_model": 48,
+            "n_heads": 2,
+            "d_ff": 96,
+            "n_layers": 1,
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 8,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 2,
+            "lr": 3e-3,
+            "warmup_steps": 0,
+            "log_every_steps": 2,
+            "eval_every_steps": 100,
+            "save_every_steps": 100,
+        },
+        "resilience": {"nonfinite_guard": True},
+        "mlflow": {"enabled": False},
+    }
+    if tmp_path is not None:
+        base["output"] = {"root_dir": str(tmp_path)}
+    for section, values in overrides.items():
+        base[section] = {**base.get(section, {}), **values}
+    return RunConfig.model_validate(base)
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+def _run_dir(tmp_path, name):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+# --------------------------------------------------------------------------
+# pillar 1: non-finite guard
+# --------------------------------------------------------------------------
+
+
+class TestNonFiniteGuard:
+    def test_injected_nan_is_survived_with_skipped_update(self, tmp_path, caplog):
+        """NaN at step 3 inside the compiled step: the run trains through,
+        the guard warns, and the final loss is finite."""
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "nonfinite_guard": True,
+                "faults": {"nan_loss_at_step": 3, "nan_loss_steps": 1},
+            },
+        )
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.final_step == cfg.trainer.max_steps
+        assert np.isfinite(res.final_loss)
+        assert any("skipped by the guard" in r.message for r in caplog.records)
+
+    def test_unguarded_nan_poisons_the_run(self, tmp_path):
+        """Control: the same injection WITHOUT the guard destroys the
+        params — this is exactly the failure mode the guard removes."""
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "nonfinite_guard": False,
+                "faults": {"nan_loss_at_step": 3, "nan_loss_steps": 1},
+            },
+        )
+        res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert not np.isfinite(res.final_loss)
+
+    def test_persistent_nan_aborts_after_cap(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            trainer={"max_steps": 30, "log_every_steps": 1},
+            resilience={
+                "nonfinite_guard": True,
+                "max_consecutive_nonfinite": 3,
+                "faults": {"nan_loss_at_step": 2, "nan_loss_steps": 100},
+            },
+        )
+        with pytest.raises(NonFiniteLossError, match="3 consecutive"):
+            Trainer(cfg, None, NullTracker(), None).fit()
+
+    def test_guard_counter_round_trips_through_checkpoint(self, tmp_path):
+        """Persistent NaN from step 3 on; save at 6 must record 4 consecutive
+        skips, and the resumed run must CONTINUE the count (8 by step 8),
+        not restart it from zero."""
+        overrides = {
+            "trainer": {"max_steps": 6, "save_every_steps": 3},
+            "resilience": {
+                "nonfinite_guard": True,
+                "max_consecutive_nonfinite": 1000,
+                "faults": {"nan_loss_at_step": 3, "nan_loss_steps": 100},
+            },
+        }
+        cfg = _cfg(tmp_path, **overrides)
+        run_dir = _run_dir(tmp_path, "guard_rt")
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+        ckpt_dir = run_dir / "checkpoints"
+        payload = CheckpointManager.load(ckpt_dir / "step_000006.ckpt")
+        assert int(payload["resilience"]["nonfinite_count"]) == 4
+
+        resumed_cfg = _cfg(
+            tmp_path,
+            **{**overrides, "trainer": {"max_steps": 8, "save_every_steps": 8}},
+        )
+        Trainer(resumed_cfg, run_dir, NullTracker(), None).fit(
+            resume_from=str(ckpt_dir)
+        )
+        payload = CheckpointManager.load(ckpt_dir / "step_000008.ckpt")
+        assert int(payload["resilience"]["nonfinite_count"]) == 6
+
+
+# --------------------------------------------------------------------------
+# pillar 2: loss-spike rollback
+# --------------------------------------------------------------------------
+
+
+class TestSpikeRollback:
+    def _spike_cfg(self, tmp_path, **extra_resilience):
+        return _cfg(
+            tmp_path,
+            trainer={
+                "max_steps": 12,
+                "log_every_steps": 2,
+                "save_every_steps": 5,
+            },
+            resilience={
+                "nonfinite_guard": False,
+                "spike_detection": True,
+                "spike_factor": 4.0,
+                "spike_min_history": 4,
+                "max_rollbacks": 2,
+                "faults": {"spike_loss_at_step": 8, "spike_loss_scale": 100.0},
+                **extra_resilience,
+            },
+        )
+
+    def test_injected_spike_rolls_back_and_completes(self, tmp_path, caplog):
+        cfg = self._spike_cfg(tmp_path)
+        run_dir = _run_dir(tmp_path, "spike")
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, run_dir, NullTracker(), None).fit()
+        assert res.rollbacks == 1
+        assert res.final_step == 12
+        assert np.isfinite(res.final_loss)
+        assert any("rolled back to checkpoint step 5" in r.message for r in caplog.records)
+        # The rollback bookkeeping round-tripped into the final checkpoint.
+        payload = CheckpointManager.load(run_dir / "checkpoints" / "step_000012.ckpt")
+        assert int(payload["resilience"]["rollback_count"]) == 1
+        assert int(payload["resilience"]["data_offset"]) > 0
+
+    def test_rollback_budget_exhaustion_raises(self, tmp_path):
+        cfg = self._spike_cfg(tmp_path, max_rollbacks=0)
+        run_dir = _run_dir(tmp_path, "spike_budget")
+        with pytest.raises(RollbackBudgetExceededError, match="budget"):
+            Trainer(cfg, run_dir, NullTracker(), None).fit()
+
+    def test_early_spike_before_first_save_continues(self, tmp_path, caplog):
+        """A spike with no verified checkpoint predating it (detector armed
+        before the first periodic save) must warn and train through, not
+        kill a run that would otherwise continue."""
+        cfg = _cfg(
+            tmp_path,
+            trainer={
+                "max_steps": 10,
+                "log_every_steps": 2,
+                "save_every_steps": 100,
+            },
+            resilience={
+                "nonfinite_guard": False,
+                "spike_detection": True,
+                "spike_factor": 4.0,
+                "spike_min_history": 3,
+                "faults": {"spike_loss_at_step": 6, "spike_loss_scale": 100.0},
+            },
+        )
+        run_dir = _run_dir(tmp_path, "early_spike")
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, run_dir, NullTracker(), None).fit()
+        assert res.rollbacks == 0
+        assert res.final_step == 10
+        assert any(
+            "continuing without rollback" in r.message for r in caplog.records
+        )
+
+    def test_spike_without_checkpoint_manager_disables_detector(
+        self, tmp_path, caplog
+    ):
+        """No run dir → nothing to roll back to: log an error and finish the
+        run rather than dying."""
+        cfg = self._spike_cfg(tmp_path)
+        with caplog.at_level(logging.ERROR, logger="llmtrain"):
+            res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.rollbacks == 0
+        assert res.final_step == 12
+        assert any("rollback disabled" in r.message for r in caplog.records)
+
+    def test_detector_unit_behavior(self):
+        det = LossSpikeDetector(factor=4.0, beta=0.9, min_history=5)
+        for _ in range(10):
+            assert det.observe(1.0) is False
+        assert det.armed
+        assert det.observe(float("nan")) is False  # guard's failure mode
+        assert det.observe(1.3) is False  # noise, not a spike
+        assert det.observe(10.0) is True  # 10 > 4 x trend(~1.0)
+        # The spike was not folded into the trend: a second spike still fires.
+        assert det.observe(10.0) is True
+        state = det.state()
+        clone = LossSpikeDetector(factor=4.0, beta=0.9, min_history=5)
+        clone.load_state(state)
+        assert clone.trend == pytest.approx(det.trend)
+        assert clone.armed
+
+
+# --------------------------------------------------------------------------
+# pillar 3: checkpoint integrity (e2e; unit coverage in test_checkpoint.py)
+# --------------------------------------------------------------------------
+
+
+class TestCorruptCheckpointRecovery:
+    def test_resume_skips_injected_corruption(self, tmp_path, caplog):
+        """The newest checkpoint is truncated after its save; resume must
+        warn, fall back to the previous verified one, and continue."""
+        cfg = _cfg(
+            tmp_path,
+            trainer={"max_steps": 10, "save_every_steps": 5},
+            resilience={
+                "faults": {
+                    "corrupt_checkpoint_at_step": 10,
+                    "corrupt_mode": "truncate",
+                }
+            },
+        )
+        run_dir = _run_dir(tmp_path, "corrupt")
+        Trainer(cfg, run_dir, NullTracker(), None).fit()
+
+        clean = _cfg(tmp_path, trainer={"max_steps": 12, "save_every_steps": 5})
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(clean, None, NullTracker(), None).fit(
+                resume_from=str(run_dir / "checkpoints")
+            )
+        assert res.resumed_from_step == 5
+        assert res.final_step == 12
+        assert any(
+            "failed integrity verification" in r.message for r in caplog.records
+        )
+
+
+# --------------------------------------------------------------------------
+# pillar 4 (+ satellite): SIGTERM injection, guard-enabled resume parity
+# --------------------------------------------------------------------------
+
+
+class TestSigtermInjection:
+    def test_injected_sigterm_saves_and_resumes_to_parity(self, tmp_path):
+        """Guard enabled on both sides: the preempted-and-resumed run must
+        reach the continuous run's final loss to 1e-5, proving the guard
+        state (and everything else) round-trips through the preemption
+        checkpoint."""
+        base = {
+            "trainer": {"max_steps": 14, "save_every_steps": 100},
+            "resilience": {"nonfinite_guard": True},
+        }
+        continuous = _cfg(tmp_path, **base)
+        run_a = _run_dir(tmp_path, "cont")
+        res_full = Trainer(continuous, run_a, NullTracker(), None).fit()
+        assert res_full.preempted is False
+
+        preempt = _cfg(
+            tmp_path,
+            **{
+                **base,
+                "resilience": {
+                    "nonfinite_guard": True,
+                    "faults": {"sigterm_at_step": 7},
+                },
+            },
+        )
+        run_b = _run_dir(tmp_path, "pre")
+        res_pre = Trainer(preempt, run_b, NullTracker(), None).fit()
+        assert res_pre.preempted is True
+        assert res_pre.final_step == 7
+        ckpt = run_b / "checkpoints" / "step_000007.ckpt"
+        assert ckpt.exists()
+        # The preemption save carries the guard payload.
+        assert "resilience" in CheckpointManager.load(ckpt)
+
+        resumed = Trainer(_cfg(tmp_path, **base), None, NullTracker(), None).fit(
+            resume_from=str(run_b / "checkpoints")
+        )
+        assert resumed.resumed_from_step == 7
+        assert resumed.final_loss == pytest.approx(res_full.final_loss, abs=1e-5)
+
+
+# --------------------------------------------------------------------------
+# retry + flaky-init injection
+# --------------------------------------------------------------------------
+
+
+class TestRetry:
+    def test_exponential_backoff_delays(self):
+        sleeps: list[float] = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise RuntimeError("boom")
+            return "ok"
+
+        assert (
+            retry(
+                flaky,
+                attempts=4,
+                base_delay=0.1,
+                description="unit op",
+                sleep=sleeps.append,
+            )
+            == "ok"
+        )
+        assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+    def test_final_failure_reraises_original(self):
+        def always():
+            raise ValueError("real cause")
+
+        with pytest.raises(ValueError, match="real cause"):
+            retry(always, attempts=2, base_delay=0.0, sleep=lambda _t: None)
+
+    def test_flaky_distributed_init_recovers_under_retry(self):
+        from llmtrain_tpu.config import FaultInjectionConfig
+
+        plan = FaultPlan.from_config(
+            FaultInjectionConfig(distributed_init_failures=2)
+        )
+        wrapped = plan.flaky("distributed_init", lambda: "rendezvous")
+        with pytest.raises(InjectedFault):
+            wrapped()
+        assert (
+            retry(wrapped, attempts=3, base_delay=0.0, sleep=lambda _t: None)
+            == "rendezvous"
+        )
+
+    def test_trainer_dataset_setup_retries_injected_failures(self, tmp_path, caplog):
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "retry_attempts": 3,
+                "retry_base_delay": 0.0,
+                "faults": {"dataset_load_failures": 2},
+            },
+        )
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.final_step == cfg.trainer.max_steps
+        assert any(
+            "dataset setup failed (attempt 1/3" in r.message for r in caplog.records
+        )
+
+    def test_trainer_dataset_setup_fails_past_budget(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "retry_attempts": 2,
+                "retry_base_delay": 0.0,
+                "faults": {"dataset_load_failures": 5},
+            },
+        )
+        with pytest.raises(InjectedFault):
+            Trainer(cfg, None, NullTracker(), None)
+
+
+# --------------------------------------------------------------------------
+# async-save failure path through the trainer (satellite)
+# --------------------------------------------------------------------------
+
+
+class _StepRecorder(NullTracker):
+    def __init__(self):
+        self.steps: list[int] = []
+
+    def log_metrics(self, metrics, step=None):
+        if step is not None:
+            self.steps.append(step)
+
+
+class TestAsyncSaveFailureSurfaces:
+    def test_background_write_error_fails_the_run_promptly(self, tmp_path):
+        """A failing async checkpoint write must abort training within a log
+        interval or two — not silently train to max_steps and die at
+        close()."""
+        cfg = _cfg(
+            tmp_path,
+            trainer={
+                "max_steps": 200,
+                "save_every_steps": 5,
+                "log_every_steps": 5,
+            },
+        )
+        run_dir = _run_dir(tmp_path, "async_fail")
+        # A FILE where the checkpoints dir should be: every write fails.
+        (run_dir / "checkpoints").write_text("not a directory")
+        tracker = _StepRecorder()
+        with pytest.raises(OSError):
+            Trainer(cfg, run_dir, tracker, None).fit()
+        assert not tracker.steps or max(tracker.steps) <= 50
