@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -18,6 +18,12 @@ test-serial:
 verify-resilience:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
 		tests/test_checkpoint.py tests/test_preemption.py -q -m "not slow"
+
+# Hang watchdog + exit-code taxonomy suite: injected REAL host hang killed
+# with a retryable exit + all-thread stack report, heartbeat freshness,
+# straggler telemetry, bounded drain of a wedged checkpoint write.
+verify-watchdog:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_watchdog.py -q -m "not slow"
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

@@ -75,3 +75,24 @@ PY
     fi
     [ "$FAILURES" -eq "$before" ]
 }
+
+# The watchdog's progress beacon must have produced a heartbeat file (the
+# livenessProbe contract, docs/k8s.md) and touched it no longer than
+# max_age seconds ago — the same freshness computation the probe's exec
+# performs in k8s/job.yaml.
+assert_heartbeat() {
+    local hb="$1" max_age="${2:-600}" before="$FAILURES" mtime age
+    if [ ! -f "$hb" ]; then
+        fail "heartbeat file missing: $hb"
+        return 1
+    fi
+    pass "heartbeat file exists: $hb"
+    mtime=$(stat -c %Y "$hb" 2>/dev/null || stat -f %m "$hb" 2>/dev/null || echo 0)
+    age=$(( $(date +%s) - mtime ))
+    if [ "$age" -lt "$max_age" ]; then
+        pass "heartbeat fresh (${age}s old)"
+    else
+        fail "heartbeat stale (${age}s old >= ${max_age}s)"
+    fi
+    [ "$FAILURES" -eq "$before" ]
+}

@@ -79,4 +79,53 @@ if [ -n "${LLMTRAIN_RUN_ID:-}" ]; then
 fi
 
 echo "entrypoint: exec python -m llmtrain_tpu train --config ${CONFIG_PATH} ${EXTRA_ARGS[*]:-}"
-exec python -m llmtrain_tpu train --config "$CONFIG_PATH" "${EXTRA_ARGS[@]+"${EXTRA_ARGS[@]}"}"
+
+# Run the trainer as a child (not exec) so its exit code can be mapped
+# onto the documented taxonomy below. SIGTERM (pod eviction) is forwarded
+# to the child so the trainer's preemption save still fires inside the
+# grace period; the final exit code is passed through UNCHANGED — the
+# Job's podFailurePolicy (k8s/job.yaml) is what consumes it.
+python -m llmtrain_tpu train --config "$CONFIG_PATH" "${EXTRA_ARGS[@]+"${EXTRA_ARGS[@]}"}" &
+CHILD=$!
+# The flag disambiguates "our wait was interrupted by the trap" (re-wait
+# for the child's true status — bash retains it even for an already-dead
+# child) from "the child itself died by signal" (wait already returned
+# the real 128+N; re-waiting would just repeat it). Gating the re-wait on
+# `kill -0` instead would race a child that exits right after the
+# interruption and misreport a clean preemption save (exit 0) as 143.
+TRAPPED=0
+trap 'TRAPPED=1; kill -TERM "$CHILD" 2>/dev/null' TERM INT
+
+set +e
+wait "$CHILD"
+CODE=$?
+while [ "$TRAPPED" -eq 1 ]; do
+    TRAPPED=0
+    wait "$CHILD" 2>/dev/null
+    W=$?
+    # 127 = the child was already reaped by a previous wait (a second
+    # signal raced the loop test); CODE already holds the true status.
+    [ "$W" -eq 127 ] || CODE=$W
+done
+set -e
+
+# Exit-code taxonomy (llmtrain_tpu/resilience/exit_codes.py):
+#   0      clean (incl. preemption save-and-stop)
+#   2      fatal config error               -> podFailurePolicy: FailJob
+#   75     retryable infra (EX_TEMPFAIL)    -> podFailurePolicy: Count
+#   76     retryable hang (watchdog exit)   -> podFailurePolicy: Count
+#   other  fatal training failure           -> podFailurePolicy: FailJob
+if [ "$CODE" -gt 128 ] && [ "$CODE" -le 255 ]; then
+    # 128+N = killed by signal N (OOM SIGKILL=137, eviction SIGTERM=143):
+    # environmental, and the Job's podFailurePolicy treats it as retryable
+    # — the log must say the same thing the orchestrator does.
+    echo "entrypoint: terminated by signal $((CODE - 128)) (exit $CODE) — retryable, the orchestrator may restart this pod" >&2
+else
+    case "$CODE" in
+        0)      echo "entrypoint: training exited clean (0)" ;;
+        75|76)  echo "entrypoint: RETRYABLE failure (exit $CODE) — the orchestrator should restart this pod" >&2 ;;
+        2)      echo "entrypoint: FATAL config error (exit 2) — do not retry" >&2 ;;
+        *)      echo "entrypoint: FATAL training failure (exit $CODE) — do not retry" >&2 ;;
+    esac
+fi
+exit "$CODE"

@@ -82,6 +82,16 @@ distributed:
   timeout_sec: 600
   mesh:
     data: -1
+resilience:
+  # Arm the real watchdog (it must NEVER fire on this healthy run). No
+  # explicit heartbeat_path: the default lands in the shared run dir with
+  # a per-rank suffix (heartbeat for rank 0, heartbeat.r1 for rank 1), so
+  # the assertions below can check EACH pod's beacon — one shared file
+  # would let a healthy pod's touches mask a dead beacon on the other,
+  # exactly the anti-pattern docs/k8s.md warns about.
+  watchdog:
+    enabled: true
+    stall_timeout_sec: 600
 mlflow:
   enabled: true
   tracking_uri: "sqlite:///$PWD/$OUT/volume/mlflow/mlflow.db"
@@ -140,14 +150,26 @@ LOGS0="$(cat "$OUT/logs/pod0.log")"
 say "asserting rank-0 output"
 assert_rank0_logs "$LOGS0" || true
 
-say "asserting pod exit codes"
+say "asserting pod exit codes (taxonomy-clean 0: watchdog armed, never fired)"
 for IDX in 0 1; do
     if [ "${CODES[$IDX]}" = "0" ]; then
         pass "pod $IDX exited 0"
     else
-        fail "pod $IDX exited ${CODES[$IDX]}"
+        fail "pod $IDX exited ${CODES[$IDX]} (75/76 = retryable infra/hang, 1/2 = fatal)"
     fi
 done
+
+say "asserting per-rank heartbeat files (livenessProbe contract)"
+HB_RUN_DIR=$(find "$OUT/volume/runs" -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)
+assert_heartbeat "$HB_RUN_DIR/heartbeat" || true      # rank 0's beacon
+assert_heartbeat "$HB_RUN_DIR/heartbeat.r1" || true   # rank 1's beacon
+
+say "asserting no hang report was written (healthy run)"
+if find "$OUT/volume/runs" -name 'hang_report_*.txt' | grep -q .; then
+    fail "hang report present after a healthy run"
+else
+    pass "no hang_report_*.txt in the run dir"
+fi
 
 say "asserting host artifacts"
 RUN_DIR=$(find "$OUT/volume/runs" -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)

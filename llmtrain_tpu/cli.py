@@ -34,6 +34,13 @@ from .registry import (
     get_model_adapter,
     initialize_registries,
 )
+from .resilience.exit_codes import (
+    EXIT_CONFIG_ERROR,
+    EXIT_OK,
+    EXIT_RETRYABLE_INFRA,
+    EXIT_TRAIN_FAILURE,
+    exit_code_for_exception,
+)
 from .tracking import NullTracker, Tracker, build_tracker
 from .utils import (
     configure_logging,
@@ -46,9 +53,9 @@ from .utils import (
     write_resolved_config,
 )
 
-EXIT_OK = 0
-EXIT_TRAIN_FAILURE = 1
-EXIT_CONFIG_ERROR = 2
+# Exit codes come from the taxonomy module (resilience/exit_codes.py):
+# 0 clean, 1 fatal training, 2 fatal config, 75/76 retryable infra/hang.
+# The names are re-exported here so `cli.EXIT_*` keeps working.
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -939,11 +946,9 @@ def _agree_flag(local_ok: bool, dist_state: DistState | None) -> bool:
     """Broadcast rank 0's boolean to every process (single-process: identity)."""
     if dist_state is None or dist_state.num_processes == 1:
         return local_ok
-    import numpy as np
-    from jax.experimental import multihost_utils
+    from .distributed import broadcast_int_from_main
 
-    agreed = multihost_utils.broadcast_one_to_all(np.uint8(1 if local_ok else 0))
-    return bool(np.asarray(agreed))
+    return bool(broadcast_int_from_main(1 if local_ok else 0))
 
 
 def _build_decode_stack(cfg, logger, label: str = ""):
@@ -1091,7 +1096,7 @@ def _handle_serve(args: argparse.Namespace) -> int:
         return EXIT_OK
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         _emit_error(f"serve failed: {exc}")
-        return EXIT_TRAIN_FAILURE
+        return exit_code_for_exception(exc)
 
 
 def _handle_eval(args: argparse.Namespace) -> int:
@@ -1137,7 +1142,7 @@ def _handle_eval(args: argparse.Namespace) -> int:
         return EXIT_OK
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         _emit_error(f"evaluation failed: {exc}")
-        return EXIT_TRAIN_FAILURE
+        return exit_code_for_exception(exc)
 
 
 def _prepare_decode_model(model, params, decode_param_dtype: str, logger, label=""):
@@ -1459,7 +1464,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         logger.exception("generation failed: %s", exc)
         _emit_error(f"generation failed: {exc}")
-        return EXIT_TRAIN_FAILURE
+        return exit_code_for_exception(exc)
     return EXIT_OK
 
 
@@ -1485,14 +1490,29 @@ def _handle_train(args: argparse.Namespace) -> int:
         from .resilience import FaultPlan, retry
 
         plan = FaultPlan.from_config(cfg.resilience.faults)
-        dist_state = retry(
-            plan.flaky(
-                "distributed_init", lambda: setup_distributed(cfg.distributed)
-            ),
-            attempts=cfg.resilience.retry_attempts,
-            base_delay=cfg.resilience.retry_base_delay,
-            description="distributed init",
-        )
+        try:
+            dist_state = retry(
+                plan.flaky(
+                    "distributed_init", lambda: setup_distributed(cfg.distributed)
+                ),
+                attempts=cfg.resilience.retry_attempts,
+                base_delay=cfg.resilience.retry_base_delay,
+                description="distributed init",
+            )
+        except ValueError as exc:
+            # Topology/coordinator misconfiguration (resolve_topology and
+            # setup_distributed raise ValueError for these) is deterministic
+            # — restarting the pod replays it, so fail the Job fast.
+            _emit_error(f"distributed init failed: {exc}")
+            return EXIT_CONFIG_ERROR
+        except Exception as exc:  # noqa: BLE001 — CLI boundary
+            # Everything else at the rendezvous stage is environmental
+            # (coordinator pod still scheduling, DNS not propagated,
+            # timeout): exit EX_TEMPFAIL so the orchestrator restarts this
+            # pod instead of failing the whole Job (k8s/job.yaml
+            # podFailurePolicy).
+            _emit_error(f"distributed init failed: {exc}")
+            return EXIT_RETRYABLE_INFRA
     is_main = dist_state is None or dist_state.is_main
 
     logger = get_logger()
@@ -1600,7 +1620,19 @@ def _handle_train(args: argparse.Namespace) -> int:
         else:
             from .training import Trainer
 
-            trainer = Trainer(cfg, run_dir, tracker, dist_state)
+            # Non-main ranks get the run-dir PATH too (never created or
+            # written by them — every write stays rank-0-gated inside the
+            # Trainer): on the shared runs volume it gives all ranks a
+            # readable checkpoint dir, which is what makes the loss-spike
+            # rollback consensus restore the same file on every host.
+            trainer_run_dir = run_dir
+            if (
+                run_dir is None
+                and dist_state is not None
+                and dist_state.num_processes > 1
+            ):
+                trainer_run_dir = Path(cfg.output.root_dir) / run_id
+            trainer = Trainer(cfg, trainer_run_dir, tracker, dist_state)
             result = trainer.fit(resume_from=resume_spec)
             summary = format_run_summary(
                 cfg,
@@ -1615,8 +1647,11 @@ def _handle_train(args: argparse.Namespace) -> int:
             _log_run_artifacts(tracker, run_dir)
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         logger.exception("training failed: %s", exc)
-        _emit_error(f"training failed: {exc}")
-        exit_code = EXIT_TRAIN_FAILURE
+        # Taxonomy (resilience/exit_codes.py): transient infra causes exit
+        # EX_TEMPFAIL-style retryable codes; deterministic failures exit
+        # fatal so the orchestrator does not replay them.
+        exit_code = exit_code_for_exception(exc)
+        _emit_error(f"training failed: {exc} (exit {exit_code})")
     finally:
         try:
             if tracker_started:

@@ -19,6 +19,7 @@ from .schemas import (
     RunConfig,
     RunSectionConfig,
     TrainerConfig,
+    WatchdogConfig,
 )
 
 __all__ = [
@@ -35,6 +36,7 @@ __all__ = [
     "RunConfig",
     "RunSectionConfig",
     "TrainerConfig",
+    "WatchdogConfig",
     "load_and_validate_config",
     "load_yaml_config",
     "resolve_config_path",

@@ -215,6 +215,45 @@ class FaultInjectionConfig(BaseModel):
     # exponential-backoff retry() wiring.
     dataset_load_failures: int = Field(0, ge=0)
     distributed_init_failures: int = Field(0, ge=0)
+    # Block the host step loop FOR REAL right after dispatching this step
+    # (one-shot) — the hang-shaped failure the watchdog exists to kill.
+    # Without a duration the block is indefinite (the watchdog, or the k8s
+    # liveness probe, is what ends it); with one, the loop resumes after —
+    # a controllable straggler/GC-pause stand-in.
+    hang_at_step: int | None = Field(None, ge=1)
+    hang_duration_sec: float | None = Field(None, gt=0.0)
+
+    model_config = _STRICT
+
+
+class WatchdogConfig(BaseModel):
+    """Hang watchdog + heartbeat + straggler telemetry
+    (llmtrain_tpu/resilience/watchdog.py).
+
+    The watchdog hard-exits a stalled run with the retryable
+    EXIT_HANG_DETECTED (76) after dumping all-thread stacks and JAX
+    diagnostics to ``{run_dir}/hang_report_*.txt`` — a stuck collective
+    never raises, so detection has to come from outside the step loop.
+    """
+
+    enabled: bool = False
+    # No optimizer step dispatched for this long => the run is hung. Budget
+    # for the slowest legitimate gap: first-step compile, periodic eval,
+    # and checkpoint host-gather all count as "no progress".
+    stall_timeout_sec: float = Field(300.0, gt=0.0)
+    # Watchdog poll cadence; default None = stall_timeout_sec / 10.
+    poll_interval_sec: float | None = Field(None, gt=0.0)
+    # Heartbeat file the beacon touches for the k8s livenessProbe exec.
+    # None = {run_dir}/heartbeat. Point it at container-local storage
+    # (e.g. /tmp/llmtrain-heartbeat) on k8s: the probe must observe THIS
+    # pod, not whichever pod last touched a shared volume.
+    heartbeat_path: str | None = None
+    heartbeat_interval_sec: float = Field(1.0, ge=0.0)
+    # Per-host step-time skew telemetry on multi-process runs (allgathered
+    # at log boundaries, so it adds no extra device syncs).
+    straggler_telemetry: bool = True
+    straggler_skew_factor: float = Field(2.0, gt=1.0)
+    straggler_patience: int = Field(3, ge=1)
 
     model_config = _STRICT
 
@@ -247,6 +286,8 @@ class ResilienceConfig(BaseModel):
     # Exponential-backoff retry for distributed init and dataset loading.
     retry_attempts: int = Field(3, ge=1)
     retry_base_delay: float = Field(0.05, ge=0.0)
+    # Hang watchdog + heartbeat + straggler telemetry.
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     faults: FaultInjectionConfig = Field(default_factory=FaultInjectionConfig)
 
     model_config = _STRICT

@@ -237,18 +237,40 @@ def setup_distributed(cfg: DistributedConfig) -> DistState:
                 "(set MASTER_ADDR/MASTER_PORT, JAX_COORDINATOR_ADDRESS, "
                 "or distributed.coordinator_addr/coordinator_port)"
             )
-        jax.distributed.initialize(
+        init_kwargs: dict = dict(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
             local_device_ids=None,
             initialization_timeout=cfg.timeout_sec,
-            # The shutdown barrier must tolerate the same straggler skew
-            # as startup: on oversubscribed hosts (N procs per core in CI)
-            # ranks can reach teardown minutes apart, and jax's 300 s
-            # default then kills otherwise-green runs at the very end.
-            shutdown_timeout_seconds=max(300, cfg.timeout_sec),
         )
+        # The shutdown barrier must tolerate the same straggler skew as
+        # startup: on oversubscribed hosts (N procs per core in CI) ranks
+        # can reach teardown minutes apart, and jax's 300 s default then
+        # kills otherwise-green runs at the very end. The knob only exists
+        # on newer jax — gate on the signature so older versions rendezvous
+        # instead of dying on an unexpected kwarg.
+        import inspect
+
+        if (
+            "shutdown_timeout_seconds"
+            in inspect.signature(jax.distributed.initialize).parameters
+        ):
+            init_kwargs["shutdown_timeout_seconds"] = max(300, cfg.timeout_sec)
+        try:
+            jax.distributed.initialize(**init_kwargs)
+        except Exception:
+            # A failed connect (coordinator not up yet — the case the CLI's
+            # backoff retry exists for) leaves jax's global distributed
+            # state partially set; without a teardown every later attempt
+            # dies on "initialize should only be called once" instead of
+            # actually retrying the rendezvous. shutdown() resets
+            # client/service to None, making initialize callable again.
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
         _JAX_DIST_INITIALIZED = True
         process_id = jax.process_index()
         num_processes = jax.process_count()
@@ -281,6 +303,48 @@ def teardown_distributed() -> None:
 
 def active_state() -> DistState | None:
     return _ACTIVE_STATE
+
+
+def allgather_any(flag: bool) -> bool:
+    """Cross-process OR of a local boolean (collective: EVERY process must
+    call this at the same point). Single-process: identity. The consensus
+    primitive for "did ANY rank see it" decisions — preemption stop,
+    loss-spike rollback — where acting on a local-only flag would desync
+    the ranks into a deadlocked collective."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray([bool(flag)]))
+    return bool(np.asarray(gathered).any())
+
+
+def allgather_scalar(value: float) -> "list[float]":
+    """Per-process list of a local scalar, indexed by process id
+    (collective). Single-process: one-element list. Feeds the straggler
+    telemetry's per-host step times."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [float(value)]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray([float(value)]))
+    return [float(x) for x in np.asarray(gathered).reshape(-1)]
+
+
+def broadcast_int_from_main(value: int) -> int:
+    """Every process returns process 0's value (collective). Single-process:
+    identity. Used where rank 0 owns the decision (e.g. which checkpoint
+    step a rollback restores) and the others must follow it exactly."""
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    agreed = multihost_utils.broadcast_one_to_all(np.int64(value))
+    return int(np.asarray(agreed))
 
 
 MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipeline", "expert")

@@ -1,25 +1,58 @@
 """Fault-tolerance layer: non-finite guard, loss-spike rollback, fault
-injection, and retry — see docs/robustness.md.
+injection, retry, hang watchdog, and the exit-code taxonomy — see
+docs/robustness.md.
 
 The reference framework (and PAPER.md §2.4) has no elastic-recovery
 machinery: a NaN loss corrupts the optimizer state, a truncated checkpoint
-kills resume, a flaky rendezvous kills the pod. This package supplies the
+kills resume, a flaky rendezvous kills the pod — and a stuck collective
+stalls the whole job without ever raising. This package supplies the
 survivable-failure semantics production pre-training treats as table
 stakes, wired through config (``resilience:`` section), the jitted train
-step, the trainer loop, and the checkpoint manager — with every recovery
-path exercised end to end by the config-driven fault-injection harness.
+step, the trainer loop, the checkpoint manager, the CLI's exit codes, and
+the k8s liveness/restart machinery — with every recovery path exercised
+end to end by the config-driven fault-injection harness.
 """
 
+from .exit_codes import (
+    EXIT_CONFIG_ERROR,
+    EXIT_HANG_DETECTED,
+    EXIT_OK,
+    EXIT_RETRYABLE_INFRA,
+    EXIT_TRAIN_FAILURE,
+    RETRYABLE_EXIT_CODES,
+    RetryableInfraError,
+    exit_code_for_exception,
+    is_retryable,
+)
 from .faults import FaultPlan, InjectedFault, retry
 from .guard import NonFiniteLossError, tree_all_finite
 from .spike import LossSpikeDetector, RollbackBudgetExceededError
+from .watchdog import (
+    HangWatchdog,
+    ProgressBeacon,
+    StragglerTracker,
+    heartbeat_age_seconds,
+)
 
 __all__ = [
+    "EXIT_CONFIG_ERROR",
+    "EXIT_HANG_DETECTED",
+    "EXIT_OK",
+    "EXIT_RETRYABLE_INFRA",
+    "EXIT_TRAIN_FAILURE",
     "FaultPlan",
+    "HangWatchdog",
     "InjectedFault",
     "LossSpikeDetector",
     "NonFiniteLossError",
+    "ProgressBeacon",
+    "RETRYABLE_EXIT_CODES",
+    "RetryableInfraError",
     "RollbackBudgetExceededError",
+    "StragglerTracker",
+    "exit_code_for_exception",
+    "heartbeat_age_seconds",
+    "is_retryable",
     "retry",
     "tree_all_finite",
 ]

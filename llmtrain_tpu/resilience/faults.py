@@ -88,6 +88,7 @@ class FaultPlan:
         self._sigterm_fired = False
         self._corrupt_fired = False
         self._spike_fired = False
+        self._hang_fired = False
         self._flaky_counts: dict[str, int] = {}
 
     @classmethod
@@ -117,6 +118,31 @@ class FaultPlan:
         self._sigterm_fired = True
         logger.warning("fault injection: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_hang(self, step: int) -> None:
+        """Block the host step loop FOR REAL at exactly the configured step
+        (one-shot). No exception, no signal — the genuinely hang-shaped
+        failure mode: from outside, the process is alive and doing nothing,
+        which is exactly what the watchdog (resilience/watchdog.py) must
+        detect and kill. With ``hang_duration_sec`` set the loop resumes
+        afterwards (a controllable straggler stand-in); without it the
+        block is indefinite and only the watchdog's ``os._exit`` (or the
+        pod's liveness probe) ends the process. Exact equality, not >=:
+        a resumed run starting past the step must not re-hang.
+        """
+        at = self._cfg.hang_at_step
+        if at is None or self._hang_fired or step != at:
+            return
+        self._hang_fired = True
+        duration = self._cfg.hang_duration_sec
+        logger.warning(
+            "fault injection: hanging the host step loop at step %d (%s)",
+            step,
+            f"{duration:g}s" if duration is not None else "indefinitely",
+        )
+        deadline = None if duration is None else time.monotonic() + duration
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.05)
 
     def poison_host_losses(self, losses: Any, first_step: int) -> Any:
         """Scale the configured step's host-observed loss (one-shot).

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import re
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from pathlib import Path
 from typing import Any
 
@@ -110,7 +111,6 @@ class CheckpointManager:
         self._dir = Path(directory)
         self._keep_last_k = max(1, keep_last_k)
         self._pending: Any = None  # in-flight async write (Future)
-        self._executor: Any = None
         # Verification results keyed by (path, size, mtime_ns): pruning and
         # rollback re-verify the same unchanged files every save; hashing a
         # multi-GB checkpoint repeatedly would be pure waste.
@@ -189,17 +189,34 @@ class CheckpointManager:
         (reference trainer.py:402-413). At most one write runs at a time;
         queueing a new one first drains (and re-raises errors from) the
         previous. Call ``wait_pending`` before reading checkpoints back.
+
+        A plain DAEMON thread + Future, deliberately not ThreadPoolExecutor:
+        executor workers are non-daemon and joined by an atexit hook, so a
+        write wedged on dead storage would deadlock interpreter exit even
+        after ``close(timeout)`` "abandoned" it — the abort-path contract
+        (docs/robustness.md) requires the process to actually get out.
         """
-        from concurrent.futures import ThreadPoolExecutor
+        import threading
+        from concurrent.futures import Future
 
         self.wait_pending()
-        if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="ckpt-write"
-            )
-        self._pending = self._executor.submit(
-            self.save_host, step, host_state, resolved_config, resilience=resilience
-        )
+        future: Future = Future()
+
+        def work() -> None:
+            # False = wait_pending cancelled the write before we started.
+            if not future.set_running_or_notify_cancel():
+                return
+            try:
+                future.set_result(
+                    self.save_host(
+                        step, host_state, resolved_config, resilience=resilience
+                    )
+                )
+            except BaseException as exc:  # noqa: BLE001 — delivered via result()
+                future.set_exception(exc)
+
+        threading.Thread(target=work, name="ckpt-write", daemon=True).start()
+        self._pending = future
 
     def poll(self) -> None:
         """Non-blocking failure check: if the in-flight async write has
@@ -211,21 +228,57 @@ class CheckpointManager:
             self._pending = None
             pending.result()
 
-    def wait_pending(self) -> None:
+    def wait_pending(self, timeout: float | None = None) -> bool:
         """Block until the in-flight async write (if any) finishes; re-raise
-        its error."""
-        pending, self._pending = self._pending, None
-        if pending is not None:
-            pending.result()
+        its error. With a ``timeout``, give up after that many seconds and
+        return False, leaving the write in flight — abort/watchdog exit
+        paths must never deadlock behind a write wedged on dead storage.
+        Returns True when nothing is (any longer) pending."""
+        pending = self._pending
+        if pending is None:
+            return True
+        if timeout is not None and not pending.done():
+            # A queued-but-unstarted write can simply be withdrawn — but
+            # loudly, same as the timeout path: a checkpoint that silently
+            # never lands makes the next resume inexplicable.
+            if pending.cancel():
+                from ..utils.logging import get_logger
 
-    def close(self) -> None:
-        """Drain the pending write and stop the worker thread."""
+                get_logger().error(
+                    "queued async checkpoint write cancelled before it "
+                    "started (bounded drain); the newest on-disk checkpoint "
+                    "may be one save behind"
+                )
+                self._pending = None
+                return True
+        self._pending = None
         try:
-            self.wait_pending()
+            pending.result(timeout)
+        except FuturesTimeoutError:
+            # Still running: put it back so a later unbounded drain (or a
+            # repeat bounded attempt) can still observe its outcome.
+            self._pending = pending
+            return False
+        return True
+
+    def close(self, timeout: float | None = None) -> None:
+        """Drain the pending write. A ``timeout`` bounds the drain: on
+        expiry the write is ABANDONED (logged as an error; the daemon
+        writer thread cannot block process exit) instead of deadlocking —
+        the abort-path contract (docs/robustness.md)."""
+        try:
+            drained = self.wait_pending(timeout)
+            if not drained:
+                from ..utils.logging import get_logger
+
+                get_logger().error(
+                    "async checkpoint write still in flight after %.1fs; "
+                    "abandoning it (the newest on-disk checkpoint may be one "
+                    "save behind)",
+                    timeout,
+                )
         finally:
-            executor, self._executor = self._executor, None
-            if executor is not None:
-                executor.shutdown(wait=True)
+            self._pending = None
 
     def _prune(self) -> None:
         """Keep the last k checkpoints by step — but NEVER delete the newest

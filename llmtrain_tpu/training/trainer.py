@@ -46,9 +46,12 @@ from ..parallel.sharding import (
 from ..registry import get_data_module
 from ..resilience import (
     FaultPlan,
+    HangWatchdog,
     LossSpikeDetector,
     NonFiniteLossError,
+    ProgressBeacon,
     RollbackBudgetExceededError,
+    StragglerTracker,
     retry,
 )
 from ..tracking.base import Tracker
@@ -60,6 +63,10 @@ from .optimizer import build_optimizer, lr_schedule
 from .train_step import TrainState, make_eval_step, make_train_step
 
 logger = get_logger()
+
+# Abort/watchdog paths bound their drain of the in-flight async checkpoint
+# write by this much before abandoning it (docs/robustness.md).
+_ABORT_DRAIN_TIMEOUT_SEC = 30.0
 
 
 @dataclass(frozen=True)
@@ -114,6 +121,8 @@ class Trainer:
         self._data_offset = 0
         self._spike_detector: LossSpikeDetector | None = None
         self._last_restored_resilience: dict[str, Any] = {}
+        self._beacon: ProgressBeacon | None = None
+        self._straggler: StragglerTracker | None = None
 
         tokenizer = None
         try:
@@ -493,6 +502,9 @@ class Trainer:
         )
 
         res_cfg = self._resilience
+        multi_process = (
+            self._dist_state is not None and self._dist_state.num_processes > 1
+        )
         self._spike_detector = (
             LossSpikeDetector(
                 factor=res_cfg.spike_factor,
@@ -502,8 +514,81 @@ class Trainer:
             if res_cfg.spike_detection
             else None
         )
+        if self._spike_detector is not None and multi_process:
+            # Rollback restores the SAME checkpoint file on every rank via
+            # a consensus all-gather (see _maybe_rollback); a rank that
+            # cannot even resolve the checkpoint dir would desync the
+            # collective the moment a spike fires. The CLI hands every rank
+            # the shared run-dir path (reads only; writes stay rank-0
+            # gated) — direct embedders must do the same. The missing-
+            # manager flag is itself all-gathered so EVERY rank raises
+            # together: a local-only raise would leave the other ranks
+            # wedged in their first collective until the distributed
+            # timeout — the exact opaque hang this check exists to avoid.
+            from ..distributed import allgather_any
+
+            if allgather_any(self._ckpt_mgr is None):
+                raise ValueError(
+                    "multi-process spike rollback requires every rank to "
+                    "see the shared run directory (checkpoints volume); "
+                    "construct the Trainer with the run-dir path on all "
+                    "ranks or disable resilience.spike_detection"
+                )
         self._rollback_count = 0
         self._data_offset = 0
+
+        # Hang watchdog + heartbeat + straggler telemetry (resilience/
+        # watchdog.py, docs/robustness.md). The beacon records progress at
+        # each dispatched step; the watchdog hard-exits with the retryable
+        # EXIT_HANG_DETECTED when nothing lands within the stall timeout.
+        wd_cfg = res_cfg.watchdog
+        self._beacon = None
+        watchdog: HangWatchdog | None = None
+        if wd_cfg.enabled:
+            hb_path = wd_cfg.heartbeat_path
+            if hb_path is None and self._run_dir is not None:
+                # Default lands in the run dir — which multi-process runs
+                # SHARE, so non-main ranks get a per-rank suffix: one file
+                # for all ranks would let a healthy rank's touches mask a
+                # hung one from any external freshness check. An explicit
+                # heartbeat_path is honored verbatim (the k8s probes stat
+                # a container-LOCAL path, so sharing cannot happen there).
+                name = "heartbeat"
+                if multi_process and not self._is_main:
+                    name = f"heartbeat.r{self._dist_state.process_index}"
+                hb_path = str(Path(self._run_dir) / name)
+            self._beacon = ProgressBeacon(
+                hb_path, heartbeat_interval_sec=wd_cfg.heartbeat_interval_sec
+            )
+            import tempfile
+
+            report_dir = (
+                Path(self._run_dir)
+                if self._run_dir is not None
+                else Path(tempfile.gettempdir())
+            )
+            watchdog = HangWatchdog(
+                self._beacon,
+                stall_timeout_sec=wd_cfg.stall_timeout_sec,
+                poll_interval_sec=wd_cfg.poll_interval_sec,
+                report_dir=report_dir,
+                process_index=(
+                    self._dist_state.process_index if self._dist_state else 0
+                ),
+                # Before the hard exit, drain-or-abandon the in-flight
+                # async checkpoint write with a bounded wait: never block
+                # the watchdog behind a write wedged on the same dead
+                # storage that may have caused the hang.
+                on_hang=self._drain_checkpoints_for_abort,
+            )
+        self._straggler = (
+            StragglerTracker(
+                skew_factor=wd_cfg.straggler_skew_factor,
+                patience=wd_cfg.straggler_patience,
+            )
+            if multi_process and wd_cfg.straggler_telemetry
+            else None
+        )
 
         resumed_from_step: int | None = None
         if resume_from is not None:
@@ -563,20 +648,6 @@ class Trainer:
         # installed by C code, and that handler must be restored too.
         handler_installed = False
         old_term = None
-        multi_process = (
-            self._dist_state is not None and self._dist_state.num_processes > 1
-        )
-        if self._spike_detector is not None and multi_process:
-            # Rollback needs every rank to restore the same file, but only
-            # the main rank owns a checkpoint manager — a main-only rollback
-            # would deadlock the next collective. Single-process (the k8s
-            # one-pod story) is where auto-rollback operates today.
-            logger.warning(
-                "spike rollback is single-process only for now; disabling "
-                "the detector on this %d-process run",
-                self._dist_state.num_processes,
-            )
-            self._spike_detector = None
 
         def _on_sigterm(signum, frame):  # pragma: no cover - exercised via kill
             nonlocal preempted
@@ -585,6 +656,17 @@ class Trainer:
         if threading.current_thread() is threading.main_thread():
             old_term = signal.signal(signal.SIGTERM, _on_sigterm)
             handler_installed = True
+        else:
+            # signal.signal only works on the main thread. Embedding the
+            # trainer in a worker thread therefore silently loses the
+            # checkpoint-on-eviction path — make that loudly visible
+            # instead of discovering it at the first preemption.
+            logger.warning(
+                "Trainer.fit is running off the main thread: SIGTERM "
+                "preemption handling is DISABLED for this run (no "
+                "checkpoint-on-eviction; the process default handler "
+                "applies)"
+            )
 
         past_end_loss: float | None = None
         final_step_override: int | None = None
@@ -606,9 +688,28 @@ class Trainer:
                     batch = self._global_batch(sampler, train_ds, step)
                     self._state, metrics = self._train_step_fn(self._state, batch, run_key)
                     profiler.maybe_stop(step, sync=metrics["loss"])
+                    if self._beacon is not None:
+                        # Progress = the step DISPATCHED. A hung device
+                        # backpressures the host within a step or two (the
+                        # dispatch queue is bounded and log boundaries
+                        # block on device_get), so host-side dispatch time
+                        # is a faithful liveness signal for both host and
+                        # device stalls. The watchdog arms at the FIRST
+                        # dispatched step, so the (minutes-long on a pod
+                        # slice) first-step compile never counts against
+                        # the stall timeout — init-time wedges belong to
+                        # the rendezvous timeout and the k8s probe, not to
+                        # the step-progress watchdog.
+                        self._beacon.touch(step)
+                        if watchdog is not None:
+                            watchdog.arm()  # no-op once armed
                     # Injected preemption goes through the real OS signal
                     # path, so everything below sees a genuine SIGTERM.
                     self._faults.maybe_sigterm(step)
+                    # Injected hang BLOCKS here for real — the beacon is
+                    # stranded at this step and the watchdog must end the
+                    # process (tests/test_watchdog.py, end to end).
+                    self._faults.maybe_hang(step)
 
                     step_loss_dev = metrics["loss"]
                     nonfinite_dev = metrics.get("nonfinite_count")
@@ -623,13 +724,9 @@ class Trainer:
                         first_step_loss = float(jax.device_get(metrics["loss"]))
 
                     if multi_process and step % log_every == 0:
-                        from jax.experimental import multihost_utils
+                        from ..distributed import allgather_any
 
-                        stop_now = bool(
-                            multihost_utils.process_allgather(
-                                np.asarray([preempted])
-                            ).any()
-                        )
+                        stop_now = allgather_any(preempted)
                     else:
                         stop_now = preempted and not multi_process
                     # A signal during the very last step changes nothing:
@@ -638,14 +735,30 @@ class Trainer:
                     stop_now = stop_now and step < max_steps
                     if step % save_every == 0 or step == max_steps or stop_now:
                         self._save_checkpoint(step)
-                        self._faults.maybe_corrupt_checkpoint(step, self._ckpt_mgr)
+                        # Injection on the WRITING rank only: non-main ranks
+                        # now hold read-side managers over the same shared
+                        # dir, and two ranks XOR-garbling the same bytes
+                        # would un-corrupt the file (and their wait_pending
+                        # is a no-op against rank 0's in-flight write).
+                        self._faults.maybe_corrupt_checkpoint(
+                            step, self._ckpt_mgr if self._is_main else None
+                        )
 
                     if stop_now:
-                        if self._ckpt_mgr is not None:
+                        if self._ckpt_mgr is not None and self._is_main:
                             logger.warning(
                                 "SIGTERM received: preemption checkpoint "
                                 "saved at step %d; stopping cleanly (resume "
                                 "with --resume)",
+                                step,
+                            )
+                        elif self._ckpt_mgr is not None:
+                            # Non-main rank with a (read-side) manager: the
+                            # save happened on the main rank only.
+                            logger.warning(
+                                "SIGTERM received: stopping cleanly at step "
+                                "%d (preemption checkpoint written by the "
+                                "main rank)",
                                 step,
                             )
                         else:
@@ -715,6 +828,8 @@ class Trainer:
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
             loop_completed = True
         finally:
+            if watchdog is not None:
+                watchdog.disarm()
             if handler_installed:
                 # old_term None = the previous handler was installed by C
                 # code; Python cannot re-install it, but SIG_DFL at least
@@ -734,7 +849,11 @@ class Trainer:
                     self._ckpt_mgr.close()
                 else:
                     try:
-                        self._ckpt_mgr.close()
+                        # Bounded drain on the abort path: a write wedged on
+                        # dead storage must not deadlock the exit that is
+                        # already unwinding an exception (the timeout
+                        # abandons it with an error log).
+                        self._ckpt_mgr.close(timeout=_ABORT_DRAIN_TIMEOUT_SEC)
                     except Exception as ckpt_exc:  # noqa: BLE001
                         logger.error(
                             "async checkpoint write failed during unwind: %s", ckpt_exc
@@ -834,8 +953,50 @@ class Trainer:
                 spike_step = first_interval_step + i
                 spike_loss, trend = float(value), detector.trend
                 break
+        multi_process = (
+            self._dist_state is not None and self._dist_state.num_processes > 1
+        )
+        if multi_process:
+            # Consensus: ANY rank's spike rolls back EVERY rank. Losses are
+            # replicated (out_shardings), so ranks normally agree already —
+            # the all-gather removes the numeric edge cases where they
+            # don't, which would otherwise desync the next collective. The
+            # earliest flagged step wins so the restore point predates all
+            # local views of the spike. This collective runs at every log
+            # boundary the detector is active for, on every rank — the
+            # boundary already syncs on host losses, so it's noise.
+            from ..distributed import allgather_scalar
+
+            views = allgather_scalar(
+                float(spike_step) if spike_step is not None else -1.0
+            )
+            flagged = [int(v) for v in views if v >= 0]
+            consensus_step = min(flagged) if flagged else None
+            if consensus_step is not None and spike_step is None:
+                logger.warning(
+                    "loss spike at step %d flagged by another rank; joining "
+                    "the consensus rollback",
+                    consensus_step,
+                )
+            spike_step = consensus_step
         if spike_step is None:
             return None
+        if spike_loss is None:
+            # Consensus-joined rank: the spiking loss was another rank's
+            # observation; log NaN rather than faking a local value.
+            spike_loss = float("nan")
+            if trend is None:
+                trend = (
+                    detector.trend if detector.trend is not None else float("nan")
+                )
+        if multi_process and self._ckpt_mgr is None:
+            # fit() validates this up front; reaching it means the manager
+            # vanished mid-run — desyncing the consensus would hang every
+            # rank, so fail loudly instead.
+            raise RuntimeError(
+                "consensus spike rollback needs a checkpoint manager on "
+                "every rank but this rank has none"
+            )
         if self._ckpt_mgr is None:
             logger.error(
                 "loss spike at step %d (%.4f vs trend %.4f) but no checkpoint "
@@ -858,7 +1019,44 @@ class Trainer:
         # land inside a spiking interval, and that checkpoint — valid by
         # integrity, poisoned by value — must not become the restore point.
         self._ckpt_mgr.wait_pending()
-        target = self._ckpt_mgr.latest_valid_checkpoint(before_step=spike_step)
+        if multi_process:
+            # Rank 0 owns the target decision (its manager did the writes);
+            # broadcasting the STEP — not each rank scanning the shared dir
+            # independently — removes any filesystem-visibility race from
+            # the agreement. Every rank then restores the same file.
+            from ..distributed import broadcast_int_from_main
+
+            target_step = -1
+            if self._is_main:
+                picked = self._ckpt_mgr.latest_valid_checkpoint(
+                    before_step=spike_step
+                )
+                if picked is not None:
+                    target_step = int(picked.stem.split("_")[1])
+            target_step = broadcast_int_from_main(target_step)
+            target = (
+                self._ckpt_mgr.directory / f"step_{target_step:06d}.ckpt"
+                if target_step >= 0
+                else None
+            )
+            if target is not None and not self._is_main:
+                # The broadcast removes the AGREEMENT race, not the READ
+                # race: rank 0 verified the file in its own filesystem
+                # view, but a shared-FS attribute cache (NFS acdirmax) can
+                # lag on other ranks. Poll briefly before restoring —
+                # crashing here would strand every other rank in the
+                # restore collective until the distributed timeout.
+                deadline = time.monotonic() + 60.0
+                while not target.is_file() and time.monotonic() < deadline:
+                    time.sleep(0.5)
+                if not target.is_file():
+                    raise RuntimeError(
+                        f"rollback target {target} (broadcast by rank 0) "
+                        "never became visible on this rank's filesystem "
+                        "view — shared runs volume misconfigured?"
+                    )
+        else:
+            target = self._ckpt_mgr.latest_valid_checkpoint(before_step=spike_step)
         if target is None:
             # Early spike, before the first periodic save: nothing to
             # restore, so train through it (same stance as the
@@ -893,6 +1091,13 @@ class Trainer:
             (step - restored_step) * accum,
         )
         return restored_step
+
+    def _drain_checkpoints_for_abort(self) -> None:
+        """Bounded drain of the in-flight async checkpoint write for the
+        watchdog's pre-exit hook: give a healthy write a chance to land,
+        abandon a wedged one instead of deadlocking the hard exit."""
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.close(timeout=_ABORT_DRAIN_TIMEOUT_SEC)
 
     def _resilience_payload(self) -> dict[str, Any] | None:
         """Small scalar dict saved alongside the state so guard counter,
@@ -970,6 +1175,35 @@ class Trainer:
         current_lr = float(jax.device_get(self._schedule(step - 1)))
         # MFU from per-chip throughput — new observability over the reference,
         # which only tracks tokens_per_sec (SURVEY §5/§6).
+        # Straggler telemetry (multi-process only): all-gather every host's
+        # mean step time for this interval and reduce to max/median skew. A
+        # persistently slowest host is the canonical precursor of a full
+        # stall — surface it while the job is still making progress. Rides
+        # the boundary the ranks already synchronize at: no extra syncs.
+        step_time_skew: float | None = None
+        if self._straggler is not None:
+            from ..distributed import allgather_scalar
+
+            per_host = np.asarray(allgather_scalar(avg_step_time))
+            straggle = self._straggler.observe(per_host)
+            step_time_skew = straggle["skew"]
+            logger.info(
+                "stragglers: step_time max=%.4fs median=%.4fs skew=%.2fx "
+                "(slowest host %d)",
+                straggle["max_sec"],
+                straggle["median_sec"],
+                straggle["skew"],
+                straggle["slowest_host"],
+            )
+            if straggle["persistent"]:
+                logger.warning(
+                    "persistent straggler: host %d has been the slowest "
+                    "with >=%.1fx skew for %d consecutive intervals — "
+                    "check that host before it stalls the job",
+                    straggle["slowest_host"],
+                    self._resilience.watchdog.straggler_skew_factor,
+                    straggle["streak"],
+                )
         n_chips = self._mesh.devices.size
         interval_mfu = compute_mfu(
             tokens_per_sec / n_chips,
@@ -995,17 +1229,17 @@ class Trainer:
                         },
                         step=step,
                     )
-            self._tracker.log_metrics(
-                {
-                    "train/loss": avg_loss,
-                    "train/lr": current_lr,
-                    "train/tokens_per_sec": tokens_per_sec,
-                    "train/step_time_sec": avg_step_time,
-                    "train/tokens_total": float(total_tokens),
-                    "train/mfu": interval_mfu,
-                },
-                step=step,
-            )
+            global_metrics = {
+                "train/loss": avg_loss,
+                "train/lr": current_lr,
+                "train/tokens_per_sec": tokens_per_sec,
+                "train/step_time_sec": avg_step_time,
+                "train/tokens_total": float(total_tokens),
+                "train/mfu": interval_mfu,
+            }
+            if step_time_skew is not None:
+                global_metrics["train/step_time_skew"] = step_time_skew
+            self._tracker.log_metrics(global_metrics, step=step)
 
         logger.info(
             "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs  mfu=%.4f",

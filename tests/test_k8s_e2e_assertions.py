@@ -143,6 +143,31 @@ class TestAssertTrackingDb:
         assert "no recorded run" in r.stderr
 
 
+class TestAssertHeartbeat:
+    def test_passes_on_fresh_heartbeat(self, tmp_path):
+        hb = tmp_path / "heartbeat"
+        hb.touch()
+        r = _sh(f'assert_heartbeat "{hb}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "heartbeat fresh" in r.stdout
+
+    def test_fails_on_missing_file(self, tmp_path):
+        r = _sh(f'assert_heartbeat "{tmp_path}/nope"')
+        assert r.returncode != 0
+        assert "heartbeat file missing" in r.stderr
+
+    def test_fails_on_stale_mtime(self, tmp_path):
+        """The same freshness computation the livenessProbe exec performs:
+        a back-dated mtime must FAIL."""
+        hb = tmp_path / "heartbeat"
+        hb.touch()
+        old = 10_000  # seconds in the past
+        os.utime(hb, (hb.stat().st_atime - old, hb.stat().st_mtime - old))
+        r = _sh(f'assert_heartbeat "{hb}" 600')
+        assert r.returncode != 0
+        assert "heartbeat stale" in r.stderr
+
+
 # ---------------------------------------------------------------- manifests
 
 
@@ -177,7 +202,51 @@ class TestManifestStructure:
         spec = job["spec"]
         assert spec["completionMode"] == "Indexed"
         assert spec["completions"] == spec["parallelism"]
-        assert spec["backoffLimit"] == 0  # fail fast, don't flap rendezvous
+        # Retryable failures burn a bounded backoff budget; fatal codes
+        # fail the Job fast via the podFailurePolicy below.
+        assert spec["backoffLimit"] > 0
+
+    @pytest.mark.parametrize("job_file", ["job.yaml", "job-tpu-v5e.yaml"])
+    def test_jobs_consume_the_exit_code_taxonomy(self, manifests, job_file):
+        """podFailurePolicy must agree with resilience/exit_codes.py:
+        fatal codes (1/2) FailJob, retryable ones (75/76) are retried."""
+        from llmtrain_tpu.resilience.exit_codes import (
+            EXIT_CONFIG_ERROR,
+            EXIT_HANG_DETECTED,
+            EXIT_RETRYABLE_INFRA,
+            EXIT_TRAIN_FAILURE,
+        )
+
+        (job,) = _by_kind(manifests[job_file], "Job")
+        rules = job["spec"]["podFailurePolicy"]["rules"]
+        by_action = {r["action"]: r["onExitCodes"]["values"] for r in rules}
+        assert set(by_action["FailJob"]) == {EXIT_TRAIN_FAILURE, EXIT_CONFIG_ERROR}
+        retried = set(by_action["Count"])
+        assert {EXIT_RETRYABLE_INFRA, EXIT_HANG_DETECTED} <= retried
+
+    @pytest.mark.parametrize("job_file", ["job.yaml", "job-tpu-v5e.yaml"])
+    def test_jobs_have_heartbeat_liveness_probe(self, manifests, job_file):
+        """The probe's exec must check the same heartbeat path the
+        ConfigMap points the watchdog at, and tolerate a missing file
+        (startup/compile must not be probe-killed)."""
+        (job,) = _by_kind(manifests[job_file], "Job")
+        (ctr,) = job["spec"]["template"]["spec"]["containers"]
+        probe = ctr["livenessProbe"]
+        cmd = " ".join(probe["exec"]["command"])
+        assert "/tmp/llmtrain-heartbeat" in cmd
+        assert "! -f" in cmd  # missing-file-passes startup contract
+        assert probe["periodSeconds"] >= 10
+
+    def test_configmap_heartbeat_paths_match_the_probes(self, manifests):
+        """watchdog.heartbeat_path in every embedded train.yaml must be the
+        container-local path the livenessProbe execs stat."""
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            for key, raw in cm.get("data", {}).items():
+                if key.endswith(".yaml"):
+                    cfg = yaml.safe_load(raw)
+                    wd = cfg["resilience"]["watchdog"]
+                    assert wd["enabled"] is True
+                    assert wd["heartbeat_path"] == "/tmp/llmtrain-heartbeat"
 
     def test_job_references_resolve(self, manifests):
         """Every name job.yaml references must exist in infra/configmap."""

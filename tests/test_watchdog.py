@@ -1,0 +1,635 @@
+"""Hang watchdog, straggler telemetry, and exit-code taxonomy tests
+(llmtrain_tpu/resilience/watchdog.py + exit_codes.py).
+
+The acceptance pillar runs END TO END through a real CLI subprocess: a
+config-injected host hang (``resilience.faults.hang_at_step`` blocks the
+step loop for real) is detected by the armed watchdog within a sub-second
+stall timeout, produces a ``hang_report_*.txt`` with every thread's stack,
+and exits with the documented retryable code — while an identical clean
+run exits 0 with the watchdog armed and never firing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.distributed import DistState
+from llmtrain_tpu.registry import initialize_registries
+from llmtrain_tpu.resilience import (
+    EXIT_HANG_DETECTED,
+    EXIT_RETRYABLE_INFRA,
+    EXIT_TRAIN_FAILURE,
+    HangWatchdog,
+    InjectedFault,
+    NonFiniteLossError,
+    ProgressBeacon,
+    RetryableInfraError,
+    RollbackBudgetExceededError,
+    StragglerTracker,
+    exit_code_for_exception,
+    heartbeat_age_seconds,
+    is_retryable,
+)
+from llmtrain_tpu.tracking import NullTracker
+from llmtrain_tpu.training import CheckpointManager, Trainer
+
+pytestmark = []  # deliberately unmarked: tier-1 must exercise hang recovery
+
+
+@pytest.fixture(autouse=True)
+def _registries():
+    initialize_registries()
+
+
+@pytest.fixture(autouse=True)
+def _capture_llmtrain_logs():
+    """Earlier test files may have run configure_logging in-process, which
+    sets the 'llmtrain' logger's propagate=False — silently breaking every
+    caplog assertion below. Force propagation for this module's tests."""
+    logger = logging.getLogger("llmtrain")
+    prev = logger.propagate
+    logger.propagate = True
+    yield
+    logger.propagate = prev
+
+
+def _cfg(tmp_path=None, **overrides):
+    base = {
+        "run": {"name": "wdog", "seed": 7},
+        "model": {
+            "name": "dummy_gpt",
+            "block_size": 8,
+            "vocab_size": 32,
+            "dropout": 0.0,
+            "d_model": 48,
+            "n_heads": 2,
+            "d_ff": 96,
+            "n_layers": 1,
+        },
+        "data": {"name": "dummy_text"},
+        "trainer": {
+            "max_steps": 6,
+            "micro_batch_size": 2,
+            "grad_accum_steps": 1,
+            "lr": 3e-3,
+            "warmup_steps": 0,
+            "log_every_steps": 2,
+            "eval_every_steps": 100,
+            "save_every_steps": 100,
+        },
+        "mlflow": {"enabled": False},
+    }
+    if tmp_path is not None:
+        base["output"] = {"root_dir": str(tmp_path)}
+    for section, values in overrides.items():
+        base[section] = {**base.get(section, {}), **values}
+    return RunConfig.model_validate(base)
+
+
+def _run_cli_main(argv: list[str]) -> int:
+    """cli.main in-process, preserving the 'llmtrain' logger state: the CLI
+    reconfigures it (propagate=False, handlers), which would break caplog
+    for every later test in this process."""
+    from llmtrain_tpu import cli
+
+    logger = logging.getLogger("llmtrain")
+    prev_propagate = logger.propagate
+    prev_level = logger.level
+    prev_handlers = list(logger.handlers)
+    try:
+        return cli.main(argv)
+    finally:
+        logger.propagate = prev_propagate
+        logger.setLevel(prev_level)
+        for h in list(logger.handlers):
+            if h not in prev_handlers:
+                logger.removeHandler(h)
+
+
+# --------------------------------------------------------------------------
+# progress beacon + heartbeat freshness
+# --------------------------------------------------------------------------
+
+
+class TestProgressBeacon:
+    def test_touch_records_step_and_creates_heartbeat(self, tmp_path):
+        hb = tmp_path / "hb"
+        beacon = ProgressBeacon(hb, heartbeat_interval_sec=0.0)
+        assert heartbeat_age_seconds(hb) is None  # not yet created
+        beacon.touch(3)
+        step, age = beacon.snapshot()
+        assert step == 3
+        assert age < 1.0
+        fresh = heartbeat_age_seconds(hb)
+        assert fresh is not None and fresh < 5.0
+
+    def test_heartbeat_staleness_is_observable(self, tmp_path):
+        """The freshness computation the k8s livenessProbe exec performs:
+        a back-dated mtime reads as stale."""
+        hb = tmp_path / "hb"
+        ProgressBeacon(hb, heartbeat_interval_sec=0.0).touch(1)
+        past = time.time() - 3600
+        os.utime(hb, (past, past))
+        assert heartbeat_age_seconds(hb) > 3000
+
+    def test_heartbeat_rate_limit(self, tmp_path):
+        hb = tmp_path / "hb"
+        beacon = ProgressBeacon(hb, heartbeat_interval_sec=3600.0)
+        beacon.touch(1)
+        first = hb.stat().st_mtime_ns
+        time.sleep(0.05)
+        beacon.touch(2)  # inside the interval: no second write
+        assert hb.stat().st_mtime_ns == first
+        assert beacon.snapshot()[0] == 2  # progress still recorded
+
+    def test_no_heartbeat_path_is_fine(self):
+        beacon = ProgressBeacon(None)
+        beacon.touch(1)
+        assert beacon.snapshot()[0] == 1
+
+
+# --------------------------------------------------------------------------
+# watchdog unit behavior (exit_fn injected; the REAL os._exit path is
+# exercised by the subprocess e2e below)
+# --------------------------------------------------------------------------
+
+
+class TestHangWatchdog:
+    def test_stall_fires_report_and_exit(self, tmp_path):
+        beacon = ProgressBeacon(None)
+        exits: list[int] = []
+        drained = {"called": False}
+
+        def fake_exit(code):
+            exits.append(code)
+
+        marker = threading.Event()
+        helper = threading.Thread(
+            target=marker.wait, name="stuck-collective-stand-in", daemon=True
+        )
+        helper.start()
+        try:
+            wd = HangWatchdog(
+                beacon,
+                stall_timeout_sec=0.2,
+                report_dir=tmp_path,
+                exit_fn=fake_exit,
+                on_hang=lambda: drained.__setitem__("called", True),
+            )
+            wd.arm()
+            deadline = time.monotonic() + 5.0
+            while not wd.fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+            wd.disarm()
+            assert wd.fired
+            assert exits == [EXIT_HANG_DETECTED]
+            assert drained["called"]
+            report = list(tmp_path.glob("hang_report_*.txt"))
+            assert report == [wd.report_path]
+            text = report[0].read_text()
+            # All-thread stacks: the main thread AND the named helper.
+            assert "MainThread" in text
+            assert "stuck-collective-stand-in" in text
+            assert "jax" in text  # device diagnostics section
+        finally:
+            marker.set()
+
+    def test_live_beacon_never_fires(self, tmp_path):
+        beacon = ProgressBeacon(None)
+        exits: list[int] = []
+        wd = HangWatchdog(
+            beacon,
+            stall_timeout_sec=0.3,
+            report_dir=tmp_path,
+            exit_fn=exits.append,
+        )
+        with wd:
+            for step in range(10):
+                beacon.touch(step)
+                time.sleep(0.05)
+        assert not wd.fired
+        assert exits == []
+        assert list(tmp_path.glob("hang_report_*.txt")) == []
+
+    def test_on_hang_failure_does_not_block_exit(self, tmp_path):
+        beacon = ProgressBeacon(None)
+        exits: list[int] = []
+
+        def broken_hook():
+            raise RuntimeError("drain failed")
+
+        wd = HangWatchdog(
+            beacon,
+            stall_timeout_sec=0.1,
+            report_dir=tmp_path,
+            exit_fn=exits.append,
+            on_hang=broken_hook,
+        )
+        wd.arm()
+        deadline = time.monotonic() + 5.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.02)
+        wd.disarm()
+        assert exits == [EXIT_HANG_DETECTED]
+
+
+# --------------------------------------------------------------------------
+# straggler telemetry
+# --------------------------------------------------------------------------
+
+
+class TestStragglerTracker:
+    def test_skew_and_slowest_host(self):
+        t = StragglerTracker(skew_factor=2.0, patience=3)
+        rep = t.observe(np.array([0.10, 0.11, 0.35, 0.10]))
+        assert rep["slowest_host"] == 2
+        assert rep["max_sec"] == pytest.approx(0.35)
+        # Skew is measured against the median of the OTHER hosts, so the
+        # straggler cannot dilute its own signal on small host counts.
+        assert rep["skew"] == pytest.approx(0.35 / 0.10)
+        assert not rep["persistent"]
+
+    def test_persistent_straggler_needs_same_host_and_patience(self):
+        t = StragglerTracker(skew_factor=2.0, patience=2)
+        assert not t.observe(np.array([0.1, 0.5]))["persistent"]
+        assert t.observe(np.array([0.1, 0.5]))["persistent"]  # streak = 2
+        # A different slowest host resets the streak.
+        assert not t.observe(np.array([0.5, 0.1]))["persistent"]
+        # Balanced intervals clear it entirely.
+        rep = t.observe(np.array([0.1, 0.1]))
+        assert rep["streak"] == 0 and not rep["persistent"]
+
+    def test_single_host_degenerates_cleanly(self):
+        rep = StragglerTracker().observe(np.array([0.2]))
+        assert rep["skew"] == pytest.approx(1.0)
+        assert not rep["persistent"]
+
+
+# --------------------------------------------------------------------------
+# exit-code taxonomy
+# --------------------------------------------------------------------------
+
+
+class TestExitCodeTaxonomy:
+    @pytest.mark.parametrize(
+        "exc,code",
+        [
+            (TimeoutError("rendezvous"), EXIT_RETRYABLE_INFRA),
+            (ConnectionError("coordinator"), EXIT_RETRYABLE_INFRA),
+            (RetryableInfraError("nfs blip"), EXIT_RETRYABLE_INFRA),
+            (InjectedFault("flaky"), EXIT_RETRYABLE_INFRA),
+            (NonFiniteLossError("diverged"), EXIT_TRAIN_FAILURE),
+            (RollbackBudgetExceededError("budget"), EXIT_TRAIN_FAILURE),
+            (RuntimeError("bug"), EXIT_TRAIN_FAILURE),
+            (ValueError("bad arg"), EXIT_TRAIN_FAILURE),
+        ],
+    )
+    def test_direct_mapping(self, exc, code):
+        assert exit_code_for_exception(exc) == code
+
+    def test_wrapped_retryable_cause_classifies_retryable(self):
+        try:
+            try:
+                raise TimeoutError("coordinator never answered")
+            except TimeoutError as inner:
+                raise RuntimeError("training failed") from inner
+        except RuntimeError as outer:
+            assert exit_code_for_exception(outer) == EXIT_RETRYABLE_INFRA
+
+    def test_divergence_beats_wrapped_transient(self):
+        """A deterministic divergence wrapping a transient error must stay
+        fatal — retrying replays the same math."""
+        try:
+            try:
+                raise TimeoutError("incidental")
+            except TimeoutError as inner:
+                raise NonFiniteLossError("diverged") from inner
+        except NonFiniteLossError as outer:
+            assert exit_code_for_exception(outer) == EXIT_TRAIN_FAILURE
+
+    def test_suppressed_context_does_not_leak_retryable(self):
+        """`raise X from None` severs the chain: a deterministic error
+        raised while handling a transient one must stay fatal."""
+        try:
+            try:
+                raise TimeoutError("transient")
+            except TimeoutError:
+                raise ValueError("split not found") from None
+        except ValueError as exc:
+            assert exit_code_for_exception(exc) == EXIT_TRAIN_FAILURE
+
+    def test_unsuppressed_context_still_classifies(self):
+        """A plain re-raise inside an except block keeps the implicit
+        chain, so the transient root cause is still visible."""
+        try:
+            try:
+                raise ConnectionError("coordinator reset")
+            except ConnectionError:
+                raise RuntimeError("training failed")
+        except RuntimeError as exc:
+            assert exit_code_for_exception(exc) == EXIT_RETRYABLE_INFRA
+
+    def test_retryable_set(self):
+        assert is_retryable(EXIT_RETRYABLE_INFRA)
+        assert is_retryable(EXIT_HANG_DETECTED)
+        assert not is_retryable(0)
+        assert not is_retryable(1)
+        assert not is_retryable(2)
+
+    def test_cli_maps_injected_infra_failure_to_retryable(self, tmp_path):
+        """The train handler classifies a flaky dataset load (InjectedFault
+        past the retry budget) as retryable infra, not generic failure."""
+        cfg = _cfg(tmp_path)
+        raw = cfg.model_dump()
+        raw["resilience"]["retry_attempts"] = 1
+        raw["resilience"]["faults"]["dataset_load_failures"] = 5
+        cfg_path = tmp_path / "flaky.yaml"
+        cfg_path.write_text(yaml.safe_dump(raw))
+        assert _run_cli_main(["train", "--config", str(cfg_path)]) == (
+            EXIT_RETRYABLE_INFRA
+        )
+
+    def test_cli_maps_distributed_misconfig_to_config_error(
+        self, tmp_path, monkeypatch
+    ):
+        """A deterministic rendezvous misconfiguration (multi-process with
+        no process id) must exit fatal-config, not retryable — restarting
+        the pod would replay it forever."""
+        from llmtrain_tpu.distributed import teardown_distributed
+
+        teardown_distributed()  # clear any stale idempotency latch
+        for var in (
+            "RANK",
+            "JAX_PROCESS_ID",
+            "WORLD_SIZE",
+            "JAX_NUM_PROCESSES",
+            "MASTER_ADDR",
+            "JAX_COORDINATOR_ADDRESS",
+        ):
+            monkeypatch.delenv(var, raising=False)
+        cfg = _cfg(tmp_path)
+        raw = cfg.model_dump()
+        raw["distributed"]["enabled"] = True
+        raw["distributed"]["num_processes"] = 2  # process_id left unset
+        raw["resilience"]["retry_attempts"] = 1
+        raw["resilience"]["retry_base_delay"] = 0.0
+        cfg_path = tmp_path / "misconf.yaml"
+        cfg_path.write_text(yaml.safe_dump(raw))
+        from llmtrain_tpu.resilience import EXIT_CONFIG_ERROR
+
+        assert _run_cli_main(["train", "--config", str(cfg_path)]) == (
+            EXIT_CONFIG_ERROR
+        )
+
+
+# --------------------------------------------------------------------------
+# bounded drain of the in-flight async checkpoint write (satellite)
+# --------------------------------------------------------------------------
+
+
+class TestBoundedCheckpointDrain:
+    def test_wait_pending_and_close_abandon_a_wedged_write(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        """A write wedged on dead storage must not deadlock wait_pending or
+        close when the caller bounds them — the watchdog/abort contract."""
+        mgr = CheckpointManager(tmp_path / "ck")
+        release = threading.Event()
+        monkeypatch.setattr(
+            mgr, "save_host", lambda *a, **k: release.wait(), raising=False
+        )
+        try:
+            mgr.save_host_async(1, {}, {})
+            start = time.monotonic()
+            assert mgr.wait_pending(timeout=0.2) is False
+            with caplog.at_level(logging.ERROR, logger="llmtrain"):
+                mgr.close(timeout=0.2)
+            elapsed = time.monotonic() - start
+            assert elapsed < 5.0, f"bounded drain took {elapsed:.1f}s"
+            assert any("abandoning" in r.message for r in caplog.records)
+        finally:
+            release.set()
+
+    def test_unbounded_close_still_drains(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "ck")
+        mgr.save_host_async(
+            1,
+            {"params": {"w": np.zeros(2, np.float32)}, "opt_state": {}},
+            {"a": 1},
+        )
+        mgr.close()
+        assert (tmp_path / "ck" / "step_000001.ckpt").is_file()
+
+
+# --------------------------------------------------------------------------
+# trainer integration (in-process; injected exit_fn is NOT used here — the
+# real os._exit path runs in the subprocess e2e below)
+# --------------------------------------------------------------------------
+
+
+class TestTrainerIntegration:
+    def test_bounded_hang_injection_blocks_for_real(self, tmp_path, caplog):
+        """hang_duration_sec actually stalls the host loop (wall clock
+        proves it) and the run then completes — the injection is real,
+        not a flag."""
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "faults": {"hang_at_step": 2, "hang_duration_sec": 0.4}
+            },
+        )
+        start = time.monotonic()
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, None, NullTracker(), None).fit()
+        assert res.final_step == cfg.trainer.max_steps
+        assert time.monotonic() - start >= 0.4
+        assert any("hanging the host step loop" in r.message for r in caplog.records)
+
+    def test_watchdog_armed_run_completes_and_heartbeats(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            resilience={
+                "watchdog": {
+                    "enabled": True,
+                    "stall_timeout_sec": 60.0,
+                    "heartbeat_interval_sec": 0.0,
+                }
+            },
+        )
+        run_dir = tmp_path / "armed"
+        run_dir.mkdir()
+        res = Trainer(cfg, run_dir, NullTracker(), None).fit()
+        assert res.final_step == cfg.trainer.max_steps
+        hb = run_dir / "heartbeat"
+        assert hb.is_file()
+        assert heartbeat_age_seconds(hb) < 60.0
+        assert list(run_dir.glob("hang_report_*.txt")) == []
+
+    def test_off_main_thread_fit_warns_about_sigterm(self, tmp_path, caplog):
+        """Embedding the trainer off the main thread silently loses
+        preemption handling — it must now be loudly visible (satellite)."""
+        cfg = _cfg(tmp_path, trainer={"max_steps": 2, "log_every_steps": 1})
+        result: dict = {}
+
+        def run():
+            with caplog.at_level(logging.WARNING, logger="llmtrain"):
+                result["res"] = Trainer(cfg, None, NullTracker(), None).fit()
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert result["res"].final_step == 2
+        assert any(
+            "off the main thread" in r.message and "SIGTERM" in r.message
+            for r in caplog.records
+        )
+
+    def test_spike_rollback_consensus_path_multi_process(self, tmp_path, caplog):
+        """The multi-process disabling branch is gone: with a (degenerate
+        single-jax-process) 2-process DistState the detector stays active
+        and the rollback goes through the consensus all-gather + rank-0
+        target broadcast code path."""
+        cfg = _cfg(
+            tmp_path,
+            trainer={
+                "max_steps": 12,
+                "log_every_steps": 2,
+                "save_every_steps": 5,
+            },
+            resilience={
+                "spike_detection": True,
+                "spike_factor": 4.0,
+                "spike_min_history": 4,
+                "max_rollbacks": 2,
+                "faults": {"spike_loss_at_step": 8, "spike_loss_scale": 100.0},
+            },
+        )
+        dist = DistState(
+            process_index=0, num_processes=2, local_device_count=1, is_main=True
+        )
+        run_dir = tmp_path / "consensus"
+        run_dir.mkdir()
+        with caplog.at_level(logging.WARNING, logger="llmtrain"):
+            res = Trainer(cfg, run_dir, NullTracker(), dist).fit()
+        assert res.rollbacks == 1
+        assert res.final_step == 12
+        assert not any(
+            "disabling" in r.message and "detector" in r.message
+            for r in caplog.records
+        )
+
+    def test_multi_process_spike_detection_without_ckpt_dir_fails_fast(
+        self, tmp_path
+    ):
+        cfg = _cfg(tmp_path, resilience={"spike_detection": True})
+        dist = DistState(
+            process_index=0, num_processes=2, local_device_count=1, is_main=True
+        )
+        with pytest.raises(ValueError, match="shared run directory"):
+            Trainer(cfg, None, NullTracker(), dist).fit()
+
+
+# --------------------------------------------------------------------------
+# end-to-end: the acceptance pillar, through a real CLI subprocess
+# --------------------------------------------------------------------------
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "llmtrain_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=_cli_env(),
+        timeout=420,
+    )
+
+
+def _e2e_cfg(**resilience):
+    cfg = _cfg()
+    raw = cfg.model_dump()
+    raw["output"] = {"root_dir": "runs"}
+    raw["resilience"] = {**raw["resilience"], **resilience}
+    return raw
+
+
+class TestWatchdogEndToEnd:
+    def test_injected_hang_is_killed_with_report_and_retryable_code(
+        self, tmp_path
+    ):
+        """hang_at_step blocks the step loop for real; the watchdog must
+        detect the stall within the sub-second timeout, write a hang
+        report containing every thread's stack, and hard-exit with the
+        documented retryable code."""
+        raw = _e2e_cfg(
+            watchdog={
+                "enabled": True,
+                "stall_timeout_sec": 0.8,
+                "heartbeat_interval_sec": 0.0,
+            },
+            faults={"hang_at_step": 3},
+        )
+        (tmp_path / "hang.yaml").write_text(yaml.safe_dump(raw))
+        start = time.monotonic()
+        proc = _run_cli(
+            ["train", "--config", "hang.yaml", "--run-id", "hangrun"], tmp_path
+        )
+        elapsed = time.monotonic() - start
+        assert proc.returncode == EXIT_HANG_DETECTED, (
+            f"expected exit {EXIT_HANG_DETECTED}, got {proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+        # Detection latency is bounded by timeout + poll + report, far
+        # under the 420 s subprocess ceiling; assert it did not sit around.
+        assert elapsed < 300
+        run_dir = tmp_path / "runs" / "hangrun"
+        reports = list(run_dir.glob("hang_report_*.txt"))
+        assert len(reports) == 1, proc.stderr
+        text = reports[0].read_text()
+        assert "MainThread" in text  # the blocked step loop's stack
+        assert "maybe_hang" in text  # ... pointing at the actual stall site
+        assert "hang-watchdog" in text  # all threads, including the watchdog
+        assert "jax" in text  # device diagnostics section
+        assert "HANG DETECTED" in proc.stderr
+        # Beacon progressed to the hang step before stalling.
+        assert (run_dir / "heartbeat").is_file()
+
+    def test_clean_run_exits_zero_with_watchdog_armed(self, tmp_path):
+        raw = _e2e_cfg(
+            watchdog={
+                "enabled": True,
+                "stall_timeout_sec": 60.0,
+                "heartbeat_interval_sec": 0.0,
+            }
+        )
+        (tmp_path / "clean.yaml").write_text(yaml.safe_dump(raw))
+        proc = _run_cli(
+            ["train", "--config", "clean.yaml", "--run-id", "cleanrun"], tmp_path
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "hang watchdog armed" in proc.stderr
+        run_dir = tmp_path / "runs" / "cleanrun"
+        assert (run_dir / "heartbeat").is_file()
+        assert list(run_dir.glob("hang_report_*.txt")) == []
+        # The run trained to completion: the final checkpoint exists.
+        assert (run_dir / "checkpoints" / "step_000006.ckpt").is_file()
