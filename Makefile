@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -24,6 +24,13 @@ verify-resilience:
 # straggler telemetry, bounded drain of a wedged checkpoint write.
 verify-watchdog:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_watchdog.py -q -m "not slow"
+
+# Async input pipeline suite: prefetch-on/off loss bitwise equality (incl.
+# resume and spike-rollback replay), SIGTERM shutdown with a full queue,
+# watchdog catching a hang injected inside the prefetch thread, and the
+# compilation-cache dir resolution precedence.
+verify-prefetch:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prefetch.py -q -m "not slow"
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

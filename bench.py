@@ -222,6 +222,12 @@ def _watchdog_main() -> None:
     if no_fallback:
         # Evidence mode: no CPU line allowed; one straight TPU attempt in
         # case the probe itself was a flake, then give up loudly.
+        print(
+            f"probe budget was {probe_timeout:.0f}s; evidence mode retries TPU "
+            f"once at the full {tpu_timeout:.0f}s timeout",
+            file=sys.stderr,
+            flush=True,
+        )
         if not attempt(tpu_env, tpu_timeout):
             give_up()
         return
@@ -233,7 +239,12 @@ def _watchdog_main() -> None:
     # on a truly dead tunnel the cost is wall-clock only — the CPU JSON
     # line is already on stdout, and a TPU line printed after it wins
     # (last JSON line, the same contract the auto-sweep relies on).
-    print("retrying TPU at full timeout after banked CPU line", file=sys.stderr, flush=True)
+    print(
+        f"probe budget was {probe_timeout:.0f}s; retrying TPU at the full "
+        f"{tpu_timeout:.0f}s timeout after banked CPU line",
+        file=sys.stderr,
+        flush=True,
+    )
     attempt(tpu_env, tpu_timeout)
     if not printed_any:
         give_up()
@@ -569,14 +580,19 @@ def _run(
     # step_time/loss pair stays internally consistent.
     elapsed = float("inf")
     final_loss = float("nan")
+    dispatch_total = float("nan")
     for _ in range(2):
         start = time.perf_counter()
+        pass_dispatch = 0.0
         for _ in range(steps):
+            t0 = time.perf_counter()
             state, metrics = step_fn(state, batch_dict, rng)
+            pass_dispatch += time.perf_counter() - t0
         pass_loss = float(jax.device_get(metrics["loss"]))
         pass_elapsed = time.perf_counter() - start
         if pass_elapsed < elapsed:
             elapsed, final_loss = pass_elapsed, pass_loss
+            dispatch_total = pass_dispatch
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * steps / elapsed
@@ -611,6 +627,16 @@ def _run(
             "step_time_ms": round(elapsed / steps * 1e3, 2),
             "final_loss": final_loss,
             "peak_hbm_gb": peak_hbm_gb,
+            # Host-overlap telemetry (mirrors the trainer's per-interval
+            # train/data_wait_ms / train/host_dispatch_ms): the bench batch
+            # is device-resident, so data_wait is identically 0 — the
+            # number that matters here is the host-blocked fraction, time
+            # spent inside the dispatch call (trace/enqueue + any implicit
+            # sync) over wall clock. Near 0 = the device queue hides the
+            # host; near 1 = a per-step sync is bottlenecking dispatch.
+            "data_wait_ms": 0.0,
+            "host_dispatch_ms": round(dispatch_total / steps * 1e3, 2),
+            "host_blocked_frac": round(dispatch_total / elapsed, 4),
         },
     }
 

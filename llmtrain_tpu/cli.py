@@ -1048,7 +1048,7 @@ def _handle_serve(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
-    configure_compilation_cache()
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
     try:
@@ -1117,7 +1117,7 @@ def _handle_eval(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
-    configure_compilation_cache()
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
     level = "DEBUG" if args.verbose else cfg.logging.level
     configure_logging(level=level, json_output=cfg.logging.json_output)
     try:
@@ -1230,7 +1230,7 @@ def _handle_generate(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
-    configure_compilation_cache()
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
 
@@ -1480,7 +1480,7 @@ def _handle_train(args: argparse.Namespace) -> int:
         return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
-    configure_compilation_cache()
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
     dist_state: DistState | None = None
     if cfg.distributed.enabled:
         # Rendezvous against a coordinator that is still coming up (k8s pods

@@ -35,6 +35,13 @@ class RunSectionConfig(BaseModel):
     device: Literal["cpu", "tpu"] = "cpu"
     deterministic: bool = True
     notes: str | None = None
+    # Persistent JAX compilation-cache directory. None = the library
+    # default (~/.cache/llmtrain_tpu/jax); the LLMTRAIN_COMPILATION_CACHE
+    # env var overrides either (and "off" disables caching entirely) —
+    # see llmtrain_tpu.distributed.resolve_compilation_cache_dir. On k8s,
+    # point this (or the env var) at a mounted cache volume so
+    # podFailurePolicy retries skip the minutes-long recompile.
+    compilation_cache_dir: str | None = None
 
     model_config = _STRICT
 
@@ -110,6 +117,13 @@ class TrainerConfig(BaseModel):
     log_every_steps: int = Field(10, ge=1)
     eval_every_steps: int = Field(100, ge=1)
     save_every_steps: int = Field(500, ge=1)
+    # Batches the async input pipeline assembles ahead of the step loop
+    # (data/prefetch.py): host-side gathers + H2D overlap the previous
+    # step's device compute. 0 = synchronous assembly (the pre-prefetch
+    # path, kept as the escape hatch). Loss trajectories are bitwise
+    # identical either way — the prefetcher only changes WHEN batches are
+    # built, never what is built (tests/test_prefetch.py).
+    prefetch_depth: int = Field(2, ge=0)
     extra: dict[str, Any] = Field(default_factory=dict)
 
     model_config = _STRICT
@@ -222,6 +236,13 @@ class FaultInjectionConfig(BaseModel):
     # a controllable straggler/GC-pause stand-in.
     hang_at_step: int | None = Field(None, ge=1)
     hang_duration_sec: float | None = Field(None, gt=0.0)
+    # Fire the hang inside the background prefetcher's assembly thread
+    # instead of the host step loop: the consumer then starves on the
+    # queue — the stall signature of a wedged data pipeline, which the
+    # watchdog must detect exactly like a host-loop hang. Requires
+    # trainer.prefetch_depth >= 1 (with the synchronous fallback there is
+    # no prefetcher to hang, so the injection never fires).
+    hang_in_prefetcher: bool = False
 
     model_config = _STRICT
 

@@ -1,6 +1,13 @@
 """Data plugins: the DataModule contract, sampler, and built-in modules."""
 
 from .base import DataModule, IndexedDataset
+from .prefetch import BatchPrefetcher, PrefetcherClosedError
 from .sampler import DeterministicSampler
 
-__all__ = ["DataModule", "DeterministicSampler", "IndexedDataset"]
+__all__ = [
+    "BatchPrefetcher",
+    "DataModule",
+    "DeterministicSampler",
+    "IndexedDataset",
+    "PrefetcherClosedError",
+]

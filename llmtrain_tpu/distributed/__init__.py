@@ -134,31 +134,43 @@ def configure_platform(device: str) -> None:
         get_logger().warning("could not pin jax platform to cpu: %s", exc)
 
 
-def resolve_compilation_cache_dir() -> str | None:
+def resolve_compilation_cache_dir(config_dir: str | None = None) -> str | None:
     """The directory ``configure_compilation_cache`` will use, or None when
     disabled via ``LLMTRAIN_COMPILATION_CACHE=off``. Single owner of the
     env-token and default-path conventions (bench.py's cache telemetry
-    reads it too)."""
+    reads it too).
+
+    Precedence: the ``LLMTRAIN_COMPILATION_CACHE`` env var (including the
+    "off" disable tokens) beats ``config_dir`` (``run.compilation_cache_dir``
+    from the config) beats the built-in default — the same env-beats-config
+    rule every other knob in this module follows.
+    """
     env = os.environ.get("LLMTRAIN_COMPILATION_CACHE", "")
     low = env.lower()
     if low in ("off", "0", "false", "no", "disable"):
         return None
     if low in ("on", "1", "true", "yes"):
         env = ""  # boolean-ish enable: use the default dir, not a dir named "true"
-    return env or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
+    return (
+        env
+        or config_dir
+        or os.path.join(os.path.expanduser("~"), ".cache", "llmtrain_tpu", "jax")
+    )
 
 
-def configure_compilation_cache() -> None:
+def configure_compilation_cache(config_dir: str | None = None) -> None:
     """Enable JAX's persistent compilation cache (new capability; the
     reference has no compiled artifacts to cache).
 
     On the tunneled TPU a first compile costs 20-40s; caching it on disk
     makes repeated runs (bench watchdog attempts, auto-sweep candidates,
-    restarted jobs) pay it once. Default dir: ``~/.cache/llmtrain_tpu/jax``
-    (stable across CWDs so identical programs actually hit); opt out with
-    ``LLMTRAIN_COMPILATION_CACHE=off``; any other value is the cache dir.
+    podFailurePolicy-restarted k8s Jobs) pay it once. Default dir:
+    ``~/.cache/llmtrain_tpu/jax`` (stable across CWDs so identical programs
+    actually hit); ``run.compilation_cache_dir`` in the config (passed here
+    as ``config_dir``) overrides the default, and the
+    ``LLMTRAIN_COMPILATION_CACHE`` env var overrides both (``off`` disables).
     Safe to call multiple times."""
-    path = resolve_compilation_cache_dir()
+    path = resolve_compilation_cache_dir(config_dir)
     if path is None:
         return
     try:

@@ -119,24 +119,33 @@ class FaultPlan:
         logger.warning("fault injection: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
 
-    def maybe_hang(self, step: int) -> None:
-        """Block the host step loop FOR REAL at exactly the configured step
+    def maybe_hang(self, step: int, *, site: str = "host") -> None:
+        """Block the calling thread FOR REAL at exactly the configured step
         (one-shot). No exception, no signal — the genuinely hang-shaped
         failure mode: from outside, the process is alive and doing nothing,
         which is exactly what the watchdog (resilience/watchdog.py) must
-        detect and kill. With ``hang_duration_sec`` set the loop resumes
+        detect and kill. With ``hang_duration_sec`` set the thread resumes
         afterwards (a controllable straggler stand-in); without it the
         block is indefinite and only the watchdog's ``os._exit`` (or the
         pod's liveness probe) ends the process. Exact equality, not >=:
         a resumed run starting past the step must not re-hang.
+
+        ``site`` selects where the injection fires: the trainer's step
+        loop calls with "host" (the default), the batch prefetcher's
+        assembly thread with "prefetcher"; ``hang_in_prefetcher`` in the
+        config picks which call actually blocks — a prefetcher hang
+        starves the consumer on the queue instead of blocking the loop
+        directly, and the watchdog must catch both signatures.
         """
         at = self._cfg.hang_at_step
-        if at is None or self._hang_fired or step != at:
+        target_site = "prefetcher" if self._cfg.hang_in_prefetcher else "host"
+        if at is None or self._hang_fired or step != at or site != target_site:
             return
         self._hang_fired = True
         duration = self._cfg.hang_duration_sec
         logger.warning(
-            "fault injection: hanging the host step loop at step %d (%s)",
+            "fault injection: hanging the %s at step %d (%s)",
+            "prefetch thread" if site == "prefetcher" else "host step loop",
             step,
             f"{duration:g}s" if duration is not None else "indefinitely",
         )

@@ -81,8 +81,22 @@ def _to_host(tree: Any) -> Any:
         ):
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return np.asarray(x)
+            arr = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        else:
+            arr = np.asarray(x)
+        # The snapshot must OWN its bytes. On the CPU backend np.asarray
+        # of a jax.Array is a zero-copy VIEW of the device buffer; the
+        # next train step then DONATES that buffer (donate_argnums=(0,))
+        # and XLA writes the new state into it in place — while the async
+        # checkpoint writer may still be serializing the view. Result: a
+        # checkpoint whose step field says N but whose params are from a
+        # later step (caught by the prefetch determinism suite, which
+        # removes the host-assembly slack that usually hid the race).
+        # Copy only when numpy reports foreign memory — accelerator
+        # backends already return owned host copies.
+        if arr.base is not None:
+            arr = arr.copy()
+        return arr
 
     return jax.tree.map(fetch, unboxed)
 

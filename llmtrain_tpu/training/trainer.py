@@ -34,6 +34,7 @@ from flax import linen as nn
 from flax.linen import meta as nn_meta
 
 from ..config.schemas import RunConfig
+from ..data.prefetch import BatchPrefetcher
 from ..data.sampler import DeterministicSampler
 from ..distributed import DistState, build_mesh
 from ..parallel.sharding import (
@@ -123,6 +124,12 @@ class Trainer:
         self._last_restored_resilience: dict[str, Any] = {}
         self._beacon: ProgressBeacon | None = None
         self._straggler: StragglerTracker | None = None
+        # One persistent eval-data worker shared by every _evaluate call
+        # of a fit (eval-heavy configs used to pay ThreadPoolExecutor
+        # startup per eval interval). Lazily created; shut down when the
+        # owning fit()/evaluate() returns so Trainer-per-run processes
+        # don't accumulate idle non-daemon workers.
+        self._eval_pool = None
 
         tokenizer = None
         try:
@@ -478,8 +485,11 @@ class Trainer:
                 }
             else:
                 params_override = quantize_tree(base)
-        with self._mesh, nn.logical_axis_rules(self._rules):
-            return self._evaluate(step, step, params_override)
+        try:
+            with self._mesh, nn.logical_axis_rules(self._rules):
+                return self._evaluate(step, step, params_override)
+        finally:
+            self._close_eval_pool()
 
     # ------------------------------------------------------------------ fit
 
@@ -625,8 +635,31 @@ class Trainer:
         interval_losses: list[jax.Array] = []
         interval_shard: list[tuple[jax.Array, jax.Array]] = []
         interval_tokens = 0
+        # Input-pipeline health (docs/perf.md): time the consumer spent
+        # blocked waiting for a batch, and host time spent inside the
+        # dispatch call. With a healthy prefetch pipeline data_wait ~ 0
+        # and dispatch is the only host cost left on the critical path.
+        interval_data_wait = 0.0
+        interval_dispatch = 0.0
         interval_start = time.perf_counter()
         start_time = time.perf_counter()
+
+        # Async input pipeline (data/prefetch.py): a daemon thread runs the
+        # deterministic index math ahead of the loop and keeps up to
+        # prefetch_depth fully-formed global device batches queued, so host
+        # assembly + H2D overlap the previous step's compute. depth 0 keeps
+        # the synchronous path (identical batches either way — the
+        # prefetcher changes when they are built, never what is built).
+        prefetcher: BatchPrefetcher | None = None
+        if cfg.trainer.prefetch_depth > 0 and start_step <= max_steps:
+            prefetcher = BatchPrefetcher(
+                lambda s: self._global_batch(sampler, train_ds, s),
+                depth=cfg.trainer.prefetch_depth,
+                start_step=start_step,
+                before_assemble=(
+                    lambda s: self._faults.maybe_hang(s, site="prefetcher")
+                ),
+            )
 
         # Preemption-safe checkpointing (the k8s spot/maintenance story,
         # docs/k8s.md): SIGTERM sets a flag; the loop saves a durable
@@ -685,8 +718,19 @@ class Trainer:
                 while step < max_steps:
                     step += 1
                     profiler.maybe_start(step)
-                    batch = self._global_batch(sampler, train_ds, step)
+                    # data_wait: consumer blocked on the queue (prefetch) or
+                    # the full synchronous assembly (depth 0) — either way,
+                    # host time the device queue could not hide.
+                    t_fetch = time.perf_counter()
+                    if prefetcher is not None:
+                        batch = prefetcher.get(step)
+                    else:
+                        batch = self._global_batch(sampler, train_ds, step)
+                    t_dispatch = time.perf_counter()
                     self._state, metrics = self._train_step_fn(self._state, batch, run_key)
+                    t_done = time.perf_counter()
+                    interval_data_wait += t_dispatch - t_fetch
+                    interval_dispatch += t_done - t_dispatch
                     profiler.maybe_stop(step, sync=metrics["loss"])
                     if self._beacon is not None:
                         # Progress = the step DISPATCHED. A hung device
@@ -801,10 +845,21 @@ class Trainer:
                             interval_losses = []
                             interval_shard = []
                             interval_tokens = 0
+                            interval_data_wait = 0.0
+                            interval_dispatch = 0.0
                             interval_start = time.perf_counter()
                             step_loss_dev = None
                             nonfinite_dev = None
                             step = rolled_back_to
+                            if prefetcher is not None:
+                                # Everything queued (or mid-assembly) was
+                                # built under the pre-rollback data offset:
+                                # invalidate it and restart the producer at
+                                # the first replayed step, which now reads
+                                # the advanced offset — the replay consumes
+                                # the batches FOLLOWING the bad window,
+                                # exactly as the synchronous path would.
+                                prefetcher.reseek(step + 1)
                             continue
                         interval_time = time.perf_counter() - interval_start
                         self._log_train_interval(
@@ -815,10 +870,14 @@ class Trainer:
                             interval_tokens=interval_tokens,
                             interval_time=interval_time,
                             total_tokens=total_tokens,
+                            interval_data_wait=interval_data_wait,
+                            interval_dispatch=interval_dispatch,
                         )
                         interval_losses = []
                         interval_shard = []
                         interval_tokens = 0
+                        interval_data_wait = 0.0
+                        interval_dispatch = 0.0
                         interval_start = time.perf_counter()
 
                     if step % eval_every == 0 or step == max_steps:
@@ -828,6 +887,18 @@ class Trainer:
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
             loop_completed = True
         finally:
+            if prefetcher is not None:
+                # Poisoned-shutdown path: SIGTERM preemption or an unwinding
+                # exception can leave the queue full and the producer blocked
+                # in put (or wedged inside a hung fetch). close() drains the
+                # queue so a healthy producer unblocks and exits, and
+                # abandons a wedged one after a bounded join — the same
+                # never-deadlock-the-exit stance as the checkpoint drain.
+                prefetcher.close()
+            # The interval evals' shared worker is fit-scoped: release it
+            # so repeated Trainer constructions don't accumulate idle
+            # non-daemon threads.
+            self._close_eval_pool()
             if watchdog is not None:
                 watchdog.disarm()
             if handler_installed:
@@ -886,6 +957,13 @@ class Trainer:
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]
+
+    def _close_eval_pool(self) -> None:
+        """Release the shared eval-data executor (idle at call time: every
+        submitted build was consumed by the eval loop that submitted it)."""
+        if self._eval_pool is not None:
+            self._eval_pool.shutdown(wait=True)
+            self._eval_pool = None
 
     # ------------------------------------------------------------ resilience
 
@@ -1162,6 +1240,8 @@ class Trainer:
         interval_tokens: int,
         interval_time: float,
         total_tokens: int,
+        interval_data_wait: float = 0.0,
+        interval_dispatch: float = 0.0,
     ) -> None:
         if self._ckpt_mgr is not None:
             # Surface a failed async checkpoint write within one log
@@ -1172,6 +1252,16 @@ class Trainer:
         steps_in_interval = len(losses)
         avg_step_time = interval_time / steps_in_interval if steps_in_interval else 0.0
         tokens_per_sec = interval_tokens / interval_time if interval_time > 0 else 0.0
+        # Host-overlap telemetry (docs/perf.md): per-step mean time the
+        # consumer blocked waiting on the input pipeline, and host time
+        # inside the dispatch call. Steady-state data_wait near zero means
+        # batch assembly + H2D are fully hidden behind device compute.
+        data_wait_ms = (
+            interval_data_wait / steps_in_interval * 1e3 if steps_in_interval else 0.0
+        )
+        host_dispatch_ms = (
+            interval_dispatch / steps_in_interval * 1e3 if steps_in_interval else 0.0
+        )
         current_lr = float(jax.device_get(self._schedule(step - 1)))
         # MFU from per-chip throughput — new observability over the reference,
         # which only tracks tokens_per_sec (SURVEY §5/§6).
@@ -1236,13 +1326,16 @@ class Trainer:
                 "train/step_time_sec": avg_step_time,
                 "train/tokens_total": float(total_tokens),
                 "train/mfu": interval_mfu,
+                "train/data_wait_ms": data_wait_ms,
+                "train/host_dispatch_ms": host_dispatch_ms,
             }
             if step_time_skew is not None:
                 global_metrics["train/step_time_skew"] = step_time_skew
             self._tracker.log_metrics(global_metrics, step=step)
 
         logger.info(
-            "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs  mfu=%.4f",
+            "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs  "
+            "mfu=%.4f  data_wait=%.2fms  host_dispatch=%.2fms",
             step,
             max_steps,
             avg_loss,
@@ -1250,6 +1343,8 @@ class Trainer:
             tokens_per_sec,
             avg_step_time,
             interval_mfu,
+            data_wait_ms,
+            host_dispatch_ms,
         )
 
     # ------------------------------------------------------------------ eval
@@ -1279,7 +1374,16 @@ class Trainer:
         # batch b; eval-step dispatch is async, so the host never blocks on
         # device results inside the loop — there is ONE device sync for the
         # whole eval pass, at the device_get below (VERDICT r1 weak #6).
-        from concurrent.futures import ThreadPoolExecutor
+        # The single-worker executor persists across eval calls: eval-heavy
+        # configs (small eval_every_steps) otherwise pay thread startup at
+        # every interval.
+        if self._eval_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._eval_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="eval-data"
+            )
+        pool = self._eval_pool
 
         params = (
             params_override
@@ -1295,15 +1399,14 @@ class Trainer:
 
         loss_sums = []
         token_sums = []
-        with ThreadPoolExecutor(max_workers=1, thread_name_prefix="eval-data") as pool:
-            pending = pool.submit(build, 0)
-            for b in range(num_batches):
-                batch = pending.result()
-                if b + 1 < num_batches:
-                    pending = pool.submit(build, b + 1)
-                loss_sum, tokens = self._eval_step_fn(params, batch)
-                loss_sums.append(loss_sum)
-                token_sums.append(tokens)
+        pending = pool.submit(build, 0)
+        for b in range(num_batches):
+            batch = pending.result()
+            if b + 1 < num_batches:
+                pending = pool.submit(build, b + 1)
+            loss_sum, tokens = self._eval_step_fn(params, batch)
+            loss_sums.append(loss_sum)
+            token_sums.append(tokens)
 
         host_loss, host_tok = jax.device_get((loss_sums, token_sums))
         total_loss = float(sum(x.sum() for x in host_loss))
