@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -31,6 +31,14 @@ verify-watchdog:
 # compilation-cache dir resolution precedence.
 verify-prefetch:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prefetch.py -q -m "not slow"
+
+# Telemetry subsystem suite (docs/observability.md): runs a real smoke fit
+# and asserts report.json + report.md + a Perfetto-loadable trace.json are
+# produced, train/mfu + mem/hbm_peak + span metrics land in the tracker AND
+# in a live Prometheus scrape, timeline rollback tagging, and the
+# failing-tracker degrade-to-warning regression.
+verify-telemetry:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -m "not slow"
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

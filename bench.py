@@ -577,18 +577,26 @@ def _run(
     # (the 1-core CPU fallback hosts especially) inflates a single pass;
     # the faster pass is the closer estimate of the machine's capability.
     # (elapsed, final_loss) are taken from the SAME pass so the reported
-    # step_time/loss pair stays internally consistent.
+    # step_time/loss pair stays internally consistent. The telemetry
+    # timeline records the same spans the trainer does (host_dispatch,
+    # interval_sync), so BENCH_*.json carries the span breakdown the
+    # perf-trajectory files can compare against real runs.
+    from llmtrain_tpu.telemetry.timeline import EventTimeline
+
+    timeline = EventTimeline(xprof_annotations=False)
     elapsed = float("inf")
     final_loss = float("nan")
     dispatch_total = float("nan")
     for _ in range(2):
         start = time.perf_counter()
         pass_dispatch = 0.0
-        for _ in range(steps):
+        for s in range(steps):
             t0 = time.perf_counter()
-            state, metrics = step_fn(state, batch_dict, rng)
+            with timeline.span("host_dispatch", step=s):
+                state, metrics = step_fn(state, batch_dict, rng)
             pass_dispatch += time.perf_counter() - t0
-        pass_loss = float(jax.device_get(metrics["loss"]))
+        with timeline.span("interval_sync"):
+            pass_loss = float(jax.device_get(metrics["loss"]))
         pass_elapsed = time.perf_counter() - start
         if pass_elapsed < elapsed:
             elapsed, final_loss = pass_elapsed, pass_loss
@@ -637,6 +645,13 @@ def _run(
             "data_wait_ms": 0.0,
             "host_dispatch_ms": round(dispatch_total / steps * 1e3, 2),
             "host_blocked_frac": round(dispatch_total / elapsed, 4),
+            # Telemetry summary (llmtrain_tpu/telemetry, docs/observability.md):
+            # span wall-clock breakdown over BOTH timing passes plus the HBM
+            # peak, so the perf trajectory files carry memory + span data.
+            "telemetry": {
+                "spans": timeline.span_totals(),
+                "hbm_peak_bytes": peak_memory_bytes(),
+            },
         },
     }
 

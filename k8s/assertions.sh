@@ -96,3 +96,62 @@ assert_heartbeat() {
     fi
     [ "$FAILURES" -eq "$before" ]
 }
+
+# Telemetry artifacts (docs/observability.md): every completed run must
+# carry report.json/report.md plus a Perfetto-LOADABLE trace.json, a
+# non-empty timeline.jsonl, and the Prometheus textfile snapshot with
+# llmtrain_ gauges in it.
+assert_telemetry_artifacts() {
+    local run_dir="$1" before="$FAILURES" rel
+    if [ -z "$run_dir" ] || [ ! -d "$run_dir" ]; then
+        fail "no run directory for telemetry assertions (got '${run_dir:-}')"
+        return 1
+    fi
+    for rel in report.json report.md telemetry/trace.json telemetry/timeline.jsonl \
+               telemetry/metrics.prom; do
+        [ -s "$run_dir/$rel" ] && pass "$rel present" || fail "$rel missing/empty in $run_dir"
+    done
+    # python3-only hosts (no python-is-python3) must still validate; a
+    # host with NEITHER binary gets a visible skip line, not silence.
+    local pybin
+    pybin=$(command -v python3 || command -v python || true)
+    if [ -z "$pybin" ]; then
+        printf '  SKIP: no python/python3 on PATH; report/trace JSON not validated\n'
+    else
+        if "$pybin" - "$run_dir" <<'PY'
+import json, sys, pathlib
+run = pathlib.Path(sys.argv[1])
+report = json.loads((run / "report.json").read_text())
+assert report["loss"]["final"] is not None, "report has no final loss"
+assert report["spans"], "report has no span breakdown"
+trace = json.loads((run / "telemetry" / "trace.json").read_text())
+assert isinstance(trace["traceEvents"], list) and trace["traceEvents"], "empty trace"
+PY
+        then pass "report.json + trace.json validate"
+        else fail "report.json/trace.json failed validation"
+        fi
+    fi
+    grep -q "llmtrain_" "$run_dir/telemetry/metrics.prom" 2>/dev/null \
+        && pass "metrics.prom carries llmtrain_ gauges" \
+        || fail "no llmtrain_ gauges in metrics.prom"
+    [ "$FAILURES" -eq "$before" ]
+}
+
+# A captured /metrics scrape (file) must carry llmtrain_ gauges and the
+# run-info labels — proves a machine could consume the run's metrics over
+# HTTP while it was training.
+assert_prometheus_scrape() {
+    local scrape_file="$1" before="$FAILURES"
+    if [ ! -s "$scrape_file" ]; then
+        fail "no captured prometheus scrape at ${scrape_file:-<unset>}"
+        return 1
+    fi
+    pass "prometheus scrape captured"
+    grep -q "^llmtrain_" "$scrape_file" \
+        && pass "scrape carries llmtrain_ gauges" \
+        || fail "no llmtrain_ gauges in the scrape"
+    grep -q "llmtrain_run_info" "$scrape_file" \
+        && pass "scrape carries llmtrain_run_info" \
+        || fail "llmtrain_run_info missing from the scrape"
+    [ "$FAILURES" -eq "$before" ]
+}

@@ -19,6 +19,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-runs/e2e_local}"
 STEPS="${LLMTRAIN_E2E_STEPS:-60}"
+PROM_PORT="${LLMTRAIN_E2E_PROM_PORT:-9237}"
 FAILURES=0
 
 say() { printf '==> %s\n' "$*"; }
@@ -92,6 +93,14 @@ resilience:
   watchdog:
     enabled: true
     stall_timeout_sec: 600
+telemetry:
+  # Prometheus endpoint, mirroring the k8s Job's scrape annotations. Both
+  # "pods" share localhost here, so one rank wins the bind and the other
+  # degrades to a warning — exactly the documented single-netns behavior;
+  # the scraper below asserts against whichever rank is serving.
+  prometheus: true
+  prometheus_port: $PROM_PORT
+  prometheus_host: "127.0.0.1"
 mlflow:
   enabled: true
   tracking_uri: "sqlite:///$PWD/$OUT/volume/mlflow/mlflow.db"
@@ -123,6 +132,31 @@ for IDX in 0 1; do
         bash k8s/entrypoint.sh > "$OUT/logs/pod$IDX.log" 2>&1 &
     PIDS+=($!)
 done
+
+say "starting mid-run prometheus scraper against 127.0.0.1:$PROM_PORT"
+# Real curl may be absent on this host (and the stubbed one only exists in
+# the pods' PATH), so the metrics scrape uses python urllib — the transport
+# matters less than the exercised endpoint. Polls until it captures a
+# scrape with llmtrain_ gauges, is killed after the pods exit, or times out.
+PYBIN=$(command -v python3 || command -v python)
+"$PYBIN" - "$PROM_PORT" "$OUT/scrape.prom" <<'PY' &
+import sys, time, urllib.request
+port, target = sys.argv[1], sys.argv[2]
+deadline = time.time() + 900
+while time.time() < deadline:
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        if "llmtrain_" in text:
+            with open(target, "w") as fh:
+                fh.write(text)
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1.0)
+sys.exit(1)
+PY
+SCRAPER_PID=$!
 
 # Bounded wait (same discipline as tests/test_multiprocess.py): a
 # deadlocked collective must fail the run, not hang it forever.
@@ -175,6 +209,16 @@ say "asserting host artifacts"
 RUN_DIR=$(find "$OUT/volume/runs" -mindepth 1 -maxdepth 1 -type d | head -n 1 || true)
 assert_artifact_tree "$RUN_DIR" || true
 assert_tracking_db "$OUT/volume/mlflow/mlflow.db" || true
+
+say "asserting telemetry artifacts (report + perfetto trace + textfile)"
+assert_telemetry_artifacts "$RUN_DIR" || true
+
+say "asserting the mid-run prometheus scrape"
+# The pods are done: the scrape either landed already or never will —
+# kill a still-polling scraper instead of waiting out its deadline.
+kill "$SCRAPER_PID" 2>/dev/null || true
+wait "$SCRAPER_PID" 2>/dev/null || true
+assert_prometheus_scrape "$OUT/scrape.prom" || true
 
 if [ "$FAILURES" -eq 0 ]; then
     say "E2E (local, docker-free) SUCCEEDED"

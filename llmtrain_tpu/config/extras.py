@@ -20,6 +20,7 @@ TRAINER_EXTRA_KEYS = frozenset(
         "keep_last_k",
         "profile_start_step",
         "profile_num_steps",
+        "profile_all_hosts",
         "optimizer",
         "ema_decay",
     }

@@ -314,6 +314,46 @@ class ResilienceConfig(BaseModel):
     model_config = _STRICT
 
 
+class TelemetryConfig(BaseModel):
+    """Unified telemetry subsystem (llmtrain_tpu/telemetry/,
+    docs/observability.md): step-event timeline with Perfetto export,
+    device/host memory accounting, the metrics registry every component
+    publishes through, a Prometheus text endpoint, and the end-of-run
+    report.json/report.md.
+
+    Defaults are production-shaped and near-free on the hot path (span
+    recording is a dict append; memory sampling runs at log-interval
+    cadence only). ``prometheus`` is the one opt-in: it binds a port.
+    """
+
+    enabled: bool = True
+    # Structured span/instant timeline: {run_dir}/telemetry/timeline.jsonl
+    # per flush + Perfetto-loadable trace.json at end of run.
+    timeline: bool = True
+    # Retained-event cap; overflow drops the oldest already-persisted
+    # events (counted in the report, never silent).
+    max_events: int = Field(200_000, ge=1000)
+    # Wrap steps/spans in jax.profiler Step/TraceAnnotations so an xprof
+    # window lines up 1:1 with the framework timeline.
+    xprof_annotations: bool = True
+    # mem/hbm_used, mem/hbm_peak, mem/host_rss ... sampled per log interval,
+    # with a headroom warning when used/limit crosses the threshold.
+    memory: bool = True
+    hbm_headroom_warn_frac: float = Field(0.92, gt=0.0, le=1.0)
+    # Stdlib HTTP /metrics endpoint (main process only; k8s Jobs carry the
+    # matching prometheus.io/scrape annotations).
+    prometheus: bool = False
+    prometheus_host: str = "0.0.0.0"
+    prometheus_port: int = Field(9200, ge=0, le=65535)  # 0 = ephemeral
+    # node-exporter textfile-collector snapshot, rewritten atomically at
+    # every flush: {run_dir}/telemetry/metrics.prom.
+    prometheus_textfile: bool = True
+    # End-of-run report.json/report.md in the run dir.
+    report: bool = True
+
+    model_config = _STRICT
+
+
 class MLflowConfig(BaseModel):
     """MLflow tracking options (reference schemas.py:123-136).
 
@@ -371,6 +411,7 @@ class RunConfig(BaseModel):
     trainer: TrainerConfig
     distributed: DistributedConfig = Field(default_factory=DistributedConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)

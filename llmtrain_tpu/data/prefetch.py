@@ -72,11 +72,17 @@ class BatchPrefetcher:
         start_step: int,
         name: str = "batch-prefetch",
         before_assemble: Callable[[int], None] | None = None,
+        timeline: Any | None = None,
     ) -> None:
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1 (0 = don't construct one)")
         self._assemble = assemble
         self._before_assemble = before_assemble
+        # Telemetry hook (telemetry/timeline.py): the producer records a
+        # prefetch_assemble span per batch so the trace shows host
+        # assembly overlapping device compute — the whole point of the
+        # async pipeline, now visible instead of inferred.
+        self._timeline = timeline
         self._name = name
         self._queue: queue.Queue[tuple[int, int, Any]] = queue.Queue(maxsize=depth)
         self._lock = threading.Lock()
@@ -102,7 +108,13 @@ class BatchPrefetcher:
                     # on the queue, which is exactly the stall the hang
                     # watchdog must detect from outside.
                     self._before_assemble(step)
-                batch = self._assemble(step)
+                if self._timeline is not None:
+                    with self._timeline.span(
+                        "prefetch_assemble", cat="data", step=step
+                    ):
+                        batch = self._assemble(step)
+                else:
+                    batch = self._assemble(step)
             except BaseException as exc:  # noqa: BLE001 — re-raised in consumer
                 with self._lock:
                     self._error = exc
@@ -213,6 +225,13 @@ class BatchPrefetcher:
     @property
     def closed(self) -> bool:
         return self._stop.is_set()
+
+    @property
+    def queue_depth(self) -> int:
+        """Batches currently queued ahead of the consumer (approximate —
+        qsize is advisory under concurrency; published as a telemetry
+        gauge, never used for control flow)."""
+        return self._queue.qsize()
 
     def _drain(self) -> None:
         while True:

@@ -90,6 +90,19 @@ class FaultPlan:
         self._spike_fired = False
         self._hang_fired = False
         self._flaky_counts: dict[str, int] = {}
+        # Telemetry hook: called as observer(kind, step) right before an
+        # injection fires, so fired faults land on the run's event
+        # timeline. Best-effort — a broken observer never blocks the
+        # injection (chaos drills measure the REAL recovery path).
+        self.observer: Callable[[str, int], None] | None = None
+
+    def _notify(self, kind: str, step: int) -> None:
+        if self.observer is None:
+            return
+        try:
+            self.observer(kind, step)
+        except Exception:  # noqa: BLE001 — telemetry must not alter the drill
+            pass
 
     @classmethod
     def from_config(cls, cfg: FaultInjectionConfig | None) -> "FaultPlan":
@@ -116,6 +129,7 @@ class FaultPlan:
         if at is None or self._sigterm_fired or step != at:
             return
         self._sigterm_fired = True
+        self._notify("sigterm", step)
         logger.warning("fault injection: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
 
@@ -142,6 +156,7 @@ class FaultPlan:
         if at is None or self._hang_fired or step != at or site != target_site:
             return
         self._hang_fired = True
+        self._notify(f"hang_{site}", step)
         duration = self._cfg.hang_duration_sec
         logger.warning(
             "fault injection: hanging the %s at step %d (%s)",
@@ -166,6 +181,7 @@ class FaultPlan:
         idx = at - first_step
         if 0 <= idx < len(losses):
             self._spike_fired = True
+            self._notify("spike_loss", at)
             losses = losses.copy()
             losses[idx] = losses[idx] * self._cfg.spike_loss_scale
             logger.warning(
@@ -189,6 +205,7 @@ class FaultPlan:
         if newest is None:
             return
         self._corrupt_fired = True
+        self._notify("corrupt_checkpoint", step)
         data = newest.read_bytes()
         if self._cfg.corrupt_mode == "truncate":
             newest.write_bytes(data[: max(1, len(data) // 2)])

@@ -1,0 +1,281 @@
+"""Unified telemetry subsystem: timeline, metrics registry, memory,
+Prometheus export, and end-of-run reports.
+
+One facade (:class:`Telemetry`) owns the pieces and their lifecycle so the
+trainer wires a single object instead of five:
+
+* :class:`~.timeline.EventTimeline` — structured span/instant stream,
+  JSONL + Perfetto export, xprof-aligned;
+* :class:`~.registry.MetricsRegistry` — the one publish surface every
+  component (trainer, prefetcher, watchdog, checkpoint manager) uses,
+  flushed to the tracker once per log interval with failures degraded to
+  warnings;
+* :class:`~.memory.MemoryMonitor` — HBM/host memory accounting with a
+  headroom warning channel;
+* :class:`~.prometheus.PrometheusEndpoint` — config-gated ``/metrics``
+  HTTP server + textfile fallback;
+* :mod:`~.report` — ``report.json`` / ``report.md`` aggregation.
+
+Rank discipline mirrors the rest of the framework: every rank records
+in memory (spans are free context for a crash report on any host), but
+FILE outputs (JSONL, trace, report, textfile) and the metrics endpoint
+are main-process-only — non-main ranks share the run dir read-only.
+
+See docs/observability.md for the schema, naming convention, and scrape
+setup.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any
+
+from ..tracking.base import Tracker
+from ..utils.logging import get_logger
+from .memory import MemoryMonitor
+from .prometheus import (
+    PrometheusEndpoint,
+    prometheus_name,
+    render_prometheus,
+    write_textfile,
+)
+from .registry import MetricsRegistry
+from .report import build_report, render_markdown, write_reports
+from .timeline import EventTimeline, step_annotation
+
+logger = get_logger()
+
+# Cap on individual files registered as tracker artifacts per pattern walk
+# (a profiler window can emit hundreds of tool files).
+_ARTIFACT_CAP = 64
+
+
+class Telemetry:
+    """Facade tying the telemetry pieces to one run's lifecycle.
+
+    ``cfg`` is the full RunConfig (the facade reads ``cfg.telemetry`` and
+    run identity). Pass ``run_dir=None`` (or ``is_main=False``) for a
+    memory-only instance — every method stays callable.
+    """
+
+    def __init__(
+        self,
+        cfg: Any,
+        run_dir: str | Path | None,
+        tracker: Tracker | None,
+        *,
+        process_index: int = 0,
+        is_main: bool = True,
+    ) -> None:
+        self._cfg = cfg.telemetry
+        self._run_name = cfg.run.name
+        self._run_dir = Path(run_dir) if run_dir is not None else None
+        self._is_main = is_main
+        self._process_index = process_index
+        self._writes_files = (
+            self._cfg.enabled and is_main and self._run_dir is not None
+        )
+        telemetry_dir = (
+            self._run_dir / "telemetry" if self._run_dir is not None else None
+        )
+        self._dir = telemetry_dir
+
+        record_timeline = self._cfg.enabled and self._cfg.timeline
+        self.timeline = EventTimeline(
+            (telemetry_dir / "timeline.jsonl")
+            if self._writes_files and self._cfg.timeline
+            else None,
+            process_index=process_index,
+            max_events=self._cfg.max_events,
+            xprof_annotations=record_timeline and self._cfg.xprof_annotations,
+            # enabled=False -> every span/instant is a true no-op: the
+            # master switch must remove the subsystem from the hot path,
+            # not just its file outputs.
+            enabled=record_timeline,
+        )
+        # The registry keeps the tracker even with telemetry disabled:
+        # the trainer routes ALL tracker traffic through it, so severing
+        # it here would turn `telemetry.enabled: false` into "no mlflow
+        # logging at all" — the registry is plumbing, not telemetry.
+        self.metrics = MetricsRegistry(tracker)
+        self.memory = (
+            MemoryMonitor(
+                headroom_warn_frac=self._cfg.hbm_headroom_warn_frac,
+                timeline=self.timeline,
+            )
+            if self._cfg.enabled and self._cfg.memory
+            else None
+        )
+        self._endpoint: PrometheusEndpoint | None = None
+        self._started = time.perf_counter()
+        self._finalized = False
+
+    # -------------------------------------------------------------- lifecycle
+
+    def step_annotation(self, step: int):
+        """xprof step annotation honoring the config gate."""
+        return step_annotation(
+            step, enabled=self._cfg.enabled and self._cfg.xprof_annotations
+        )
+
+    def start(self) -> None:
+        """Arm the run-scoped transports (Prometheus endpoint). Failures
+        degrade to warnings — a busy port must not kill a training run.
+
+        The endpoint starts on EVERY process, not just main: on k8s each
+        pod has its own IP and the scrape annotation covers all of them,
+        and non-main ranks serve genuinely per-host data (mem/*, span
+        counters). Two ranks sharing one network namespace (local
+        multi-process testing) simply lose the second bind to the
+        degrade-to-warning path."""
+        self._started = time.perf_counter()
+        if not (self._cfg.enabled and self._cfg.prometheus):
+            return
+        if self._endpoint is not None:
+            return
+        try:
+            self._endpoint = PrometheusEndpoint(
+                self._render_prometheus,
+                host=self._cfg.prometheus_host,
+                port=self._cfg.prometheus_port,
+            )
+            logger.info(
+                "prometheus metrics endpoint listening on %s:%d (/metrics)",
+                self._cfg.prometheus_host,
+                self._endpoint.port,
+            )
+        except OSError as exc:
+            logger.warning(
+                "prometheus endpoint failed to bind %s:%d (%s); continuing "
+                "with the textfile fallback only",
+                self._cfg.prometheus_host,
+                self._cfg.prometheus_port,
+                exc,
+            )
+
+    @property
+    def prometheus_port(self) -> int | None:
+        """Bound /metrics port, or None when the endpoint is not serving."""
+        return self._endpoint.port if self._endpoint is not None else None
+
+    def _render_prometheus(self) -> str:
+        return render_prometheus(
+            self.metrics.latest(),
+            self.metrics.counters(),
+            info={
+                "run_name": self._run_name,
+                "process_index": str(self._process_index),
+            },
+        )
+
+    def flush(self, step: int | None = None) -> None:
+        """The per-log-interval flush point: sample memory, push the pending
+        metrics sample to the tracker (degraded on failure), persist the
+        timeline, refresh the textfile snapshot.
+
+        The registry flush runs even with telemetry disabled — it is how
+        ALL tracker traffic flows now, and the master switch disables the
+        telemetry extras, not experiment tracking."""
+        if self.memory is not None:
+            self.metrics.publish(self.memory.sample(step), step)
+        self.metrics.flush(step)
+        if not self._cfg.enabled:
+            return
+        self.timeline.flush()
+        if self._writes_files and self._cfg.prometheus_textfile:
+            write_textfile(self._dir / "metrics.prom", self._render_prometheus())
+
+    def finalize(
+        self, train_result: dict[str, Any] | None = None, *, run_id: str | None = None
+    ) -> dict[str, Any] | None:
+        """End-of-run: final flush, Perfetto export, report.json/report.md.
+
+        Returns the report dict (None when telemetry/reporting is off).
+        Idempotent — a second call (e.g. an unwind path after the normal
+        one) is a no-op.
+        """
+        if not self._cfg.enabled or self._finalized:
+            return None
+        self._finalized = True
+        wall = time.perf_counter() - self._started
+        self.flush()
+        if self._writes_files and self._cfg.timeline:
+            self.timeline.export_perfetto(self._dir / "trace.json")
+        report = None
+        if self._cfg.report:
+            report = build_report(
+                run_id=run_id or self._run_name,
+                run_name=self._run_name,
+                registry=self.metrics,
+                timeline=self.timeline,
+                memory=self.memory,
+                wall_time_sec=wall,
+                train_result=train_result,
+            )
+            if self._writes_files:
+                write_reports(self._run_dir, report)
+        return report
+
+    def register_artifacts(self) -> None:
+        """Register the run's telemetry + diagnostic files with the tracker
+        (degrade-to-warning): report, trace, metrics snapshot, profiler
+        traces, and any hang reports. Main process only."""
+        if not (self._writes_files and self._run_dir is not None):
+            return
+        candidates: list[tuple[Path, str | None]] = [
+            (self._run_dir / "report.json", None),
+            (self._run_dir / "report.md", None),
+        ]
+        if self._dir is not None:
+            candidates += [
+                (self._dir / "trace.json", "telemetry"),
+                (self._dir / "timeline.jsonl", "telemetry"),
+                (self._dir / "metrics.prom", "telemetry"),
+            ]
+        for report_path in sorted(self._run_dir.glob("hang_report_*.txt"))[
+            :_ARTIFACT_CAP
+        ]:
+            candidates.append((report_path, "diagnostics"))
+        profile_dir = self._run_dir / "logs" / "profile"
+        if profile_dir.is_dir():
+            profile_files = sorted(
+                p for p in profile_dir.rglob("*") if p.is_file()
+            )
+            if len(profile_files) > _ARTIFACT_CAP:
+                logger.info(
+                    "registering %d of %d profiler files as artifacts (cap)",
+                    _ARTIFACT_CAP,
+                    len(profile_files),
+                )
+            for p in profile_files[:_ARTIFACT_CAP]:
+                rel = p.parent.relative_to(profile_dir).as_posix()
+                candidates.append(
+                    (p, "profile" if rel == "." else f"profile/{rel}")
+                )
+        for path, artifact_path in candidates:
+            if path.is_file():
+                self.metrics.safe_log_artifact(str(path), artifact_path)
+
+    def close(self) -> None:
+        """Release transports; safe to call multiple times / without start."""
+        if self._endpoint is not None:
+            self._endpoint.close()
+            self._endpoint = None
+        self.timeline.flush()
+
+
+__all__ = [
+    "EventTimeline",
+    "MemoryMonitor",
+    "MetricsRegistry",
+    "PrometheusEndpoint",
+    "Telemetry",
+    "build_report",
+    "prometheus_name",
+    "render_markdown",
+    "render_prometheus",
+    "step_annotation",
+    "write_reports",
+    "write_textfile",
+]

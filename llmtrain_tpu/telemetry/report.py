@@ -1,0 +1,280 @@
+"""End-of-run report: one JSON + one Markdown summary per training run.
+
+The run dir already holds everything a post-mortem needs — tracker DB,
+timeline JSONL, hang reports, checkpoints — but nothing READS like an
+answer to "how did this run go?". The report is that answer, written at
+the end of every fit:
+
+* ``report.json`` — machine-readable aggregation (the perf-trajectory
+  tooling and bench harness consume this);
+* ``report.md`` — the same content rendered for humans (renders directly
+  in any repo/artifact browser).
+
+Contents: final/first/min loss and a bounded loss trajectory, throughput
+(tokens/sec, MFU), memory peaks (HBM + host RSS + estimator source),
+resilience event counts (rollbacks, non-finite skips, faults injected,
+straggler warnings, headroom warnings, tracker errors), and the
+wall-clock breakdown by timeline span — the fraction of the run spent in
+data wait vs dispatch vs checkpoint vs eval, which is the first question
+every perf investigation asks.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any
+
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+# Loss-trajectory samples kept in report.json: enough to plot the run's
+# shape, bounded so a 1M-step run doesn't produce a 100 MB report.
+_TRAJECTORY_CAP = 512
+
+
+def _thin(rows: list[Any], cap: int = _TRAJECTORY_CAP) -> list[Any]:
+    if len(rows) <= cap:
+        return rows
+    stride = -(-len(rows) // cap)
+    thinned = rows[::stride]
+    if rows and thinned[-1] != rows[-1]:
+        thinned.append(rows[-1])
+    return thinned
+
+
+def build_report(
+    *,
+    run_id: str,
+    run_name: str,
+    registry: Any,  # MetricsRegistry
+    timeline: Any,  # EventTimeline
+    memory: Any | None,  # MemoryMonitor
+    wall_time_sec: float,
+    train_result: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """Aggregate the telemetry state into the report dict."""
+    latest = registry.latest()
+    counters = registry.counters()
+    history = registry.history()
+
+    loss_rows = [
+        [step, row["train/loss"]]
+        for step, row in history
+        if "train/loss" in row and step is not None
+    ]
+    losses = [v for _, v in loss_rows]
+    loss_block: dict[str, Any] = {
+        "first_logged": losses[0] if losses else None,
+        "final": losses[-1] if losses else None,
+        "min": min(losses) if losses else None,
+        "trajectory": _thin(loss_rows),
+    }
+    val_rows = [
+        [step, row["val/loss"]]
+        for step, row in history
+        if "val/loss" in row and step is not None
+    ]
+    if val_rows:
+        loss_block["val_final"] = val_rows[-1][1]
+        loss_block["val_trajectory"] = _thin(val_rows)
+
+    def latest_value(key: str) -> float | None:
+        entry = latest.get(key)
+        return entry[0] if entry is not None else None
+
+    throughput = {
+        "tokens_per_sec": latest_value("train/tokens_per_sec"),
+        "mfu": latest_value("train/mfu"),
+        "step_time_sec": latest_value("train/step_time_sec"),
+        "data_wait_ms": latest_value("train/data_wait_ms"),
+        "host_dispatch_ms": latest_value("train/host_dispatch_ms"),
+        "tokens_total": latest_value("train/tokens_total"),
+    }
+
+    mem_block: dict[str, Any] = {}
+    if memory is not None:
+        mem_block = {k: v for k, v in memory.peaks().items()}
+        mem_block["source"] = memory.source
+
+    spans = timeline.span_totals()
+    tracked_ms = sum(s["total_ms"] for s in spans.values())
+    span_block = {
+        name: {
+            **stats,
+            "frac_of_wall": (
+                round(stats["total_ms"] / (wall_time_sec * 1e3), 4)
+                if wall_time_sec > 0
+                else 0.0
+            ),
+        }
+        for name, stats in sorted(spans.items())
+    }
+
+    events = {
+        "instants": timeline.event_counts(),
+        "counters": counters,
+        "tracker_errors": registry.tracker_errors,
+        "timeline_events_dropped": timeline.dropped,
+    }
+
+    report = {
+        "schema": "llmtrain-telemetry-report/1",
+        "run": {"run_id": run_id, "name": run_name},
+        "wall_clock": {
+            "total_sec": round(wall_time_sec, 3),
+            "tracked_span_sec": round(tracked_ms / 1e3, 3),
+        },
+        "loss": loss_block,
+        "throughput": throughput,
+        "memory": mem_block,
+        "spans": span_block,
+        "events": events,
+    }
+    if train_result is not None:
+        report["train_result"] = train_result
+    return report
+
+
+def _fmt(value: Any) -> str:
+    if value is None:
+        return "—"
+    if isinstance(value, float):
+        # Diverged runs put nan/inf here, and this report is exactly the
+        # artifact that must survive them (int(inf) raises OverflowError).
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "inf" if value > 0 else "-inf"
+        if value == 0:
+            return "0"
+        if abs(value) >= 1e15:
+            return f"{value:.3e}"
+        if abs(value) >= 1000:
+            return f"{value:,.1f}"
+        return f"{value:.4g}"
+    return str(value)
+
+
+def _fmt_bytes(value: Any) -> str:
+    """Byte quantities only — _fmt cannot know units, and rendering a
+    token count as GiB (or 'GiB bytes') would mislabel the report."""
+    if value is None:
+        return "—"
+    value = float(value)
+    if not math.isfinite(value):
+        return _fmt(value)
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(value) >= scale:
+            return f"{value / scale:.2f} {unit}"
+    return f"{value:.0f} B"
+
+
+def render_markdown(report: dict[str, Any]) -> str:
+    """Human rendering of :func:`build_report`'s dict."""
+    run = report["run"]
+    lines = [
+        f"# Run report — {run['name']} ({run['run_id']})",
+        "",
+        f"Wall clock: {_fmt(report['wall_clock']['total_sec'])} s "
+        f"(tracked in spans: {_fmt(report['wall_clock']['tracked_span_sec'])} s)",
+        "",
+        "## Loss",
+        "",
+    ]
+    loss = report["loss"]
+    lines.append(
+        f"- train: first {_fmt(loss['first_logged'])} → final {_fmt(loss['final'])}"
+        f" (min {_fmt(loss['min'])})"
+    )
+    if "val_final" in loss:
+        lines.append(f"- val (final): {_fmt(loss['val_final'])}")
+    lines += ["", "## Throughput", ""]
+    tp = report["throughput"]
+    lines.append(f"- tokens/sec: {_fmt(tp['tokens_per_sec'])}")
+    lines.append(f"- MFU: {_fmt(tp['mfu'])}")
+    lines.append(f"- step time: {_fmt(tp['step_time_sec'])} s")
+    lines.append(
+        f"- data wait: {_fmt(tp['data_wait_ms'])} ms/step, "
+        f"host dispatch: {_fmt(tp['host_dispatch_ms'])} ms/step"
+    )
+    mem = report.get("memory") or {}
+    if mem:
+        lines += ["", "## Memory", ""]
+        lines.append(
+            f"- HBM peak: {_fmt_bytes(mem.get('hbm_peak_bytes'))} "
+            f"(source: {mem.get('source', 'unknown')})"
+        )
+        lines.append(f"- host RSS peak: {_fmt_bytes(mem.get('host_rss_peak_bytes'))}")
+        warns = int(mem.get("headroom_warnings") or 0)
+        if warns:
+            lines.append(f"- **headroom warnings: {warns}** (see timeline)")
+    spans = report.get("spans") or {}
+    if spans:
+        lines += [
+            "",
+            "## Wall-clock by span",
+            "",
+            "| span | count | total ms | max ms | % of wall |",
+            "|---|---:|---:|---:|---:|",
+        ]
+        for name, stats in spans.items():
+            lines.append(
+                f"| {name} | {int(stats['count'])} | {stats['total_ms']:.1f} "
+                f"| {stats['max_ms']:.1f} | {100.0 * stats['frac_of_wall']:.1f}% |"
+            )
+    events = report.get("events") or {}
+    instants = events.get("instants") or {}
+    counters = events.get("counters") or {}
+    if instants or counters or events.get("tracker_errors"):
+        lines += ["", "## Events", ""]
+        for name, count in sorted(instants.items()):
+            lines.append(f"- {name}: {count}")
+        for name, count in sorted(counters.items()):
+            lines.append(f"- {name}: {_fmt(count)}")
+        if events.get("tracker_errors"):
+            lines.append(f"- tracker errors (degraded to warnings): {events['tracker_errors']}")
+        if events.get("timeline_events_dropped"):
+            lines.append(f"- timeline events dropped (cap): {events['timeline_events_dropped']}")
+    result = report.get("train_result")
+    if result:
+        lines += ["", "## Result", ""]
+        for key in (
+            "final_step",
+            "final_loss",
+            "final_val_loss",
+            "total_tokens",
+            "parameter_count",
+            "preempted",
+            "rollbacks",
+        ):
+            if key in result:
+                lines.append(f"- {key}: {_fmt(result[key])}")
+    return "\n".join(lines) + "\n"
+
+
+def write_reports(run_dir: str | Path, report: dict[str, Any]) -> tuple[Path | None, Path | None]:
+    """Write ``report.json`` and ``report.md`` into the run dir. Never
+    raises — the report describes the run, it must not fail it."""
+    base = Path(run_dir)
+    json_path = base / "report.json"
+    md_path = base / "report.md"
+    try:
+        base.mkdir(parents=True, exist_ok=True)
+        json_path.write_text(
+            json.dumps(report, indent=2, sort_keys=False), encoding="utf-8"
+        )
+    except (OSError, TypeError, ValueError) as exc:
+        logger.warning("report.json write failed (%s)", exc)
+        json_path = None
+    try:
+        md_path.write_text(render_markdown(report), encoding="utf-8")
+    except OSError as exc:
+        logger.warning("report.md write failed (%s)", exc)
+        md_path = None
+    return json_path, md_path
+
+
+__all__ = ["build_report", "render_markdown", "write_reports"]

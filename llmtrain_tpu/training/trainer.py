@@ -22,7 +22,7 @@ import math
 import signal
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import asdict, dataclass
 from pathlib import Path
 from typing import Any
 
@@ -55,6 +55,7 @@ from ..resilience import (
     StragglerTracker,
     retry,
 )
+from ..telemetry import Telemetry
 from ..tracking.base import Tracker
 from ..utils.hw import mfu as compute_mfu
 from ..utils.hw import peak_flops_per_chip
@@ -105,6 +106,19 @@ class Trainer:
         self._run_dir = run_dir
         self._tracker = tracker
         self._dist_state = dist_state
+
+        # Unified telemetry (telemetry/, docs/observability.md): the
+        # timeline + metrics registry + memory monitor every component of
+        # this Trainer publishes through. All tracker traffic is routed
+        # via the registry so backend failures degrade to warnings
+        # instead of unwinding into the step loop.
+        self._telemetry = Telemetry(
+            cfg,
+            run_dir,
+            tracker,
+            process_index=dist_state.process_index if dist_state else 0,
+            is_main=dist_state is None or dist_state.is_main,
+        )
 
         self._dataset_specs: dict[int, tuple[tuple[str, ...], int]] = {}
         from ..models.lora import build_adapter
@@ -490,6 +504,7 @@ class Trainer:
                 return self._evaluate(step, step, params_override)
         finally:
             self._close_eval_pool()
+            self._telemetry.close()
 
     # ------------------------------------------------------------------ fit
 
@@ -585,11 +600,12 @@ class Trainer:
                 process_index=(
                     self._dist_state.process_index if self._dist_state else 0
                 ),
-                # Before the hard exit, drain-or-abandon the in-flight
-                # async checkpoint write with a bounded wait: never block
-                # the watchdog behind a write wedged on the same dead
-                # storage that may have caused the hang.
-                on_hang=self._drain_checkpoints_for_abort,
+                # Before the hard exit: stamp the hang on the timeline
+                # (flushed so the JSONL survives os._exit), then drain-or-
+                # abandon the in-flight async checkpoint write with a
+                # bounded wait — never block the watchdog behind a write
+                # wedged on the same dead storage that caused the hang.
+                on_hang=self._on_watchdog_hang,
             )
         self._straggler = (
             StragglerTracker(
@@ -622,9 +638,27 @@ class Trainer:
         run_key = self._active_run_key(base_run_key)
         self._train_seqlen = self._probe_seqlen(train_ds)
         tokens_per_step = accum * self._global_micro * self._train_seqlen
-        profiler = _StepProfiler(cfg, self._run_dir if self._is_main else None)
+        profiler = _StepProfiler(
+            cfg,
+            self._run_dir,
+            process_index=(
+                self._dist_state.process_index if self._dist_state else 0
+            ),
+            num_processes=(
+                self._dist_state.num_processes if self._dist_state else 1
+            ),
+            timeline=self._telemetry.timeline,
+        )
+        # Fired fault injections land on the event timeline so chaos
+        # drills are auditable from the trace alone.
+        tl = self._telemetry.timeline
+        self._faults.observer = lambda kind, at_step: (
+            tl.instant(f"fault_{kind}", cat="fault", step=at_step),
+            self._telemetry.metrics.inc("faults/injected"),
+        )
+        self._telemetry.start()
 
-        self._tracker.log_params(cfg.model_dump())
+        self._telemetry.metrics.safe_log_params(cfg.model_dump())
 
         first_step_loss: float | None = None
         final_val_loss: float | None = None
@@ -659,6 +693,7 @@ class Trainer:
                 before_assemble=(
                     lambda s: self._faults.maybe_hang(s, site="prefetcher")
                 ),
+                timeline=self._telemetry.timeline,
             )
 
         # Preemption-safe checkpointing (the k8s spot/maintenance story,
@@ -720,17 +755,28 @@ class Trainer:
                     profiler.maybe_start(step)
                     # data_wait: consumer blocked on the queue (prefetch) or
                     # the full synchronous assembly (depth 0) — either way,
-                    # host time the device queue could not hide.
+                    # host time the device queue could not hide. The SAME
+                    # three clock reads feed the interval accumulators and
+                    # the timeline (tl.record), so the span record and the
+                    # train/data_wait_ms family can never drift apart; the
+                    # StepTraceAnnotation aligns the dispatch with xprof.
                     t_fetch = time.perf_counter()
                     if prefetcher is not None:
                         batch = prefetcher.get(step)
                     else:
                         batch = self._global_batch(sampler, train_ds, step)
                     t_dispatch = time.perf_counter()
-                    self._state, metrics = self._train_step_fn(self._state, batch, run_key)
+                    with self._telemetry.step_annotation(step):
+                        self._state, metrics = self._train_step_fn(
+                            self._state, batch, run_key
+                        )
                     t_done = time.perf_counter()
                     interval_data_wait += t_dispatch - t_fetch
                     interval_dispatch += t_done - t_dispatch
+                    tl.record(
+                        "data_wait", cat="data", step=step, t0=t_fetch, t1=t_dispatch
+                    )
+                    tl.record("host_dispatch", step=step, t0=t_dispatch, t1=t_done)
                     profiler.maybe_stop(step, sync=metrics["loss"])
                     if self._beacon is not None:
                         # Progress = the step DISPATCHED. A hung device
@@ -789,6 +835,12 @@ class Trainer:
                         )
 
                     if stop_now:
+                        tl.instant(
+                            "preempted",
+                            cat="resilience",
+                            step=step,
+                            checkpointed=self._ckpt_mgr is not None,
+                        )
                         if self._ckpt_mgr is not None and self._is_main:
                             logger.warning(
                                 "SIGTERM received: preemption checkpoint "
@@ -823,9 +875,10 @@ class Trainer:
                         # tokens_per_sec/mfu are nonsense. (device_get, not
                         # block_until_ready: on remote-tunnel platforms the
                         # latter can return before execution finishes.)
-                        losses_host = np.asarray(
-                            jax.device_get(jnp.stack(interval_losses))
-                        )
+                        with tl.span("interval_sync", step=step):
+                            losses_host = np.asarray(
+                                jax.device_get(jnp.stack(interval_losses))
+                            )
                         first_interval_step = step - len(interval_losses) + 1
                         losses_host = self._faults.poison_host_losses(
                             losses_host, first_interval_step
@@ -835,6 +888,22 @@ class Trainer:
                             losses_host, first_interval_step, step
                         )
                         if rolled_back_to is not None:
+                            # Timeline bookkeeping BEFORE the interval state
+                            # resets: events of the replayed window are
+                            # tagged rolled_back (not dropped — the
+                            # post-mortem needs to see what the poisoned
+                            # window did) and the rollback itself is an
+                            # instant event. Both land ahead of the next
+                            # flush, so the JSONL carries the tags.
+                            tl.tag_rollback(rolled_back_to + 1, step)
+                            tl.instant(
+                                "rollback",
+                                cat="resilience",
+                                step=step,
+                                restored_step=rolled_back_to,
+                                rollback_count=self._rollback_count,
+                            )
+                            self._telemetry.metrics.inc("resilience/rollbacks")
                             # Replay from the restored step with the sampler
                             # advanced past the bad window and a fresh
                             # rollback-folded RNG stream. Rewind the token
@@ -862,6 +931,18 @@ class Trainer:
                                 prefetcher.reseek(step + 1)
                             continue
                         interval_time = time.perf_counter() - interval_start
+                        if prefetcher is not None:
+                            # Pipeline health gauge: a persistently empty
+                            # queue under nonzero data_wait means assembly
+                            # cannot keep up with the device.
+                            self._telemetry.metrics.publish(
+                                {
+                                    "data/prefetch_queue_depth": float(
+                                        prefetcher.queue_depth
+                                    )
+                                },
+                                step,
+                            )
                         self._log_train_interval(
                             step=step,
                             max_steps=max_steps,
@@ -881,7 +962,8 @@ class Trainer:
                         interval_start = time.perf_counter()
 
                     if step % eval_every == 0 or step == max_steps:
-                        val_metrics = self._evaluate(step, max_steps)
+                        with tl.span("eval", cat="eval", step=step):
+                            val_metrics = self._evaluate(step, max_steps)
                         if val_metrics:
                             final_val_metrics = val_metrics
                             final_val_loss = val_metrics.get("val/loss", final_val_loss)
@@ -899,6 +981,11 @@ class Trainer:
             # so repeated Trainer constructions don't accumulate idle
             # non-daemon threads.
             self._close_eval_pool()
+            self._faults.observer = None
+            # Transport teardown only (endpoint + a timeline flush so crash
+            # evidence persists); the report/trace finalize runs after the
+            # result is known, below.
+            self._telemetry.close()
             if watchdog is not None:
                 watchdog.disarm()
             if handler_installed:
@@ -939,7 +1026,7 @@ class Trainer:
             if past_end_loss is not None:
                 final_loss = past_end_loss
 
-        return TrainResult(
+        result = TrainResult(
             final_step=final_step,
             final_loss=final_loss,
             final_val_loss=final_val_loss,
@@ -954,6 +1041,19 @@ class Trainer:
             preempted=final_step_override is not None,
             rollbacks=self._rollback_count,
         )
+        # End-of-run telemetry: report.json/report.md + Perfetto trace in
+        # the run dir, then register them (plus profiler traces and any
+        # hang reports) as tracker artifacts. Best-effort by construction;
+        # the guard here is only against surprises in the result dict.
+        try:
+            self._telemetry.finalize(
+                train_result=asdict(result),
+                run_id=self._run_dir.name if self._run_dir is not None else None,
+            )
+            self._telemetry.register_artifacts()
+        except Exception as exc:  # noqa: BLE001 — reporting must not fail the run
+            logger.warning("telemetry finalize failed: %s", exc)
+        return result
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]
@@ -1002,6 +1102,10 @@ class Trainer:
                 "guard in the last %d step(s)",
                 skipped,
                 len(losses_host),
+            )
+            self._telemetry.metrics.inc("resilience/nonfinite_skips", skipped)
+            self._telemetry.timeline.instant(
+                "nonfinite_skip", cat="resilience", step=step, skipped=skipped
             )
         cap = self._resilience.max_consecutive_nonfinite
         if consecutive >= cap:
@@ -1096,7 +1200,8 @@ class Trainer:
         # The rollback target must PREDATE the spike: a periodic save can
         # land inside a spiking interval, and that checkpoint — valid by
         # integrity, poisoned by value — must not become the restore point.
-        self._ckpt_mgr.wait_pending()
+        with self._telemetry.timeline.span("checkpoint_wait", cat="ckpt", step=step):
+            self._ckpt_mgr.wait_pending()
         if multi_process:
             # Rank 0 owns the target decision (its manager did the writes);
             # broadcasting the STEP — not each rank scanning the shared dir
@@ -1148,7 +1253,10 @@ class Trainer:
                 trend or 0.0,
             )
             return None
-        restored_step = self._restore(str(target))
+        with self._telemetry.timeline.span(
+            "rollback_restore", cat="resilience", step=step
+        ):
+            restored_step = self._restore(str(target))
         accum = self._cfg.trainer.grad_accum_steps
         # Accumulate onto the LIVE offset, not the checkpoint's stored one:
         # a second rollback landing on a checkpoint that predates the first
@@ -1177,6 +1285,17 @@ class Trainer:
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.close(timeout=_ABORT_DRAIN_TIMEOUT_SEC)
 
+    def _on_watchdog_hang(self) -> None:
+        """Watchdog pre-exit hook: persist the hang on the timeline, then
+        drain the checkpoint write. Every part is best-effort — the
+        watchdog's bounded fire window outranks completeness."""
+        try:
+            self._telemetry.timeline.instant("hang_detected", cat="resilience")
+            self._telemetry.timeline.flush()
+        except Exception:  # noqa: BLE001 — the exit must proceed
+            pass
+        self._drain_checkpoints_for_abort()
+
     def _resilience_payload(self) -> dict[str, Any] | None:
         """Small scalar dict saved alongside the state so guard counter,
         rollback bookkeeping, and the spike detector's trend survive
@@ -1202,16 +1321,24 @@ class Trainer:
             return
         from .checkpoint import state_to_host
 
-        host_state = state_to_host(self._state)
-        if self._ckpt_mgr is not None and self._is_main:
-            # Async: msgpack + disk IO overlap the next steps (the collective
-            # device→host gather above already completed synchronously).
-            self._ckpt_mgr.save_host_async(
-                step,
-                host_state,
-                self._cfg.model_dump(),
-                resilience=self._resilience_payload(),
-            )
+        # The synchronous cost of a save is the device→host gather; the
+        # msgpack+IO tail is async. The span measures what the step loop
+        # actually pays (telemetry timeline: checkpoint_save).
+        with self._telemetry.timeline.span("checkpoint_save", cat="ckpt", step=step):
+            host_state = state_to_host(self._state)
+            if self._ckpt_mgr is not None and self._is_main:
+                # Async: msgpack + disk IO overlap the next steps (the
+                # collective device→host gather above already completed
+                # synchronously).
+                self._ckpt_mgr.save_host_async(
+                    step,
+                    host_state,
+                    self._cfg.model_dump(),
+                    resilience=self._resilience_payload(),
+                )
+                # Counter on the WRITING rank only: a non-main pod's
+                # /metrics must not report saves it never performed.
+                self._telemetry.metrics.inc("ckpt/saves")
 
     # ------------------------------------------------------------------ metrics
 
@@ -1294,6 +1421,15 @@ class Trainer:
                     self._resilience.watchdog.straggler_skew_factor,
                     straggle["streak"],
                 )
+                self._telemetry.metrics.inc("resilience/straggler_warnings")
+                self._telemetry.timeline.instant(
+                    "straggler_persistent",
+                    cat="resilience",
+                    step=step,
+                    slowest_host=straggle["slowest_host"],
+                    skew=round(straggle["skew"], 3),
+                    streak=straggle["streak"],
+                )
         n_chips = self._mesh.devices.size
         interval_mfu = compute_mfu(
             tokens_per_sec / n_chips,
@@ -1306,10 +1442,16 @@ class Trainer:
         )
 
         if self._is_main:
+            # All metrics go through the telemetry registry: buffered here,
+            # pushed to the tracker by the single flush below (backend
+            # failures degrade to warnings — a dead mlflow server must not
+            # kill the step loop), and kept live for the Prometheus
+            # endpoint and the end-of-run report.
+            registry = self._telemetry.metrics
             if self._dp > 1:
                 shard_losses = self._shard_means(interval_shard)
                 for r in range(self._dp):
-                    self._tracker.log_metrics(
+                    registry.publish(
                         {
                             f"train/loss_rank_{r}": float(shard_losses[r]),
                             f"train/lr_rank_{r}": current_lr,
@@ -1331,7 +1473,12 @@ class Trainer:
             }
             if step_time_skew is not None:
                 global_metrics["train/step_time_skew"] = step_time_skew
-            self._tracker.log_metrics(global_metrics, step=step)
+            registry.publish(global_metrics, step=step)
+        # The one flush point per log interval: samples memory (mem/*),
+        # pushes the pending sample to the tracker, persists the timeline,
+        # refreshes the Prometheus textfile. Runs on every rank (non-main
+        # ranks flush to a NullTracker and skip file writes).
+        self._telemetry.flush(step)
 
         logger.info(
             "step=%d/%d  loss=%.4f  lr=%.6e  tokens_per_sec=%.1f  step_time=%.4fs  "
@@ -1419,13 +1566,15 @@ class Trainer:
         ]
 
         if self._is_main:
+            registry = self._telemetry.metrics
             if self._dp > 1:
                 shard_losses = self._shard_means(shard_stats)
                 for r in range(self._dp):
-                    self._tracker.log_metrics(
+                    registry.publish(
                         {f"val/loss_rank_{r}": float(shard_losses[r])}, step=step
                     )
-            self._tracker.log_metrics(metrics, step=step)
+            registry.publish(metrics, step=step)
+        self._telemetry.flush(step)
 
         parts = "  ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
         logger.info("val_step=%d/%d  %s", step, max_steps, parts)
@@ -1491,17 +1640,49 @@ class _StepProfiler:
 
         trainer:
           extra:
-            profile_start_step: 10   # 0/absent = disabled
+            profile_start_step: 10     # 0/absent = disabled
             profile_num_steps: 3
+            profile_all_hosts: false   # multi-host: trace every process
 
-    The trace (XPlane protos viewable in TensorBoard / xprof) lands in
-    ``{run_dir}/logs/profile``. Only the main process traces.
+    The trace (XPlane protos viewable in TensorBoard / xprof / Perfetto)
+    lands in ``{run_dir}/logs/profile``. Every start/stop is guarded — a
+    profiler failure must never kill or wedge training — and multi-host
+    runs CANNOT clobber each other's traces: by default only the main
+    process collects; with ``profile_all_hosts`` every process writes into
+    its own ``host_{i}`` subdirectory of the shared run dir. The produced
+    trace files are registered as tracker artifacts at end of fit
+    (telemetry.register_artifacts). Framework-side, the window edges are
+    stamped on the event timeline so the XPlane trace aligns with the
+    run's own span record.
     """
 
-    def __init__(self, cfg: RunConfig, run_dir: Path | None) -> None:
+    def __init__(
+        self,
+        cfg: RunConfig,
+        run_dir: Path | None,
+        *,
+        process_index: int = 0,
+        num_processes: int = 1,
+        timeline: Any | None = None,
+    ) -> None:
         self._start_step = int(cfg.trainer.extra.get("profile_start_step", 0))
         self._num_steps = max(1, int(cfg.trainer.extra.get("profile_num_steps", 3)))
-        self._dir = Path(run_dir) / "logs" / "profile" if run_dir is not None else None
+        all_hosts = bool(cfg.trainer.extra.get("profile_all_hosts", False))
+        self._timeline = timeline
+        self._dir: Path | None = None
+        if run_dir is not None:
+            base = Path(run_dir) / "logs" / "profile"
+            if num_processes <= 1:
+                self._dir = base
+            elif all_hosts:
+                # Per-host subdirs: the run dir is SHARED on multi-host
+                # jobs, and two processes tracing into one directory write
+                # interleaved XPlane files that tooling cannot separate.
+                self._dir = base / f"host_{process_index}"
+            elif process_index == 0:
+                self._dir = base
+            # non-main without profile_all_hosts: trace collection stays
+            # restricted to the main process (self._dir stays None).
         self._active = False
         self._begun_at: int | None = None
 
@@ -1525,6 +1706,10 @@ class _StepProfiler:
             self._active = True
             self._begun_at = step
             logger.info("profiler trace started at step %d -> %s", step, self._dir)
+            if self._timeline is not None:
+                self._timeline.instant(
+                    "profiler_start", cat="profile", step=step, dir=str(self._dir)
+                )
         except Exception as exc:  # profiling must never kill training
             logger.warning("profiler start failed (%s); continuing without trace", exc)
 
@@ -1541,6 +1726,10 @@ class _StepProfiler:
                 jax.block_until_ready(sync)  # capture the full async dispatch
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", self._dir)
+            if self._timeline is not None:
+                self._timeline.instant(
+                    "profiler_stop", cat="profile", dir=str(self._dir)
+                )
         except Exception as exc:
             logger.warning("profiler stop failed (%s)", exc)
         finally:

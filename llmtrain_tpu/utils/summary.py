@@ -35,6 +35,7 @@ def format_run_summary(
         "trainer": cfg.trainer.model_dump(),
         "distributed": cfg.distributed.model_dump(),
         "resilience": cfg.resilience.model_dump(),
+        "telemetry": cfg.telemetry.model_dump(),
         "mlflow": cfg.mlflow.model_dump(),
         "logging": cfg.logging.model_dump(),
         "output": cfg.output.model_dump(),
@@ -81,6 +82,7 @@ def _render_text(summary: dict[str, Any]) -> str:
         "trainer",
         "distributed",
         "resilience",
+        "telemetry",
         "mlflow",
         "logging",
         "output",

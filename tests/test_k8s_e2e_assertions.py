@@ -316,3 +316,71 @@ class TestManifestStructure:
         (ctr,) = pod["containers"]
         config_mounts = [m for m in ctr["volumeMounts"] if m["name"] == "config"]
         assert config_mounts and config_mounts[0]["mountPath"] == "/config"
+
+    @pytest.mark.parametrize("job_file", ["job.yaml", "job-tpu-v5e.yaml"])
+    def test_jobs_carry_prometheus_scrape_annotations(self, manifests, job_file):
+        """The telemetry scrape contract (docs/observability.md): pod
+        templates must carry the prometheus.io discovery annotations."""
+        (job,) = _by_kind(manifests[job_file], "Job")
+        annotations = job["spec"]["template"]["metadata"]["annotations"]
+        assert annotations["prometheus.io/scrape"] == "true"
+        assert annotations["prometheus.io/path"] == "/metrics"
+        assert int(annotations["prometheus.io/port"]) > 0
+
+    def test_configmap_telemetry_matches_scrape_annotations(self, manifests):
+        """Every embedded train.yaml must enable the telemetry endpoint on
+        the SAME port the Job annotations advertise — a mismatch means
+        scrapers poll a dead port forever."""
+        ports = set()
+        for job_file in ("job.yaml", "job-tpu-v5e.yaml"):
+            (job,) = _by_kind(manifests[job_file], "Job")
+            ports.add(
+                int(job["spec"]["template"]["metadata"]["annotations"][
+                    "prometheus.io/port"
+                ])
+            )
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            for key, raw in cm.get("data", {}).items():
+                if key.endswith(".yaml"):
+                    cfg = yaml.safe_load(raw)
+                    tele = cfg["telemetry"]
+                    assert tele["prometheus"] is True
+                    assert tele["prometheus_port"] in ports
+
+
+class TestAssertTelemetryArtifacts:
+    def test_passes_on_real_run(self, trained_run):
+        r = _sh(f'assert_telemetry_artifacts "{trained_run["run_dir"]}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "report.json + trace.json validate" in r.stdout
+        assert "metrics.prom carries llmtrain_ gauges" in r.stdout
+
+    def test_fails_on_dir_without_telemetry(self, tmp_path):
+        r = _sh(f'assert_telemetry_artifacts "{tmp_path}"')
+        assert r.returncode != 0
+        assert "report.json missing" in r.stderr
+
+
+class TestAssertPrometheusScrape:
+    def test_passes_on_rendered_scrape(self, tmp_path):
+        from llmtrain_tpu.telemetry import render_prometheus
+
+        scrape = tmp_path / "scrape.prom"
+        scrape.write_text(
+            render_prometheus(
+                {"train/loss": (1.0, 3)}, {}, info={"run_name": "e2e"}
+            )
+        )
+        r = _sh(f'assert_prometheus_scrape "{scrape}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_fails_on_missing_capture(self, tmp_path):
+        r = _sh(f'assert_prometheus_scrape "{tmp_path}/scrape.prom"')
+        assert r.returncode != 0
+        assert "no captured prometheus scrape" in r.stderr
+
+    def test_fails_without_gauges(self, tmp_path):
+        scrape = tmp_path / "scrape.prom"
+        scrape.write_text("# just comments\nother_metric 1\n")
+        r = _sh(f'assert_prometheus_scrape "{scrape}"')
+        assert r.returncode != 0
