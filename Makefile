@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -31,6 +31,17 @@ verify-watchdog:
 # compilation-cache dir resolution precedence.
 verify-prefetch:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prefetch.py -q -m "not slow"
+
+# Crash consistency + elastic resume suite (docs/robustness.md): atomic
+# manifest commits, orphan-stage GC, pre-manifest migration, emulated
+# world-size-change resume, topology-mismatch exit codes — PLUS the seeded
+# chaos harness (5 SIGKILL/resume cycles incl. one inside the async
+# checkpoint write, bitwise-parity against an uninterrupted reference).
+# The chaos drills are @pytest.mark.slow so plain `make test` skips them;
+# this target runs everything except the env-gated soak
+# (LLMTRAIN_CHAOS_SOAK=1 enables it).
+verify-elastic:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
 
 # Telemetry subsystem suite (docs/observability.md): runs a real smoke fit
 # and asserts report.json + report.md + a Perfetto-loadable trace.json are

@@ -137,6 +137,52 @@ PY
     [ "$FAILURES" -eq "$before" ]
 }
 
+# Atomic-commit contract (docs/robustness.md "Crash consistency"): the
+# checkpoints dir must hold at least one committed step — a
+# step_N.manifest.json whose listed files exist with the recorded sha-256
+# — and the newest committed payload must be the one selection returns.
+# This is what makes a mid-run pod kill survivable: anything without a
+# manifest is an invisible partial commit.
+assert_manifest() {
+    local ckpt_dir="$1" before="$FAILURES"
+    if [ -z "$ckpt_dir" ] || [ ! -d "$ckpt_dir" ]; then
+        fail "no checkpoints directory for manifest assertions (got '${ckpt_dir:-}')"
+        return 1
+    fi
+    local manifests
+    manifests=$(ls "$ckpt_dir"/step_*.manifest.json 2>/dev/null | wc -l)
+    if [ "$manifests" -ge 1 ]; then
+        pass "checkpoint commit manifest present ($manifests)"
+    else
+        fail "no step_*.manifest.json in $ckpt_dir"
+        return 1
+    fi
+    local pybin
+    pybin=$(command -v python3 || command -v python || true)
+    if [ -z "$pybin" ]; then
+        printf '  SKIP: no python/python3 on PATH; manifest digests not validated\n'
+    else
+        if "$pybin" - "$ckpt_dir" <<'PY'
+import hashlib, json, pathlib, sys
+ckpts = pathlib.Path(sys.argv[1])
+manifests = sorted(ckpts.glob("step_*.manifest.json"))
+assert manifests, "no manifests"
+newest = json.loads(manifests[-1].read_text())
+assert newest.get("files"), "manifest lists no files"
+for entry in newest["files"]:
+    blob = (ckpts / entry["name"]).read_bytes()
+    assert len(blob) == entry["bytes"], f"{entry['name']}: size mismatch"
+    if entry.get("sha256"):
+        digest = hashlib.sha256(blob).hexdigest()
+        assert digest == entry["sha256"], f"{entry['name']}: sha mismatch"
+PY
+        then pass "newest manifest's files verify (sizes + sha-256)"
+        else fail "newest manifest failed verification"
+        fi
+    fi
+    [ "$FAILURES" -eq "$before" ]
+}
+
 # A captured /metrics scrape (file) must carry llmtrain_ gauges and the
 # run-info labels — proves a machine could consume the run's metrics over
 # HTTP while it was training.

@@ -340,6 +340,48 @@ def build_parser() -> argparse.ArgumentParser:
     )
     avg.add_argument("--json", action="store_true", help="emit stats as JSON")
 
+    chaos = sub.add_parser(
+        "chaos",
+        help="seeded chaos-recovery drill: repeated SIGKILL/resume cycles "
+        "with crash-consistency invariants checked after every cycle "
+        "(resilience/chaos.py, docs/robustness.md)",
+    )
+    chaos.add_argument("--config", required=True, help="path to the YAML run config")
+    chaos.add_argument(
+        "--cycles",
+        type=int,
+        default=5,
+        help="number of killed segments before the final uninterrupted one",
+    )
+    chaos.add_argument(
+        "--seed", type=int, default=0, help="seed for the kill-step schedule"
+    )
+    chaos.add_argument(
+        "--max-steps",
+        type=int,
+        default=None,
+        help="override trainer.max_steps for the drill (keep it small)",
+    )
+    chaos.add_argument(
+        "--save-every",
+        type=int,
+        default=None,
+        help="override trainer.save_every_steps for the drill",
+    )
+    chaos.add_argument(
+        "--work-dir",
+        default=None,
+        help="harness working directory (default: "
+        "{output.root_dir}/chaos_{run.name}_s{seed})",
+    )
+    chaos.add_argument(
+        "--timeout-sec",
+        type=float,
+        default=600.0,
+        help="per-segment wall-clock budget",
+    )
+    chaos.add_argument("--json", action="store_true", help="emit the result as JSON")
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -1468,6 +1510,59 @@ def _handle_generate(args: argparse.Namespace) -> int:
     return EXIT_OK
 
 
+def _handle_chaos(args: argparse.Namespace) -> int:
+    """Seeded kill/resume drill over real train subprocesses.
+
+    Exit 0 only when every cycle's invariants held AND the final trajectory
+    is bitwise-identical to the uninterrupted reference; exit 1 when the
+    crash-consistency contract broke (that is the signal this command
+    exists to produce); exit 2 for config problems."""
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    if args.cycles < 1:
+        _emit_error("--cycles must be >= 1")
+        return EXIT_CONFIG_ERROR
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    from .resilience.chaos import ChaosInvariantError, run_chaos
+
+    try:
+        result = run_chaos(
+            args.config,
+            cycles=args.cycles,
+            seed=args.seed,
+            max_steps=args.max_steps,
+            save_every=args.save_every,
+            work_dir=args.work_dir,
+            timeout_sec=args.timeout_sec,
+        )
+    except ChaosInvariantError as exc:
+        logger.error("chaos drill FAILED: %s", exc)
+        _emit_error(f"chaos invariant violated: {exc}")
+        return EXIT_TRAIN_FAILURE
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        logger.exception("chaos drill errored: %s", exc)
+        _emit_error(f"chaos drill errored: {exc}")
+        return exit_code_for_exception(exc)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(
+            f"chaos drill passed: {result['kills_delivered']} kill(s) "
+            f"(incl. {result['kill_during_checkpoint_cycles']} inside a "
+            f"checkpoint write) over {result['max_steps']} steps; "
+            f"{result['trajectory_points_compared']} trajectory point(s) and "
+            f"the final checkpoint are bitwise-identical to the "
+            f"uninterrupted reference (final_loss="
+            f"{result['final_loss']}); artifacts in {result['work_dir']}"
+        )
+    return EXIT_OK
+
+
 def _handle_train(args: argparse.Namespace) -> int:
     try:
         cfg, _, resolved = load_and_validate_config(args.config)
@@ -1487,9 +1582,23 @@ def _handle_train(args: argparse.Namespace) -> int:
         # start in arbitrary order) is retried with exponential backoff
         # instead of failing the pod; the flaky() wrapper is the
         # fault-injection hook exercising this path in tests.
-        from .resilience import FaultPlan, retry
+        from .distributed import resolve_topology
+        from .resilience import FaultPlan, retry, retry_rng
 
         plan = FaultPlan.from_config(cfg.resilience.faults)
+        # Full-jitter backoff seeded per (run seed, rank): every pod of a
+        # Job retries the coordinator on its own decorrelated schedule —
+        # synchronized ladders are exactly how a transient rendezvous blip
+        # becomes a repeated thundering herd. The rank comes from the SAME
+        # resolution setup_distributed uses (resolve_topology: JAX-native
+        # env beats torch-style env beats config) so per-rank
+        # decorrelation holds on every deployment flavor; a topology too
+        # broken to resolve falls back to rank 0 and lets the retried
+        # setup_distributed surface the real error.
+        try:
+            rank_hint, _, _ = resolve_topology(cfg.distributed)
+        except Exception:  # noqa: BLE001 — jitter seeding must not mask it
+            rank_hint = 0
         try:
             dist_state = retry(
                 plan.flaky(
@@ -1498,6 +1607,7 @@ def _handle_train(args: argparse.Namespace) -> int:
                 attempts=cfg.resilience.retry_attempts,
                 base_delay=cfg.resilience.retry_base_delay,
                 description="distributed init",
+                rng=retry_rng(cfg.run.seed, rank_hint),
             )
         except ValueError as exc:
             # Topology/coordinator misconfiguration (resolve_topology and
@@ -1666,6 +1776,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     if args.command == "train":
         return _handle_train(args)
+    if args.command == "chaos":
+        return _handle_chaos(args)
     if args.command == "generate":
         return _handle_generate(args)
     if args.command == "serve":

@@ -221,6 +221,17 @@ class FaultInjectionConfig(BaseModel):
     spike_loss_scale: float = Field(100.0, gt=1.0)
     # Deliver SIGTERM to this process right after dispatching this step.
     sigterm_at_step: int | None = Field(None, ge=1)
+    # Hard-kill (SIGKILL — no handler, no cleanup, no checkpoint) this
+    # process right after dispatching this step. The crash-shaped failure
+    # the atomic commit protocol + chaos harness (resilience/chaos.py)
+    # exist for: nothing on the way down gets a chance to tidy up.
+    kill_at_step: int | None = Field(None, ge=1)
+    # Aim the SIGKILL INSIDE the async checkpoint write instead: the first
+    # save at/after kill_at_step (or the first save at all when
+    # kill_at_step is unset) dies between its staged files and the
+    # manifest publish — the exact window that makes a multi-file
+    # checkpoint torn without atomic commits.
+    kill_during_checkpoint: bool = False
     # After the checkpoint save at/after this step, damage the newest
     # checkpoint file on disk (one-shot).
     corrupt_checkpoint_at_step: int | None = Field(None, ge=1)

@@ -60,6 +60,24 @@ class DeterministicSampler:
             perm = np.tile(perm, reps)
         return perm[pos * self.batch_size : (pos + 1) * self.batch_size]
 
+    def progress(self, batch_index: int) -> dict:
+        """Resumable progress record for global micro-batch ``batch_index``
+        (the NEXT batch to consume). The sampler is stateless, so this is
+        the entire "sampler state" a checkpoint manifest needs: the resumed
+        run re-derives identical batches from (seed, index) alone — on any
+        data-parallel world size, since sharding happens after the global
+        indices are fixed (see ``resilience/elastic.py``)."""
+        epoch, pos = divmod(batch_index, self.batches_per_epoch)
+        return {
+            "seed": int(self.seed),
+            "global_micro_batch": int(self.batch_size),
+            "consumed_micro_batches": int(batch_index),
+            "epoch": int(epoch),
+            "position_in_epoch": int(pos),
+            "consumed_examples": int(batch_index) * int(self.batch_size),
+            "shuffle": bool(self.shuffle),
+        }
+
     def shard_indices(self, batch_index: int, shard: int, num_shards: int) -> np.ndarray:
         """This process's contiguous slice of the global batch.
 

@@ -143,3 +143,26 @@ def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_R
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    """``{axis: size}`` for every named mesh axis — the topology record a
+    checkpoint manifest carries (resilience/elastic.py validates a resume
+    against it)."""
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
+def reshard_state(tree: Any, shardings: Any) -> Any:
+    """Lay a (restored) state pytree out onto the current mesh's shardings.
+
+    This is the elastic-resume entry point: a checkpoint holds FULL host
+    arrays, so landing them on a mesh with a different data-parallel/fsdp
+    degree is purely a placement decision against the sharding tree
+    computed for the NEW mesh. Implemented as a jit'd identity with
+    ``out_shardings`` — NOT ``jax.device_put`` — because on the CPU
+    backend device_put can alias the host numpy buffers zero-copy, and
+    the first train step then DONATES those buffers (donate_argnums);
+    XLA writing into memory numpy still owns corrupts the heap (segfault
+    reproduced by the chaos harness on jax 0.4.37). The jit identity's
+    outputs are XLA-owned copies, which makes them safely donatable."""
+    return jax.jit(lambda s: s, out_shardings=shardings)(tree)

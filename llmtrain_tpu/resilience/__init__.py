@@ -1,5 +1,7 @@
 """Fault-tolerance layer: non-finite guard, loss-spike rollback, fault
-injection, retry, hang watchdog, and the exit-code taxonomy — see
+injection, full-jitter retry, hang watchdog, the exit-code taxonomy,
+elastic topology-change validation (``elastic.py``), and the seeded
+chaos-recovery harness (``chaos.py``, ``llmtrain chaos``) — see
 docs/robustness.md.
 
 The reference framework (and PAPER.md §2.4) has no elastic-recovery
@@ -24,7 +26,13 @@ from .exit_codes import (
     exit_code_for_exception,
     is_retryable,
 )
-from .faults import FaultPlan, InjectedFault, retry
+from .elastic import (
+    TopologyMismatchError,
+    classify_topology_change,
+    describe_topology,
+    resume_batch_index,
+)
+from .faults import FaultPlan, InjectedFault, retry, retry_rng
 from .guard import NonFiniteLossError, tree_all_finite
 from .spike import LossSpikeDetector, RollbackBudgetExceededError
 from .watchdog import (
@@ -50,9 +58,14 @@ __all__ = [
     "RetryableInfraError",
     "RollbackBudgetExceededError",
     "StragglerTracker",
+    "TopologyMismatchError",
+    "classify_topology_change",
+    "describe_topology",
     "exit_code_for_exception",
     "heartbeat_age_seconds",
     "is_retryable",
+    "resume_batch_index",
     "retry",
+    "retry_rng",
     "tree_all_finite",
 ]

@@ -14,7 +14,9 @@ code class                   meaning
 0    clean                   run completed (incl. preemption save + exit)
 1    fatal (training)        deterministic failure — divergence, bad data,
                              bug; retrying reproduces it
-2    fatal (config)          invalid config/CLI usage; retrying is useless
+2    fatal (config)          invalid config/CLI usage, or an incompatible
+                             resume topology change (elastic.py); retrying
+                             is useless
 75   retryable infra         EX_TEMPFAIL — transient environment failure
                              (rendezvous, dataset fetch, storage blip);
                              the orchestrator should restart the pod
@@ -94,10 +96,17 @@ def exit_code_for_exception(exc: BaseException) -> int:
     for a genuine bug would loop the orchestrator forever.
     """
     # Local imports: keep this module importable without jax/pydantic.
+    from .elastic import TopologyMismatchError
     from .faults import InjectedFault
     from .guard import NonFiniteLossError
     from .spike import RollbackBudgetExceededError
 
+    for node in _exception_chain(exc):
+        # An incompatible topology change is a CONFIG problem: the same
+        # config replays the same mismatch, so the orchestrator must not
+        # burn restarts on it.
+        if isinstance(node, TopologyMismatchError):
+            return EXIT_CONFIG_ERROR
     for node in _exception_chain(exc):
         # Deterministic divergence beats any wrapped transient error.
         if isinstance(node, (NonFiniteLossError, RollbackBudgetExceededError)):

@@ -13,6 +13,10 @@ drills on real clusters) exercise the ACTUAL recovery paths end to end:
   design: the replayed step after the rollback must not re-spike.
 * ``sigterm_at_step`` — ``os.kill(os.getpid(), SIGTERM)``, driving the real
   preemption handler, durable save, and clean exit.
+* ``kill_at_step`` / ``kill_during_checkpoint`` — ``SIGKILL``, i.e. a real
+  crash with zero cleanup; the during-checkpoint variant dies between a
+  save's staged files and its manifest publish, driving the atomic-commit
+  protocol and the chaos harness (resilience/chaos.py).
 * ``corrupt_checkpoint_at_step`` — truncates or garbles the newest
   checkpoint file on disk after its save, driving sidecar verification,
   ``latest_valid_checkpoint`` backward scan, and prune protection.
@@ -27,6 +31,7 @@ set of cheap no-op calls in the trainer loop.
 from __future__ import annotations
 
 import os
+import random
 import signal
 import time
 from typing import Any, Callable, TypeVar
@@ -53,20 +58,36 @@ def retry(
     description: str = "operation",
     exceptions: tuple[type[BaseException], ...] = (Exception,),
     sleep: Callable[[float], None] = time.sleep,
+    jitter: bool = True,
+    rng: random.Random | None = None,
 ) -> T:
-    """Run ``fn`` with exponential backoff: delays base, 2·base, 4·base, ...
+    """Run ``fn`` with full-jitter exponential backoff.
 
-    capped at ``max_delay``. The final failure re-raises the original
-    exception unchanged so callers' error handling (CLI exit codes, test
-    asserts) sees the real cause, not a retry wrapper.
+    Attempt ``k`` sleeps ``uniform(0, min(max_delay, base·2^(k-1)))`` —
+    AWS-style FULL jitter, not a fixed ladder: when a shared dependency
+    (HF hub, the rendezvous coordinator, NFS) hiccups, every host's retry
+    clock starts at the same moment, and deterministic delays march the
+    whole fleet back in lockstep as a thundering herd. Jitter decorrelates
+    them. Pass a seeded ``rng`` for reproducible schedules (the trainer
+    and CLI seed theirs from ``(run.seed, process index)`` so delays are
+    deterministic per rank but different across ranks); ``jitter=False``
+    restores the fixed base, 2·base, 4·base ladder. The final failure
+    re-raises the original exception unchanged so callers' error handling
+    (CLI exit codes, test asserts) sees the real cause, not a retry
+    wrapper.
     """
+    if jitter and rng is None:
+        # OS-entropy seeded: still decorrelated across hosts when the
+        # caller doesn't thread a seed through.
+        rng = random.Random()
     for attempt in range(1, attempts + 1):
         try:
             return fn()
         except exceptions as exc:
             if attempt == attempts:
                 raise
-            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            cap = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay = rng.uniform(0.0, cap) if jitter else cap
             logger.warning(
                 "%s failed (attempt %d/%d: %s); retrying in %.2fs",
                 description,
@@ -80,6 +101,13 @@ def retry(
     raise AssertionError("unreachable")  # pragma: no cover
 
 
+def retry_rng(seed: int, process_index: int = 0) -> random.Random:
+    """Seeded backoff-jitter RNG: deterministic per (seed, rank) — tests
+    can pin the exact delays — while different ranks draw different
+    schedules, which is the whole anti-thundering-herd point."""
+    return random.Random(f"llmtrain-retry:{seed}:{process_index}")
+
+
 class FaultPlan:
     """Mutable one-shot bookkeeping over a frozen FaultInjectionConfig."""
 
@@ -89,6 +117,7 @@ class FaultPlan:
         self._corrupt_fired = False
         self._spike_fired = False
         self._hang_fired = False
+        self._kill_taken = False
         self._flaky_counts: dict[str, int] = {}
         # Telemetry hook: called as observer(kind, step) right before an
         # injection fires, so fired faults land on the run's event
@@ -132,6 +161,39 @@ class FaultPlan:
         self._notify("sigterm", step)
         logger.warning("fault injection: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_kill(self, step: int) -> None:
+        """SIGKILL ourselves at EXACTLY the configured step — the
+        hardest-possible crash: no Python handler runs, no drain, no
+        preemption save. What survives on disk is whatever the atomic
+        commit protocol already published; the chaos harness
+        (resilience/chaos.py) asserts resume works from exactly that.
+        With ``kill_during_checkpoint`` set, the kill belongs to the
+        checkpoint writer instead (see :meth:`take_checkpoint_kill`) and
+        this step-loop call never fires. Exact equality, not >=: a
+        resumed run starting past the step must not re-fire."""
+        at = self._cfg.kill_at_step
+        if at is None or self._cfg.kill_during_checkpoint or step != at:
+            return
+        self._notify("kill", step)
+        logger.warning("fault injection: delivering SIGKILL at step %d", step)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def take_checkpoint_kill(self, step: int) -> bool:
+        """True exactly once, for the save whose async write should die
+        mid-commit (``kill_during_checkpoint``): the first save at/after
+        ``kill_at_step`` (or the first save at all when unset). The
+        checkpoint manager performs the actual SIGKILL between its staged
+        files and the manifest publish — inside the write, on the writer
+        thread, while the step loop runs on."""
+        if not self._cfg.kill_during_checkpoint or self._kill_taken:
+            return False
+        at = self._cfg.kill_at_step
+        if at is not None and step < at:
+            return False
+        self._kill_taken = True
+        self._notify("kill_during_checkpoint", step)
+        return True
 
     def maybe_hang(self, step: int, *, site: str = "host") -> None:
         """Block the calling thread FOR REAL at exactly the configured step
@@ -247,4 +309,4 @@ class FaultPlan:
         return wrapped
 
 
-__all__ = ["FaultPlan", "InjectedFault", "retry"]
+__all__ = ["FaultPlan", "InjectedFault", "retry", "retry_rng"]

@@ -120,6 +120,25 @@ def build_report(
         "timeline_events_dropped": timeline.dropped,
     }
 
+    # Recovery totals, first-class (docs/robustness.md): "how many times
+    # did this run die/rewind/re-shard" is the first question after any
+    # incident, and burying it in the counters dict made it invisible.
+    result = train_result or {}
+    resilience_block = {
+        "resumes": int(counters.get("resilience/resumes", 0)),
+        "resume_count": (
+            int(latest_value("resilience/resume_count") or 0)
+            or int(counters.get("resilience/resumes", 0))
+        ),
+        "rollbacks": int(
+            result.get("rollbacks", counters.get("resilience/rollbacks", 0)) or 0
+        ),
+        "elastic_reshards": int(counters.get("resilience/elastic_reshard", 0)),
+        "checkpoint_commits": int(counters.get("checkpoint/commits", 0)),
+        "nonfinite_skips": int(counters.get("resilience/nonfinite_skips", 0)),
+        "preempted": bool(result.get("preempted", False)),
+    }
+
     report = {
         "schema": "llmtrain-telemetry-report/1",
         "run": {"run_id": run_id, "name": run_name},
@@ -130,6 +149,7 @@ def build_report(
         "loss": loss_block,
         "throughput": throughput,
         "memory": mem_block,
+        "resilience": resilience_block,
         "spans": span_block,
         "events": events,
     }
@@ -200,6 +220,20 @@ def render_markdown(report: dict[str, Any]) -> str:
         f"- data wait: {_fmt(tp['data_wait_ms'])} ms/step, "
         f"host dispatch: {_fmt(tp['host_dispatch_ms'])} ms/step"
     )
+    resil = report.get("resilience") or {}
+    if resil:
+        lines += ["", "## Recovery", ""]
+        lines.append(
+            f"- resumes: {resil.get('resume_count', 0)} "
+            f"(this segment: {resil.get('resumes', 0)})"
+        )
+        lines.append(f"- rollbacks: {resil.get('rollbacks', 0)}")
+        lines.append(f"- elastic reshards: {resil.get('elastic_reshards', 0)}")
+        lines.append(
+            f"- checkpoint commits: {resil.get('checkpoint_commits', 0)}"
+        )
+        if resil.get("preempted"):
+            lines.append("- **preempted** (clean SIGTERM save)")
     mem = report.get("memory") or {}
     if mem:
         lines += ["", "## Memory", ""]

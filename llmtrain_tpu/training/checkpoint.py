@@ -15,15 +15,31 @@ states in the payload: dropout keys and data order are pure functions of
 exact stream — this is what makes resume exact under any process count,
 where the reference's skip-ahead replay was single-process-only
 (reference trainer.py:336-347).
+
+Atomic commit protocol (docs/robustness.md "Crash consistency"): a
+checkpoint step is a SET of files (payload + sha-256 sidecar, historically
+growing), and a kill can land between any two of their writes. Every save
+therefore stages its files (tmp write + fsync + rename) and then publishes
+one ``step_N.manifest.json`` — file list with sizes and sha-256 digests,
+plus the saving run's mesh/topology and sampler progress — via atomic
+rename. The manifest IS the commit: selection (``latest_valid_checkpoint``,
+and through it ``resolve_resume_path``) only ever returns manifested steps
+whose listed files verify, so a partially committed step is invisible no
+matter where the kill landed. ``_prune`` garbage-collects orphaned stages
+(torn tmp files, non-verifying unmanifested payloads) and ADOPTS complete
+unmanifested payloads by synthesizing their manifest — which is also the
+backward-compat path for pre-manifest checkpoint dirs.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 import re
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -32,13 +48,55 @@ from flax import serialization
 from flax.linen import meta as nn_meta
 
 CHECKPOINT_VERSION = 1
+MANIFEST_VERSION = 1
 _STEP_RE = re.compile(r"^step_(\d{6,})\.ckpt$")
+_MANIFEST_RE = re.compile(r"^step_(\d{6,})\.manifest\.json$")
 _REQUIRED_KEYS = {"checkpoint_version", "step", "params", "opt_state", "config_yaml"}
 
 
 def sidecar_path(ckpt: Path) -> Path:
     """``step_NNNNNN.ckpt`` → its ``step_NNNNNN.ckpt.sha256`` sidecar."""
     return ckpt.with_name(ckpt.name + ".sha256")
+
+
+def manifest_path(ckpt: Path) -> Path:
+    """``step_NNNNNN.ckpt`` → its ``step_NNNNNN.manifest.json`` commit record."""
+    return ckpt.with_name(ckpt.name[: -len(".ckpt")] + ".manifest.json")
+
+
+def read_manifest(ckpt: Path) -> dict[str, Any] | None:
+    """The parsed commit manifest next to ``ckpt``, or None when absent or
+    unparseable (pre-manifest checkpoints; a torn manifest tmp never gets
+    the final name, so a parse failure here means external damage)."""
+    try:
+        raw = manifest_path(Path(ckpt)).read_text(encoding="utf-8")
+        data = json.loads(raw)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _fsync_file(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: Path) -> None:
+    """Durably record renames in the directory itself. Best-effort: some
+    filesystems (and platforms) refuse O_RDONLY fsync on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _read_sidecar_digest(ckpt: Path) -> str | None:
@@ -121,10 +179,21 @@ class CheckpointError(Exception):
 
 
 class CheckpointManager:
-    def __init__(self, directory: str | Path, *, keep_last_k: int = 3) -> None:
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        keep_last_k: int = 3,
+        on_commit: Callable[[int, Path], None] | None = None,
+    ) -> None:
         self._dir = Path(directory)
         self._keep_last_k = max(1, keep_last_k)
         self._pending: Any = None  # in-flight async write (Future)
+        # Commit observer: called (step, manifest_path) right after the
+        # manifest rename lands — from the WRITER thread on async saves, so
+        # consumers must be thread-safe (the telemetry registry is). Drives
+        # the llmtrain_checkpoint_commits_total counter.
+        self.on_commit = on_commit
         # Verification results keyed by (path, size, mtime_ns): pruning and
         # rollback re-verify the same unchanged files every save; hashing a
         # multi-GB checkpoint repeatedly would be pure waste.
@@ -151,7 +220,25 @@ class CheckpointManager:
         resolved_config: dict[str, Any],
         *,
         resilience: dict[str, Any] | None = None,
+        manifest_extra: dict[str, Any] | None = None,
+        inject_kill: bool = False,
     ) -> Path:
+        """Stage + atomically commit one checkpoint step.
+
+        Order of operations (each stage is tmp-write → fsync → rename):
+        payload, then sidecar, then the ``step_N.manifest.json`` publish —
+        the manifest rename IS the commit point. A kill anywhere before it
+        leaves an uncommitted stage that selection never sees and the next
+        save's :meth:`_prune` cleans up (or adopts, when the payload is in
+        fact complete). ``manifest_extra`` (topology/sampler metadata from
+        the trainer) rides in the manifest, not the payload, so resume can
+        validate a topology change without deserializing gigabytes.
+
+        ``inject_kill`` is the ``faults.kill_during_checkpoint`` hook: a
+        REAL ``SIGKILL`` fired between the staged files and the manifest
+        publish, i.e. inside the exact crash window the protocol exists to
+        make survivable (resilience/chaos.py drives it).
+        """
         self._dir.mkdir(parents=True, exist_ok=True)
         payload = {
             "checkpoint_version": CHECKPOINT_VERSION,
@@ -168,24 +255,94 @@ class CheckpointManager:
         target = self._dir / f"step_{step:06d}.ckpt"
         blob = serialization.msgpack_serialize(payload)
         digest = hashlib.sha256(blob).hexdigest()
+        # Re-saving a step (rollback replay): withdraw the old step before
+        # staging the new bytes — a crash mid-rewrite must leave the step
+        # unselectable (previous commit restores), never pair stale files
+        # with new ones. PAYLOAD FIRST: with the payload gone the step can
+        # neither verify against its (momentarily surviving) manifest nor
+        # be adopted by the orphan sweep as a pre-rollback snapshot with
+        # stale data_offset/rollback bookkeeping — whereas manifest-first
+        # would open exactly that window between the two unlinks. A
+        # briefly-dangling manifest fails verification closed and is
+        # garbage-collected by the next prune.
+        target.unlink(missing_ok=True)
+        sidecar_path(target).unlink(missing_ok=True)
+        manifest_path(target).unlink(missing_ok=True)
         tmp = target.with_suffix(".ckpt.tmp")
         tmp.write_bytes(blob)
-        # Re-saving a step (rollback replay): drop the stale sidecar BEFORE
-        # the payload rename, so no crash window pairs the new payload with
-        # the old digest — absent sidecar degrades to deep-parse verify.
-        sidecar_path(target).unlink(missing_ok=True)
+        _fsync_file(tmp)
         tmp.replace(target)
-        # Sidecar AFTER the payload rename: a crash between the two leaves a
-        # checkpoint without a sidecar (verified by deep parse), never a
-        # sidecar pointing at a half-written file.
         side = sidecar_path(target)
+        side_body = f"{digest}  {target.name}\n"
         side_tmp = side.with_name(side.name + ".tmp")
-        side_tmp.write_text(f"{digest}  {target.name}\n", encoding="utf-8")
+        side_tmp.write_text(side_body, encoding="utf-8")
+        _fsync_file(side_tmp)
         side_tmp.replace(side)
+        if inject_kill:
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "fault injection: SIGKILL inside the checkpoint write at "
+                "step %d (staged files present, manifest NOT published)",
+                step,
+            )
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._publish_manifest(
+            target,
+            [(target.name, len(blob), digest), _file_entry(side)],
+            manifest_extra,
+        )
         stat = target.stat()
         self._verify_cache[(str(target), stat.st_size, stat.st_mtime_ns)] = True
+        # Seed the manifest-keyed cache too (verify_manifest keys on the
+        # manifest path + payload stat): the first selection scan after a
+        # save — e.g. the rollback restore-point search — must not re-read
+        # and re-hash the multi-GB payload it just wrote.
+        self._verify_cache[
+            (str(manifest_path(target)), stat.st_size, stat.st_mtime_ns)
+        ] = True
+        if self.on_commit is not None:
+            try:
+                self.on_commit(step, manifest_path(target))
+            except Exception:  # noqa: BLE001 — observer must not fail the save
+                pass
         self._prune()
         return target
+
+    def _publish_manifest(
+        self,
+        target: Path,
+        files: list[tuple[str, int, str]],
+        manifest_extra: dict[str, Any] | None,
+        *,
+        synthesized: bool = False,
+    ) -> Path:
+        """Atomic-rename publish of the commit record for ``target``."""
+        step = int(_STEP_RE.match(target.name).group(1))
+        manifest = {
+            "manifest_version": MANIFEST_VERSION,
+            "step": step,
+            "files": [
+                {"name": name, "bytes": size, "sha256": digest}
+                for name, size, digest in files
+            ],
+        }
+        if synthesized:
+            # Pre-manifest checkpoint adopted on first scan/prune: no
+            # topology metadata exists, so elastic validation treats the
+            # saved topology as unknown (resume proceeds, no reshard check).
+            manifest["synthesized"] = True
+        if manifest_extra:
+            manifest.update(manifest_extra)
+        mpath = manifest_path(target)
+        mtmp = mpath.with_name(mpath.name + ".tmp")
+        mtmp.write_text(json.dumps(manifest, indent=1, sort_keys=False), encoding="utf-8")
+        _fsync_file(mtmp)
+        mtmp.replace(mpath)
+        _fsync_dir(self._dir)
+        return mpath
 
     def save_host_async(
         self,
@@ -194,6 +351,8 @@ class CheckpointManager:
         resolved_config: dict[str, Any],
         *,
         resilience: dict[str, Any] | None = None,
+        manifest_extra: dict[str, Any] | None = None,
+        inject_kill: bool = False,
     ) -> None:
         """Queue ``save_host`` on a background thread (one write in flight).
 
@@ -223,7 +382,12 @@ class CheckpointManager:
             try:
                 future.set_result(
                     self.save_host(
-                        step, host_state, resolved_config, resilience=resilience
+                        step,
+                        host_state,
+                        resolved_config,
+                        resilience=resilience,
+                        manifest_extra=manifest_extra,
+                        inject_kill=inject_kill,
                     )
                 )
             except BaseException as exc:  # noqa: BLE001 — delivered via result()
@@ -298,7 +462,16 @@ class CheckpointManager:
         """Keep the last k checkpoints by step — but NEVER delete the newest
         VERIFIED one. Retention keyed on file count alone would, with a
         corrupt newest file, delete the only restorable checkpoint and leave
-        the run with nothing but garbage to resume from."""
+        the run with nothing but garbage to resume from.
+
+        Also garbage-collects orphaned commit stages: leftover ``*.tmp``
+        files and unmanifested payloads whose write was cut before the
+        manifest publish. An unmanifested payload that VERIFIES (the kill
+        landed after its fsync'd rename) is a complete snapshot of the same
+        deterministic trajectory — it is adopted via a synthesized manifest
+        instead of deleted, which is also how pre-manifest checkpoint dirs
+        migrate in place."""
+        self._collect_orphans()
         ckpts = self.all_checkpoints()
         doomed = ckpts[: -self._keep_last_k]
         if not doomed:
@@ -311,6 +484,70 @@ class CheckpointManager:
                 continue
             path.unlink(missing_ok=True)
             sidecar_path(path).unlink(missing_ok=True)
+            manifest_path(path).unlink(missing_ok=True)
+
+    def _collect_orphans(self) -> None:
+        """Sweep uncommitted stage leftovers (see :meth:`_prune`). Only
+        called between writes of THIS manager — writes are serialized (one
+        async write in flight, drained before the next queues), so any tmp
+        file or unmanifested payload found here is a dead stage, not an
+        in-flight one."""
+        if not self._dir.is_dir():
+            return
+        from ..utils.logging import get_logger
+
+        manifested = {
+            int(_MANIFEST_RE.match(p.name).group(1))
+            for p in self._dir.iterdir()
+            if _MANIFEST_RE.match(p.name)
+        }
+        if not manifested:
+            # Pre-manifest directory: nothing to reconcile against; legacy
+            # selection (and synthesis on scan) handles it.
+            return
+        for path in list(self._dir.iterdir()):
+            if path.name.endswith(".tmp"):
+                path.unlink(missing_ok=True)
+                continue
+            mm = _MANIFEST_RE.match(path.name)
+            if mm and not (
+                self._dir / f"step_{int(mm.group(1)):06d}.ckpt"
+            ).is_file():
+                # Manifest whose payload vanished (external deletion):
+                # a dangling commit record must not shadow older steps.
+                path.unlink(missing_ok=True)
+                continue
+            m = _STEP_RE.match(path.name)
+            if not m or int(m.group(1)) in manifested:
+                continue
+            if self.verify(path):
+                try:
+                    self.synthesize_manifest(path)
+                    get_logger().warning(
+                        "adopted unmanifested checkpoint %s (complete payload "
+                        "whose commit was interrupted): synthesized its manifest",
+                        path.name,
+                    )
+                except OSError:
+                    pass
+            else:
+                get_logger().warning(
+                    "garbage-collecting torn uncommitted checkpoint stage %s",
+                    path.name,
+                )
+                path.unlink(missing_ok=True)
+                sidecar_path(path).unlink(missing_ok=True)
+
+    def synthesize_manifest(self, ckpt: str | Path) -> Path:
+        """Write a commit manifest for an existing (verifying) payload —
+        the backward-compat path for pre-manifest checkpoints, and the
+        adoption path for complete-but-uncommitted stages."""
+        ckpt = Path(ckpt)
+        files = [_file_entry(ckpt)]
+        side = sidecar_path(ckpt)
+        if side.is_file():
+            files.append(_file_entry(side))
+        return self._publish_manifest(ckpt, files, None, synthesized=True)
 
     def verify(self, path: str | Path) -> bool:
         """True when ``path`` is a restorable checkpoint.
@@ -333,9 +570,52 @@ class CheckpointManager:
         self._verify_cache[key] = ok
         return ok
 
+    def verify_manifest(self, ckpt: str | Path) -> bool:
+        """True when ``ckpt``'s commit manifest exists and every listed
+        file is present with the recorded size and (for the payload) the
+        recorded sha-256. Results are cached by the payload's
+        (path, size, mtime) alongside the sidecar-based cache."""
+        ckpt = Path(ckpt)
+        manifest = read_manifest(ckpt)
+        if manifest is None:
+            return False
+        try:
+            stat = ckpt.stat()
+        except OSError:
+            return False
+        key = (str(manifest_path(ckpt)), stat.st_size, stat.st_mtime_ns)
+        cached = self._verify_cache.get(key)
+        if cached is not None:
+            return cached
+        ok = _manifest_files_ok(self._dir, manifest)
+        self._verify_cache[key] = ok
+        return ok
+
+    def all_manifests(self) -> list[Path]:
+        """Committed steps' payload paths (manifest present), sorted by
+        step, oldest first. The payload file itself may be missing or
+        damaged — :meth:`verify_manifest` decides restorability."""
+        if not self._dir.is_dir():
+            return []
+        found = []
+        for path in self._dir.iterdir():
+            m = _MANIFEST_RE.match(path.name)
+            if m:
+                step = int(m.group(1))
+                found.append((step, self._dir / f"step_{step:06d}.ckpt"))
+        return [p for _, p in sorted(found)]
+
     def latest_valid_checkpoint(self, *, before_step: int | None = None) -> Path | None:
-        """Newest checkpoint that passes :meth:`verify`, scanning backward
-        past truncated/corrupt files (each skip logs a warning).
+        """Newest COMMITTED checkpoint whose manifest verifies, scanning
+        backward past damaged steps (each skip logs a warning).
+
+        Selection is manifest-driven: in a directory with commit manifests,
+        a payload without one is an uncommitted stage — invisible here no
+        matter how intact its bytes look, which is what makes the multi-file
+        commit atomic. Directories with NO manifests at all are pre-manifest
+        layouts: they fall back to per-file verification (sidecar digest or
+        deep parse) and every file that verifies gets a manifest synthesized
+        in place, so the dir is migrated by its first scan.
 
         ``before_step`` restricts the scan to checkpoints saved strictly
         before that step — the loss-spike rollback uses it so a periodic
@@ -348,16 +628,45 @@ class CheckpointManager:
         newest so legacy layouts and hand-assembled dirs still resolve — a
         genuinely broken file then fails at ``load`` with a precise error.
         """
+
+        def step_of(p: Path) -> int:
+            return int(_STEP_RE.match(p.name).group(1))
+
+        from ..utils.logging import get_logger
+
+        manifests = self.all_manifests()
+        if manifests:
+            candidates = manifests
+            if before_step is not None:
+                candidates = [p for p in candidates if step_of(p) < before_step]
+            for path in reversed(candidates):
+                if self.verify_manifest(path):
+                    return path
+                get_logger().warning(
+                    "checkpoint %s failed integrity verification against its "
+                    "commit manifest; falling back to the previous one",
+                    path,
+                )
+            if before_step is not None:
+                return None
+            # Every committed step is damaged: degrade to the legacy
+            # per-file scan below rather than returning nothing for a dir
+            # that may still hold a restorable unmanifested payload.
         ckpts = self.all_checkpoints()
         if before_step is not None:
-            ckpts = [
-                p for p in ckpts if int(_STEP_RE.match(p.name).group(1)) < before_step
-            ]
+            ckpts = [p for p in ckpts if step_of(p) < before_step]
         for path in reversed(ckpts):
             if self.verify(path):
+                if read_manifest(path) is None:
+                    # Backward compat: adopt the pre-manifest checkpoint so
+                    # later scans (and the atomic-commit invariants) see a
+                    # committed step. Best-effort — a read-only snapshot
+                    # dir still resolves, it just stays unmigrated.
+                    try:
+                        self.synthesize_manifest(path)
+                    except OSError:
+                        pass
                 return path
-            from ..utils.logging import get_logger
-
             get_logger().warning(
                 "checkpoint %s failed integrity verification; "
                 "falling back to the previous one",
@@ -421,6 +730,46 @@ class CheckpointManager:
                 f"Checkpoint {path} is missing required keys: {sorted(missing)}"
             )
         return payload
+
+
+def _file_entry(path: Path) -> tuple[str, int, str]:
+    """(name, size, sha256) manifest entry for an existing file."""
+    blob = path.read_bytes()
+    return (path.name, len(blob), hashlib.sha256(blob).hexdigest())
+
+
+def _manifest_files_ok(directory: Path, manifest: dict[str, Any]) -> bool:
+    """Every file the manifest lists exists with the recorded size and
+    digest. Malformed manifests (wrong shapes, non-numeric sizes, junk
+    digest values) fail CLOSED — the backward scan must fall back to the
+    previous step, never crash mid-resolution."""
+    try:
+        files = manifest.get("files")
+        if not isinstance(files, list) or not files:
+            return False
+        for entry in files:
+            if not isinstance(entry, dict):
+                return False
+            name = entry.get("name")
+            if not isinstance(name, str) or "/" in name or name.startswith("."):
+                return False
+            path = directory / name
+            try:
+                blob = path.read_bytes()
+            except OSError:
+                return False
+            size = entry.get("bytes")
+            if size is not None and len(blob) != int(size):
+                return False
+            digest = entry.get("sha256")
+            if (
+                digest is not None
+                and hashlib.sha256(blob).hexdigest() != str(digest).lower()
+            ):
+                return False
+    except (TypeError, ValueError):
+        return False
+    return True
 
 
 def _verify_uncached(path: Path) -> bool:

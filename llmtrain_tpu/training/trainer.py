@@ -41,7 +41,9 @@ from ..parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     batch_sharding,
     data_parallel_degree,
+    mesh_axis_sizes,
     replicated,
+    reshard_state,
     state_shardings,
 )
 from ..registry import get_data_module
@@ -54,6 +56,12 @@ from ..resilience import (
     RollbackBudgetExceededError,
     StragglerTracker,
     retry,
+    retry_rng,
+)
+from ..resilience.elastic import (
+    classify_topology_change,
+    describe_topology,
+    resume_batch_index,
 )
 from ..telemetry import Telemetry
 from ..tracking.base import Tracker
@@ -134,8 +142,13 @@ class Trainer:
         self._faults = FaultPlan.from_config(cfg.resilience.faults)
         self._rollback_count = 0
         self._data_offset = 0
+        # Resumes survived so far (cumulative: round-trips through the
+        # checkpoint's resilience payload like the rollback counter).
+        self._resume_count = 0
+        self._sampler: DeterministicSampler | None = None
         self._spike_detector: LossSpikeDetector | None = None
         self._last_restored_resilience: dict[str, Any] = {}
+        self._last_restored_manifest: dict[str, Any] | None = None
         self._beacon: ProgressBeacon | None = None
         self._straggler: StragglerTracker | None = None
         # One persistent eval-data worker shared by every _evaluate call
@@ -152,7 +165,10 @@ class Trainer:
             logger.warning("build_tokenizer failed (%s); continuing without one", exc)
         # Dataset loading is the one init stage that touches network/disk
         # caches — transient failures (HF hub hiccup, NFS blip) get
-        # exponential-backoff retries instead of killing the pod.
+        # full-jitter exponential-backoff retries instead of killing the
+        # pod; the per-rank seeded RNG keeps a multi-host fleet's retries
+        # decorrelated so a shared-dependency hiccup doesn't turn into a
+        # synchronized thundering herd.
         retry(
             self._faults.flaky(
                 "dataset_load", lambda: self._data_module.setup(cfg, tokenizer)
@@ -160,6 +176,9 @@ class Trainer:
             attempts=cfg.resilience.retry_attempts,
             base_delay=cfg.resilience.retry_base_delay,
             description="dataset setup",
+            rng=retry_rng(
+                cfg.run.seed, dist_state.process_index if dist_state else 0
+            ),
         )
 
         self._model = self._adapter.build_model(cfg)
@@ -207,7 +226,13 @@ class Trainer:
         if run_dir is not None:
             keep_last_k = int(cfg.trainer.extra.get("keep_last_k", 3))
             self._ckpt_mgr = CheckpointManager(
-                Path(run_dir) / "checkpoints", keep_last_k=keep_last_k
+                Path(run_dir) / "checkpoints",
+                keep_last_k=keep_last_k,
+                # Commit observer runs on the async writer thread; the
+                # registry/timeline are lock-protected, so the counter the
+                # Prometheus endpoint exports as
+                # llmtrain_checkpoint_commits_total stays exact.
+                on_commit=self._on_checkpoint_commit,
             )
 
         with self._mesh, nn.logical_axis_rules(self._rules):
@@ -525,6 +550,9 @@ class Trainer:
             seed=cfg.run.seed,
             shuffle=not cfg.run.deterministic,
         )
+        # Checkpoint manifests record the sampler's progress block
+        # (_manifest_extra) so elastic resume can recompute offsets.
+        self._sampler = sampler
 
         res_cfg = self._resilience
         multi_process = (
@@ -561,6 +589,7 @@ class Trainer:
                 )
         self._rollback_count = 0
         self._data_offset = 0
+        self._resume_count = 0
 
         # Hang watchdog + heartbeat + straggler telemetry (resilience/
         # watchdog.py, docs/robustness.md). The beacon records progress at
@@ -618,12 +647,37 @@ class Trainer:
 
         resumed_from_step: int | None = None
         if resume_from is not None:
-            resumed_from_step = self._restore(resume_from)
+            # validate_topology: the fit path owns the identical-trajectory
+            # contract, so a topology change is checked against the
+            # checkpoint's manifest here — elastic (batch axes) re-shards,
+            # incompatible (tensor/pipeline/global-batch) aborts with
+            # TopologyMismatchError -> exit 2.
+            resumed_from_step = self._restore(resume_from, validate_topology=True)
             # Rollback/sampler bookkeeping and the spike detector's trend
             # continue exactly where the checkpointed run left them.
             resil = self._last_restored_resilience
             self._rollback_count = int(resil.get("rollback_count", 0))
-            self._data_offset = int(resil.get("data_offset", 0))
+            manifest_data = (self._last_restored_manifest or {}).get("data") or {}
+            if "consumed_micro_batches" in manifest_data:
+                # The manifest's recorded global-batch progress is the
+                # authoritative stream position — elastic resume re-derives
+                # sampler offsets from it on ANY world size (the saving run
+                # wrote consumed = step·accum + data_offset, so this agrees
+                # with the payload bookkeeping when both exist).
+                self._data_offset = resume_batch_index(
+                    manifest_data, step=resumed_from_step, grad_accum_steps=accum
+                ) - resumed_from_step * accum
+            else:
+                # Synthesized/pre-manifest commit: no progress record, fall
+                # back to the payload's rollback-advanced offset (0 for
+                # pre-resilience checkpoints — pure step math).
+                self._data_offset = int(resil.get("data_offset", 0))
+            self._resume_count = int(resil.get("resume_count", 0)) + 1
+            self._telemetry.metrics.inc("resilience/resumes")
+            self._telemetry.metrics.publish(
+                {"resilience/resume_count": float(self._resume_count)},
+                step=resumed_from_step,
+            )
             if self._spike_detector is not None:
                 self._spike_detector.load_state(resil)
         start_step = (resumed_from_step or 0) + 1
@@ -796,6 +850,10 @@ class Trainer:
                     # Injected preemption goes through the real OS signal
                     # path, so everything below sees a genuine SIGTERM.
                     self._faults.maybe_sigterm(step)
+                    # Injected crash: SIGKILL, nothing below ever runs —
+                    # recovery is entirely the atomic commit protocol's
+                    # problem (chaos harness territory).
+                    self._faults.maybe_kill(step)
                     # Injected hang BLOCKS here for real — the beacon is
                     # stranded at this step and the watchdog must end the
                     # process (tests/test_watchdog.py, end to end).
@@ -1307,9 +1365,62 @@ class Trainer:
             out["rollback_count"] = self._rollback_count
         if self._data_offset:
             out["data_offset"] = self._data_offset
+        if self._resume_count:
+            out["resume_count"] = self._resume_count
         if self._spike_detector is not None:
             out.update(self._spike_detector.state())
         return out or None
+
+    def _on_checkpoint_commit(self, step: int, manifest: Path) -> None:
+        """Commit observer (writer thread): one counter tick + timeline
+        instant per PUBLISHED manifest — saves that died mid-write never
+        count, which is exactly what makes the metric trustworthy."""
+        self._telemetry.metrics.inc("checkpoint/commits")
+        self._telemetry.timeline.instant("checkpoint_commit", cat="ckpt", step=step)
+
+    def _current_topology(self) -> dict[str, Any]:
+        """This run's topology block — recorded in every manifest, and the
+        comparison target when resuming someone else's (elastic.py)."""
+        return describe_topology(
+            mesh_axis_sizes(self._mesh),
+            data_parallel=self._dp,
+            global_micro_batch=self._global_micro,
+            micro_batch_size=self._cfg.trainer.micro_batch_size,
+            grad_accum_steps=self._cfg.trainer.grad_accum_steps,
+            num_processes=(
+                self._dist_state.num_processes if self._dist_state else 1
+            ),
+        )
+
+    def _manifest_extra(self, step: int) -> dict[str, Any]:
+        """Topology + sampler/prefetch progress for the step-``step``
+        manifest: everything resume needs to validate (or elastically
+        re-shard) WITHOUT deserializing the multi-GB payload."""
+        accum = self._cfg.trainer.grad_accum_steps
+        # The save runs at the END of step `step`: the next global
+        # micro-batch the stream will consume is step·accum plus the
+        # rollback-advanced offset.
+        consumed = step * accum + self._data_offset
+        if self._sampler is not None:
+            sampler_state = self._sampler.progress(consumed)
+        else:
+            sampler_state = {
+                "seed": int(self._cfg.run.seed),
+                "global_micro_batch": int(self._global_micro),
+                "consumed_micro_batches": int(consumed),
+            }
+        data = {
+            **sampler_state,
+            "data_offset": int(self._data_offset),
+            # Prefetch generation state: depth is a pure performance knob
+            # (the prefetcher never changes WHAT is built) and the
+            # generation counter equals the rollback count — recorded so a
+            # resume under any prefetch_depth provably replays the same
+            # stream (tests/test_prefetch.py pins bitwise equality).
+            "prefetch_depth": int(self._cfg.trainer.prefetch_depth),
+            "prefetch_generation": int(self._rollback_count),
+        }
+        return {"topology": self._current_topology(), "data": data}
 
     def _save_checkpoint(self, step: int) -> None:
         """Host-gather on every process (collective for multi-host sharded
@@ -1329,12 +1440,17 @@ class Trainer:
             if self._ckpt_mgr is not None and self._is_main:
                 # Async: msgpack + disk IO overlap the next steps (the
                 # collective device→host gather above already completed
-                # synchronously).
+                # synchronously). The manifest extras (topology + sampler
+                # progress) make the commit self-describing for elastic
+                # resume; inject_kill aims the chaos harness's SIGKILL
+                # inside this very write.
                 self._ckpt_mgr.save_host_async(
                     step,
                     host_state,
                     self._cfg.model_dump(),
                     resilience=self._resilience_payload(),
+                    manifest_extra=self._manifest_extra(step),
+                    inject_kill=self._faults.take_checkpoint_kill(step),
                 )
                 # Counter on the WRITING rank only: a non-main pod's
                 # /metrics must not report saves it never performed.
@@ -1582,13 +1698,53 @@ class Trainer:
 
     # ------------------------------------------------------------------ resume
 
-    def _restore(self, resume_spec: str) -> int:
-        """Load a checkpoint into the live state; returns the restored step."""
+    def _restore(self, resume_spec: str, *, validate_topology: bool = False) -> int:
+        """Load a checkpoint into the live state; returns the restored step.
+
+        ``validate_topology`` (the fit/resume path) checks the commit
+        manifest's recorded topology against this run's: batch-axis-only
+        changes log an elastic reshard (params/opt state land on the new
+        mesh via ``reshard_state``), incompatible changes raise
+        ``TopologyMismatchError``. Eval-only restores skip the check —
+        they make no trajectory claim."""
         from flax import serialization
 
-        from .checkpoint import warn_on_config_mismatch
+        from .checkpoint import read_manifest, warn_on_config_mismatch
 
         path = resolve_resume_path(resume_spec, self._cfg.output.root_dir)
+        manifest = read_manifest(path)
+        self._last_restored_manifest = manifest
+        if validate_topology:
+            saved_topo = (manifest or {}).get("topology")
+            verdict = classify_topology_change(saved_topo, self._current_topology())
+            if manifest is None or manifest.get("synthesized"):
+                # WARNING, not info: an adopted orphan (kill between staged
+                # files and manifest publish) or pre-manifest checkpoint
+                # cannot be validated — if the operator ALSO changed the
+                # topology/global batch, the stream would silently re-deal.
+                # The committed-manifest path aborts that case with exit 2;
+                # here the best available signal is a loud skip.
+                logger.warning(
+                    "checkpoint %s carries no saved topology (pre-manifest "
+                    "checkpoint or synthesized manifest): elastic/topology "
+                    "validation SKIPPED — if the mesh, micro_batch_size, or "
+                    "grad_accum_steps changed since it was saved, the resumed "
+                    "trajectory will not continue the saved run's",
+                    path.name,
+                )
+            if verdict["elastic"]:
+                changes = ", ".join(verdict["changes"])
+                logger.warning(
+                    "elastic resume: topology changed (%s) with the global "
+                    "micro-batch preserved — re-sharding params/optimizer "
+                    "state onto the new mesh; the loss trajectory continues "
+                    "the saved run's at matching global steps",
+                    changes,
+                )
+                self._telemetry.timeline.instant(
+                    "elastic_reshard", cat="resilience", changes=changes
+                )
+                self._telemetry.metrics.inc("resilience/elastic_reshard")
         payload = CheckpointManager.load(path)
         warn_on_config_mismatch(
             payload, yaml.safe_dump(self._cfg.model_dump(), sort_keys=False), path
@@ -1619,7 +1775,11 @@ class Trainer:
             opt_state=boxed_opt,
             nonfinite_count=nonfinite_count,
         )
-        self._state = jax.jit(lambda s: s, out_shardings=self._state_shardings)(restored)
+        # Placement onto THIS run's mesh (parallel/sharding.py): the
+        # checkpoint holds full host arrays, so restoring onto a different
+        # data-parallel/fsdp degree is the same device_put as restoring
+        # onto the saving one — this line IS the elastic reshard.
+        self._state = reshard_state(restored, self._state_shardings)
         logger.info("resumed from %s at step %d", path, step)
         return step
 

@@ -168,6 +168,41 @@ class TestAssertHeartbeat:
         assert "heartbeat stale" in r.stderr
 
 
+class TestAssertManifest:
+    """The crash-consistency assertion the e2e's mid-run pod-kill phase
+    relies on: a committed checkpoint set must verify by its manifest."""
+
+    def test_passes_on_real_checkpoints(self, trained_run):
+        r = _sh(f'assert_manifest "{trained_run["run_dir"]}/checkpoints"')
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "commit manifest present" in r.stdout
+        assert "files verify" in r.stdout
+
+    def test_fails_without_manifests(self, tmp_path):
+        d = tmp_path / "ckpts"
+        d.mkdir()
+        (d / "step_000001.ckpt").write_bytes(b"payload without a commit")
+        r = _sh(f'assert_manifest "{d}"')
+        assert r.returncode != 0
+        assert "no step_" in r.stderr
+
+    def test_fails_on_payload_not_matching_manifest(self, trained_run, tmp_path):
+        """Damage the committed payload: the sha in the manifest no longer
+        matches, and the assertion must notice (this is the torn-file case
+        the selection logic skips)."""
+        import shutil
+
+        src = trained_run["run_dir"] / "checkpoints"
+        dst = tmp_path / "ckpts"
+        shutil.copytree(src, dst)
+        payload = sorted(dst.glob("step_*.ckpt"))[-1]
+        data = payload.read_bytes()
+        payload.write_bytes(data[: len(data) // 2])
+        r = _sh(f'assert_manifest "{dst}"')
+        assert r.returncode != 0
+        assert "failed verification" in r.stderr
+
+
 # ---------------------------------------------------------------- manifests
 
 

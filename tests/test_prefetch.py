@@ -283,6 +283,54 @@ class TestBitwiseDeterminism:
         for boundary in (8, 10, 12):
             assert resumed[boundary] == full[boundary]
 
+    def test_resume_with_different_prefetch_depth_matches(
+        self, tmp_path, sync_baseline
+    ):
+        """The saving run's prefetch_depth is a pure performance knob: a
+        checkpoint saved with depth 2 must resume bitwise-identically under
+        depth 0 (prefetch on→off) and a different nonzero depth. The
+        manifest records the saving depth (crash-consistency layer), and
+        resume must treat the difference as a non-event."""
+        from llmtrain_tpu.training.checkpoint import read_manifest
+
+        sync_res, sync_tracker = sync_baseline
+        run_dir = tmp_path / "saved_d2"
+        (run_dir / "checkpoints").mkdir(parents=True)
+        part = Trainer(_cfg(tmp_path, prefetch_depth=2), run_dir, NullTracker(), None).fit(
+            max_steps_override=5
+        )
+        assert part.final_step == 5
+        manifest = read_manifest(run_dir / "checkpoints" / "step_000005.ckpt")
+        assert manifest["data"]["prefetch_depth"] == 2
+
+        full = dict(sync_tracker.series("train/loss"))
+        for depth in (0, 3):
+            tracker = RecordingTracker()
+            res = Trainer(
+                _cfg(tmp_path, prefetch_depth=depth), None, tracker, None
+            ).fit(resume_from=str(run_dir / "checkpoints"))
+            assert res.resumed_from_step == 5
+            assert res.final_loss == sync_res.final_loss  # bitwise
+            resumed = dict(tracker.series("train/loss"))
+            # Boundary 6 straddles the resume point (partial interval);
+            # the fully-aligned intervals must match bit for bit.
+            for boundary in (8, 10, 12):
+                assert resumed[boundary] == full[boundary]
+
+    def test_resume_off_to_on_matches(self, tmp_path, sync_baseline):
+        """The mirror direction: saved synchronously, resumed prefetching."""
+        sync_res, _ = sync_baseline
+        run_dir = tmp_path / "saved_d0"
+        (run_dir / "checkpoints").mkdir(parents=True)
+        Trainer(_cfg(tmp_path, prefetch_depth=0), run_dir, NullTracker(), None).fit(
+            max_steps_override=5
+        )
+        res = Trainer(_cfg(tmp_path, prefetch_depth=2), None, RecordingTracker(), None).fit(
+            resume_from=str(run_dir / "checkpoints")
+        )
+        assert res.resumed_from_step == 5
+        assert res.final_loss == sync_res.final_loss  # bitwise
+
     def test_spike_rollback_replay_matches_synchronous(self, tmp_path):
         """An injected spike rolls both variants back to the step-5
         checkpoint; the replayed window (advanced data offset, rollback-

@@ -351,7 +351,7 @@ class TestSigtermInjection:
 
 
 class TestRetry:
-    def test_exponential_backoff_delays(self):
+    def test_exponential_backoff_delays_without_jitter(self):
         sleeps: list[float] = []
         calls = {"n": 0}
 
@@ -368,10 +368,75 @@ class TestRetry:
                 base_delay=0.1,
                 description="unit op",
                 sleep=sleeps.append,
+                jitter=False,
             )
             == "ok"
         )
         assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+    def test_full_jitter_bounded_by_exponential_caps_and_seeded(self):
+        """Default backoff is FULL jitter: each delay is uniform in
+        (0, base·2^k], and a seeded RNG reproduces the exact schedule —
+        deterministic per rank, different across ranks (no thundering
+        herd when a fleet retries a shared dependency together)."""
+        import random
+
+        from llmtrain_tpu.resilience import retry_rng
+
+        def run(rng):
+            sleeps: list[float] = []
+            calls = {"n": 0}
+
+            def flaky():
+                calls["n"] += 1
+                if calls["n"] < 4:
+                    raise RuntimeError("boom")
+                return "ok"
+
+            assert (
+                retry(
+                    flaky,
+                    attempts=4,
+                    base_delay=0.1,
+                    sleep=sleeps.append,
+                    rng=rng,
+                )
+                == "ok"
+            )
+            return sleeps
+
+        a = run(random.Random(7))
+        b = run(random.Random(7))
+        assert a == b  # seeded => deterministic
+        for delay, cap in zip(a, [0.1, 0.2, 0.4]):
+            assert 0.0 <= delay <= cap
+        # Different ranks draw different schedules from the same run seed.
+        r0 = run(retry_rng(1337, 0))
+        r1 = run(retry_rng(1337, 1))
+        assert r0 != r1
+        assert run(retry_rng(1337, 0)) == r0
+
+    def test_max_delay_caps_jitter_window(self):
+        import random
+
+        sleeps: list[float] = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 6:
+                raise RuntimeError("boom")
+            return "ok"
+
+        retry(
+            flaky,
+            attempts=6,
+            base_delay=1.0,
+            max_delay=2.0,
+            sleep=sleeps.append,
+            rng=random.Random(3),
+        )
+        assert all(d <= 2.0 for d in sleeps)
 
     def test_final_failure_reraises_original(self):
         def always():
