@@ -1,6 +1,6 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving train-smoke train-multiproc bench \
 	chip-evidence mlflow \
-	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-logs k8s-clean \
+	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
 # -n auto: xdist parallelism scales the gate to the host (1 worker on a
@@ -50,6 +50,16 @@ verify-elastic:
 # failing-tracker degrade-to-warning regression.
 verify-telemetry:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -m "not slow"
+
+# Continuous-batching serving suite (docs/serving.md): paged-KV pool
+# invariants, batched-vs-generate() bitwise parity (greedy, per-request
+# sampled knobs, speculative policy), bounded compile budget, continuous
+# join/evict, the seeded open-loop load soak, and the full CLI round-trip
+# (train -> serve-bench --verify-parity -> serve over HTTP). Includes the
+# @pytest.mark.slow soaks plain `make test` skips.
+verify-serving:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving_engine.py \
+		tests/test_serving.py -q
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.
@@ -121,6 +131,11 @@ k8s-build:
 
 k8s-train:
 	kubectl apply -f k8s/infra.yaml -f k8s/configmap.yaml -f k8s/job.yaml
+
+# Inference tier (docs/serving.md): Deployment + Service serving the
+# training Job's committed checkpoint with continuous batching.
+k8s-serve:
+	kubectl apply -f k8s/infra.yaml -f k8s/configmap.yaml -f k8s/serve.yaml
 
 k8s-logs:
 	kubectl logs -l app=llmtrain-tpu --all-containers --prefix -f

@@ -183,6 +183,64 @@ PY
     [ "$FAILURES" -eq "$before" ]
 }
 
+# Serving SLO contract (docs/serving.md): a report.json produced by the
+# load harness must carry the serving block — p50/p95/p99 TTFT and
+# per-token latency, >= 2 sequences concurrently in flight (continuous
+# batching actually batched), and a decode-loop compile count within the
+# configured bucket budget.
+assert_serving_report() {
+    local report="$1" before="$FAILURES"
+    if [ ! -s "$report" ]; then
+        fail "no serving report at ${report:-<unset>}"
+        return 1
+    fi
+    pass "serving report present"
+    local pybin
+    pybin=$(command -v python3 || command -v python || true)
+    if [ -z "$pybin" ]; then
+        printf '  SKIP: no python/python3 on PATH; serving block not validated\n'
+    else
+        if "$pybin" - "$report" <<'PY'
+import json, sys
+report = json.loads(open(sys.argv[1]).read())
+serving = report["serving"]
+for metric in ("ttft_ms", "per_token_ms"):
+    for q in ("p50", "p95", "p99"):
+        assert serving["slo"][metric][q] is not None, f"{metric}.{q} missing"
+assert serving["requests"]["completed"] >= 1, "no completed requests"
+assert serving["requests"]["failed"] == 0, "failed requests in the run"
+assert serving["occupancy"]["peak"] >= 2, (
+    f"peak occupancy {serving['occupancy']['peak']} < 2: never batched"
+)
+assert serving["compile"]["within_budget"] is True, "compile budget exceeded"
+assert serving["throughput"]["tokens_per_sec"], "no throughput recorded"
+PY
+        then pass "serving block: SLO percentiles + occupancy>=2 + compile budget"
+        else fail "serving block failed validation in $report"
+        fi
+    fi
+    [ "$FAILURES" -eq "$before" ]
+}
+
+# A captured scrape of the INFERENCE server's /metrics must carry the
+# llmtrain_serve_* family (queue depth, occupancy, KV-pool utilization,
+# requests counter) — the serving observability surface.
+assert_serving_scrape() {
+    local scrape_file="$1" before="$FAILURES" metric
+    if [ ! -s "$scrape_file" ]; then
+        fail "no captured serving scrape at ${scrape_file:-<unset>}"
+        return 1
+    fi
+    pass "serving scrape captured"
+    for metric in llmtrain_serve_requests_total llmtrain_serve_queue_depth \
+                  llmtrain_serve_batch_occupancy llmtrain_serve_kv_pool_utilization; do
+        grep -q "^$metric" "$scrape_file" \
+            && pass "$metric present" \
+            || fail "$metric missing from the serving scrape"
+    done
+    [ "$FAILURES" -eq "$before" ]
+}
+
 # A captured /metrics scrape (file) must carry llmtrain_ gauges and the
 # run-info labels — proves a machine could consume the run's metrics over
 # HTTP while it was training.

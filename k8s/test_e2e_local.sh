@@ -284,6 +284,97 @@ else
 fi
 assert_manifest "$KILL_CKPTS" || true
 
+# ---------------------------------------------------------------------------
+# Serving phase (docs/serving.md): the checkpoint the kill phase committed
+# is served by the continuous-batching inference stack — (1) the seeded
+# open-loop load harness runs with --verify-parity (batched token-ids must
+# match sequential generate() bitwise) and its serving block must land in
+# report.json; (2) the real `serve` HTTP server takes concurrent posts and
+# its /metrics must expose the llmtrain_serve_* family the k8s/serve.yaml
+# Deployment's scrape annotations advertise.
+# ---------------------------------------------------------------------------
+say "serving phase: continuous-batching load run over the killrun checkpoint"
+"$PYBIN" - "$OUT/kill.yaml" <<'PY' > "$OUT/serve.yaml"
+import sys, yaml
+cfg = yaml.safe_load(open(sys.argv[1]))
+cfg["serving"] = {
+    "mode": "continuous",
+    "max_batch_slots": 4,
+    "block_tokens": 16,
+    "prompt_buckets": [16, 32],
+    "batch_buckets": [2, 4],
+    "max_new_tokens_cap": 32,
+    "default_max_new_tokens": 8,
+}
+print(yaml.safe_dump(cfg, sort_keys=False), end="")
+PY
+if JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    "$PYBIN" -m llmtrain_tpu serve-bench --config "$OUT/serve.yaml" \
+    --from killrun --requests 8 --rate-rps 16 --max-new-tokens 8 \
+    --prompt-tokens-max 24 --verify-parity --out "$OUT/serve_report" \
+    > "$OUT/logs/serve_bench.log" 2>&1; then
+    pass "serve-bench completed with bitwise parity vs generate()"
+else
+    fail "serve-bench failed (see $OUT/logs/serve_bench.log)"
+fi
+assert_serving_report "$OUT/serve_report/report.json" || true
+
+say "serving phase: live HTTP server, concurrent posts, /metrics scrape"
+if JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    "$PYBIN" - "$OUT/serve.yaml" "$OUT" > "$OUT/logs/serve_http.log" 2>&1 <<'PY'
+import json, subprocess, sys, threading, urllib.request
+
+cfg, out = sys.argv[1], sys.argv[2]
+# stderr goes to its own file: the ready line must be the FIRST stdout
+# line, and merging streams would race log lines ahead of it.
+proc = subprocess.Popen(
+    [sys.executable, "-m", "llmtrain_tpu", "serve", "--config", cfg,
+     "--from", "killrun", "--port", "0"],
+    stdout=subprocess.PIPE,
+    stderr=open(out + "/logs/serve_http_stderr.log", "w"),
+    text=True)
+ok = False
+try:
+    ready = json.loads(proc.stdout.readline())
+    assert ready["mode"] == "continuous", ready
+    url = f"http://127.0.0.1:{ready['port']}"
+    results = []
+
+    def post(i):
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompt_ids": [1 + i, 2, 3],
+                             "max_new_tokens": 6,
+                             "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as r:
+            results.append(json.loads(r.read()))
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
+        open(out + "/serve_scrape.prom", "w").write(r.read().decode())
+    with urllib.request.urlopen(url + "/healthz", timeout=60) as r:
+        health = json.loads(r.read())
+    print("healthz scheduler:", json.dumps(health.get("scheduler", {})))
+    ok = (len(results) == 4
+          and all("ttft_ms" in r for r in results)
+          and health["scheduler"]["requests_finished"] >= 4)
+finally:
+    proc.terminate()
+    proc.wait(timeout=30)
+sys.exit(0 if ok else 1)
+PY
+then
+    pass "continuous server answered 4 concurrent posts (healthz has scheduler stats)"
+else
+    fail "continuous serve HTTP round-trip failed (see $OUT/logs/serve_http.log)"
+fi
+assert_serving_scrape "$OUT/serve_scrape.prom" || true
+
 say "asserting the mid-run prometheus scrape"
 # The pods are done: the scrape either landed already or never will —
 # kill a still-polling scraper instead of waiting out its deadline.

@@ -201,8 +201,34 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument(
         "--max-new-tokens-cap",
         type=int,
-        default=256,
-        help="upper bound a request's max_new_tokens may ask for",
+        default=None,
+        help="upper bound a request's max_new_tokens may ask for "
+        "(default: serving.max_new_tokens_cap from the config)",
+    )
+    serve.add_argument(
+        "--mode",
+        choices=("simple", "continuous"),
+        default=None,
+        help="override serving.mode: 'simple' = one decode at a time "
+        "behind the device lock; 'continuous' = paged-KV continuous "
+        "batching (N in-flight sequences share one jitted program)",
+    )
+    serve.add_argument(
+        "--draft-config",
+        default=None,
+        help="YAML config of a DRAFT model: switches the continuous "
+        "scheduler to the speculative policy (requires --draft-from)",
+    )
+    serve.add_argument(
+        "--draft-from",
+        default=None,
+        help="checkpoint file, dir, or run id for the draft model's params",
+    )
+    serve.add_argument(
+        "--gamma",
+        type=int,
+        default=None,
+        help="speculative lookahead (default: serving.speculative_gamma)",
     )
     serve.add_argument(
         "--decode-param-dtype",
@@ -228,6 +254,91 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="default stop token (requests may override; default: the "
         "tokenizer's EOS, if any)",
+    )
+
+    bench = sub.add_parser(
+        "serve-bench",
+        help="seeded open-loop load generator against the continuous-"
+        "batching scheduler: p50/p95/p99 TTFT + per-token latency, "
+        "tokens/s, occupancy, compile budget — written to report.json/"
+        "report.md (docs/serving.md)",
+    )
+    bench.add_argument("--config", required=True, help="path to the YAML run config")
+    bench.add_argument(
+        "--from",
+        dest="from_spec",
+        required=True,
+        help="checkpoint file, checkpoint dir, or run id to serve",
+    )
+    bench.add_argument(
+        "--requests", type=int, default=16, help="request population size"
+    )
+    bench.add_argument(
+        "--rate-rps",
+        type=float,
+        default=8.0,
+        help="open-loop Poisson arrival rate (requests/second); arrivals "
+        "never wait for completions",
+    )
+    bench.add_argument("--seed", type=int, default=1234)
+    bench.add_argument(
+        "--prompt-tokens-min", type=int, default=4, help="shortest prompt"
+    )
+    bench.add_argument(
+        "--prompt-tokens-max",
+        type=int,
+        default=0,
+        help="longest prompt (0 = derived: min(32, block_size - max_new))",
+    )
+    bench.add_argument("--max-new-tokens", type=int, default=16)
+    bench.add_argument(
+        "--temperature",
+        type=float,
+        default=0.0,
+        help="0 = greedy (the regime the parity check pins)",
+    )
+    bench.add_argument("--top-k", type=int, default=None)
+    bench.add_argument("--top-p", type=float, default=None)
+    bench.add_argument(
+        "--timeout-sec",
+        type=float,
+        default=300.0,
+        help="give up on unfinished requests after this long",
+    )
+    bench.add_argument(
+        "--verify-parity",
+        action="store_true",
+        help="re-decode every request through sequential generate() and "
+        "assert batched output token-ids are bitwise identical (exits "
+        "nonzero on any mismatch)",
+    )
+    bench.add_argument(
+        "--out",
+        default=None,
+        help="report directory (default: <output.root_dir>/serve_bench)",
+    )
+    bench.add_argument(
+        "--decode-param-dtype",
+        choices=("compute", "param"),
+        default="compute",
+        help="as in generate/serve",
+    )
+    bench.add_argument("--ema", action="store_true")
+    bench.add_argument(
+        "--quantize", choices=("none", "int8"), default="none",
+        help="weight-only int8 quantization (ops/quant.py)",
+    )
+    bench.add_argument(
+        "--draft-config",
+        default=None,
+        help="draft model config for the speculative scheduler policy",
+    )
+    bench.add_argument(
+        "--draft-from", default=None, help="draft model checkpoint/run id"
+    )
+    bench.add_argument(
+        "--gamma", type=int, default=None,
+        help="speculative lookahead (default: serving.speculative_gamma)",
     )
 
     evalp = sub.add_parser(
@@ -1072,12 +1183,99 @@ def _load_decode_params(
     return model, params, ckpt_path, step
 
 
+def _build_serving_backend(
+    cfg,
+    args: argparse.Namespace,
+    model,
+    params,
+    logger,
+):
+    """Continuous-batching scheduler + metrics registry for serve/serve-bench.
+
+    Policy resolution: ``--draft-config`` forces ``speculative`` (and the
+    config may also select it, in which case the draft flags are
+    required); otherwise ``serving.policy`` from the config. Raises
+    ``ValueError`` with the actionable message on a bad combination —
+    callers map it to EXIT_CONFIG_ERROR.
+    """
+    from .serving import ContinuousBatchingScheduler, PagedDecodeEngine
+    from .telemetry.registry import MetricsRegistry
+
+    scfg = cfg.serving
+    registry = MetricsRegistry(None)
+    policy = "speculative" if args.draft_config is not None else scfg.policy
+    if policy == "speculative":
+        if args.draft_config is None or args.draft_from is None:
+            raise ValueError(
+                "the speculative serving policy needs --draft-config AND "
+                "--draft-from (serving.policy: speculative in the config "
+                "selects it; the draft checkpoint must come from the CLI)"
+            )
+        from .models.lora import build_adapter
+
+        draft_cfg, _, _ = load_and_validate_config(args.draft_config)
+        draft_adapter = build_adapter(draft_cfg)
+        draft_model = draft_adapter.build_model(draft_cfg)
+        draft_model, draft_params, _, _ = _load_decode_params(
+            draft_cfg,
+            draft_adapter,
+            draft_model,
+            args.draft_from,
+            ema=False,
+            decode_param_dtype=args.decode_param_dtype,
+            quantize=args.quantize,
+            logger=logger,
+            label="draft ",
+        )
+        if draft_model.vocab_size != model.vocab_size:
+            raise ValueError(
+                f"draft vocab_size ({draft_model.vocab_size}) != target "
+                f"vocab_size ({model.vocab_size}) — speculative decoding "
+                "needs a shared vocabulary"
+            )
+        scheduler = ContinuousBatchingScheduler(
+            None,
+            policy="speculative",
+            registry=registry,
+            model=model,
+            params=params,
+            draft_model=draft_model,
+            draft_params=draft_params,
+            gamma=args.gamma if args.gamma is not None else scfg.speculative_gamma,
+        )
+    else:
+        engine = PagedDecodeEngine(
+            model,
+            params,
+            block_tokens=scfg.block_tokens,
+            num_blocks=scfg.num_blocks or None,
+            max_batch_slots=scfg.max_batch_slots,
+            prompt_buckets=scfg.prompt_buckets or None,
+            batch_buckets=scfg.batch_buckets or None,
+        )
+        logger.info(
+            "continuous batching: %d slots, %d-token blocks x %d pool blocks, "
+            "prompt buckets %s, batch buckets %s",
+            engine.max_batch_slots,
+            engine.block_tokens,
+            engine.pool.num_blocks,
+            engine.prompt_buckets,
+            engine.batch_buckets,
+        )
+        scheduler = ContinuousBatchingScheduler(engine, registry=registry)
+    return scheduler, registry
+
+
 def _handle_serve(args: argparse.Namespace) -> int:
-    """Checkpoint → compiled decode loop → stdlib HTTP server (serving.py).
+    """Checkpoint → compiled decode loop → stdlib HTTP server (serving/).
 
     Loading mirrors ``generate`` exactly (LoRA merge, EMA extraction,
     pipeline→gpt conversion, decode dtype cast, int8 quantization) so a
     served model is bit-identical to the one ``generate`` would run.
+    ``serving.mode: continuous`` (or ``--mode continuous``) swaps the
+    one-decode-at-a-time device lock for the paged-KV continuous-batching
+    scheduler — handler threads submit into the admission queue and N
+    in-flight sequences share one jitted decode program (docs/serving.md).
     """
     try:
         cfg, _, _ = load_and_validate_config(args.config)
@@ -1088,11 +1286,24 @@ def _handle_serve(args: argparse.Namespace) -> int:
     if lora_err is not None:
         _emit_error(lora_err)
         return EXIT_CONFIG_ERROR
+    if (args.draft_config is None) != (args.draft_from is None):
+        _emit_error("--draft-config and --draft-from must be given together")
+        return EXIT_CONFIG_ERROR
+    mode = args.mode or cfg.serving.mode
+    if mode != "continuous" and args.draft_config is not None:
+        # Silently ignoring the draft flags would serve plain
+        # single-request decode while the user asked for speculative.
+        _emit_error(
+            "--draft-config/--draft-from need the continuous backend; "
+            "set serving.mode: continuous (or pass --mode continuous)"
+        )
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache(cfg.run.compilation_cache_dir)
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
+    scheduler = None
     try:
         from .serving import ServerState, make_server
 
@@ -1112,6 +1323,31 @@ def _handle_serve(args: argparse.Namespace) -> int:
         if eos is None and tokenizer is not None:
             eos = getattr(tokenizer, "eot_token", None)
 
+        if mode == "continuous":
+            try:
+                scheduler, registry = _build_serving_backend(
+                    cfg, args, model, params, logger
+                )
+            except ConfigLoadError as exc:
+                _emit_error(exc.message, details=exc.details, errors=exc.errors)
+                return EXIT_CONFIG_ERROR
+            except ValueError as exc:
+                _emit_error(str(exc))
+                return EXIT_CONFIG_ERROR
+            scheduler.start()
+        else:
+            # Simple mode still serves GET /metrics (request counter and
+            # latency come from ServerStats; the scheduler gauges need
+            # the continuous backend).
+            from .telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry(None)
+
+        cap = (
+            args.max_new_tokens_cap
+            if args.max_new_tokens_cap is not None
+            else cfg.serving.max_new_tokens_cap
+        )
         state = ServerState(
             model=model,
             params=params,
@@ -1119,14 +1355,26 @@ def _handle_serve(args: argparse.Namespace) -> int:
             step=step,
             checkpoint=str(ckpt_path),
             eos_token_id=eos,
-            max_new_tokens_cap=args.max_new_tokens_cap,
+            max_new_tokens_cap=cap,
+            default_max_new_tokens=cfg.serving.default_max_new_tokens,
+            scheduler=scheduler,
+            registry=registry,
+            request_timeout_sec=cfg.serving.request_timeout_sec,
         )
         httpd = make_server(state, args.host, args.port)
         host, port = httpd.server_address[:2]
         # Machine-readable ready line: tests (and orchestration) read the
         # bound port from here, which is what makes --port 0 usable.
         print(
-            json.dumps({"serving": str(ckpt_path), "host": host, "port": port}),
+            json.dumps(
+                {
+                    "serving": str(ckpt_path),
+                    "host": host,
+                    "port": port,
+                    "mode": mode,
+                    "policy": scheduler.policy if scheduler else None,
+                }
+            ),
             flush=True,
         )
         try:
@@ -1139,6 +1387,218 @@ def _handle_serve(args: argparse.Namespace) -> int:
     except Exception as exc:  # noqa: BLE001 — CLI boundary
         _emit_error(f"serve failed: {exc}")
         return exit_code_for_exception(exc)
+    finally:
+        if scheduler is not None:
+            scheduler.close()
+
+
+def _handle_serve_bench(args: argparse.Namespace) -> int:
+    """Seeded open-loop load run against the continuous-batching scheduler.
+
+    The SLO harness (docs/serving.md): a seeded request population
+    arrives on an open-loop Poisson clock (arrivals never wait for
+    completions — the regime under which tail latency means anything),
+    the scheduler serves them with continuous batching, and the
+    measurements land in three sinks: a ``serving`` block in
+    ``report.json``/``report.md``, ``llmtrain_serve_*`` gauges, and the
+    JSON summary on stdout. ``--verify-parity`` re-decodes every request
+    through sequential single-request ``generate()`` and exits nonzero
+    unless the batched token-ids are bitwise identical; a compile count
+    over the bucket budget also fails the run.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
+    if (args.draft_config is None) != (args.draft_from is None):
+        _emit_error("--draft-config and --draft-from must be given together")
+        return EXIT_CONFIG_ERROR
+    if args.requests < 1:
+        _emit_error("--requests must be >= 1")
+        return EXIT_CONFIG_ERROR
+    if args.prompt_tokens_min < 1:
+        _emit_error("--prompt-tokens-min must be >= 1")
+        return EXIT_CONFIG_ERROR
+    if args.max_new_tokens < 1:
+        # 0 would "succeed" with one unavoidable prefill token per request
+        # and then fail parity against generate()'s empty continuation —
+        # a misleading EXIT_TRAIN_FAILURE instead of a config error.
+        _emit_error("--max-new-tokens must be >= 1")
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    scheduler = None
+    try:
+        import jax
+        import numpy as np
+
+        from .serving import build_requests, run_loadgen
+
+        initialize_registries()
+        adapter, tokenizer, model = _build_decode_stack(cfg, logger)
+        model, params, ckpt_path, _step = _load_decode_params(
+            cfg,
+            adapter,
+            model,
+            args.from_spec,
+            ema=args.ema,
+            decode_param_dtype=args.decode_param_dtype,
+            quantize=args.quantize,
+            logger=logger,
+        )
+        block_size = int(model.block_size)
+        if args.max_new_tokens >= block_size:
+            _emit_error(
+                f"--max-new-tokens ({args.max_new_tokens}) must leave room "
+                f"for a prompt within block_size ({block_size})"
+            )
+            return EXIT_CONFIG_ERROR
+        pmax = args.prompt_tokens_max or min(32, block_size - args.max_new_tokens)
+        pmax = min(pmax, block_size - args.max_new_tokens)
+        pmin = min(args.prompt_tokens_min, pmax)
+
+        try:
+            scheduler, registry = _build_serving_backend(
+                cfg, args, model, params, logger
+            )
+        except ConfigLoadError as exc:
+            _emit_error(exc.message, details=exc.details, errors=exc.errors)
+            return EXIT_CONFIG_ERROR
+        except ValueError as exc:
+            _emit_error(str(exc))
+            return EXIT_CONFIG_ERROR
+
+        requests = build_requests(
+            num_requests=args.requests,
+            seed=args.seed,
+            vocab_size=int(model.vocab_size),
+            prompt_tokens_min=pmin,
+            prompt_tokens_max=pmax,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
+        logger.info(
+            "serve-bench: %d requests, prompts %d-%d tokens, %d new tokens, "
+            "%.1f rps open-loop (seed %d, policy %s)",
+            len(requests), pmin, pmax, args.max_new_tokens,
+            args.rate_rps, args.seed, scheduler.policy,
+        )
+        scheduler.start()
+        block = run_loadgen(
+            scheduler,
+            requests,
+            rate_rps=args.rate_rps,
+            seed=args.seed,
+            timeout_sec=args.timeout_sec,
+        )
+        scheduler.close()
+        block["checkpoint"] = str(ckpt_path)
+
+        failures: list[str] = []
+        compile_block = block.get("compile")
+        if compile_block is not None and not compile_block["within_budget"]:
+            failures.append(
+                f"decode-loop compile count exceeded the bucket budget: "
+                f"{compile_block['prefill_programs']} prefill + "
+                f"{compile_block['decode_programs']} decode > "
+                f"{compile_block['budget']}"
+            )
+        if block["requests"]["failed"] or block["requests"]["timed_out"]:
+            failures.append(
+                f"{block['requests']['failed']} failed / "
+                f"{block['requests']['timed_out']} timed-out requests"
+            )
+
+        if args.verify_parity:
+            # The exactness contract: batched continuous decode must emit
+            # the SAME token ids sequential single-request generate()
+            # produces for identical seeds/sampling params.
+            from .generation import generate
+
+            mismatched = 0
+            for req in requests:
+                if req.finish_reason not in ("eos", "length"):
+                    continue
+                out = generate(
+                    model,
+                    params,
+                    req.prompt_ids[None, :],
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature,
+                    top_k=req.top_k,
+                    top_p=req.top_p,
+                    eos_token_id=req.eos_token_id,
+                    rng=jax.random.key(req.seed),
+                )
+                ref = [int(t) for t in np.asarray(out)[0, req.prompt_ids.shape[0]:]]
+                if req.eos_token_id is not None and req.eos_token_id in ref:
+                    ref = ref[: ref.index(req.eos_token_id) + 1]
+                if ref != req.tokens:
+                    mismatched += 1
+                    logger.warning(
+                        "parity mismatch on request %d: served %s != "
+                        "generate() %s",
+                        req.request_id, req.tokens, ref,
+                    )
+            checked = sum(
+                1 for r in requests if r.finish_reason in ("eos", "length")
+            )
+            block["parity"] = {
+                "checked": checked,
+                "mismatched": mismatched,
+                "bitwise_identical": mismatched == 0 and checked > 0,
+            }
+            if mismatched:
+                failures.append(
+                    f"{mismatched}/{checked} requests diverged from "
+                    "sequential generate()"
+                )
+
+        # report.json / report.md with the serving block (telemetry
+        # pipeline contract — the same writer training runs use).
+        from .telemetry.report import build_report, write_reports
+        from .telemetry.timeline import EventTimeline
+
+        out_dir = Path(args.out or (Path(cfg.output.root_dir) / "serve_bench"))
+        report = build_report(
+            run_id="serve-bench",
+            run_name=cfg.run.name,
+            registry=registry,
+            timeline=EventTimeline(None),
+            memory=None,
+            wall_time_sec=block["throughput"]["wall_sec"],
+            serving=block,
+        )
+        json_path, md_path = write_reports(out_dir, report)
+        summary = {
+            "serving": block,
+            "report_json": str(json_path) if json_path else None,
+            "report_md": str(md_path) if md_path else None,
+            "ok": not failures,
+        }
+        if failures:
+            summary["failures"] = failures
+        print(json.dumps(summary, indent=2), flush=True)
+        if failures:
+            _emit_error("; ".join(failures))
+            return EXIT_TRAIN_FAILURE
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"serve-bench failed: {exc}")
+        return exit_code_for_exception(exc)
+    finally:
+        if scheduler is not None:
+            scheduler.close()
 
 
 def _handle_eval(args: argparse.Namespace) -> int:
@@ -1782,6 +2242,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_generate(args)
     if args.command == "serve":
         return _handle_serve(args)
+    if args.command == "serve-bench":
+        return _handle_serve_bench(args)
     if args.command == "eval":
         return _handle_eval(args)
     if args.command == "train-tokenizer":

@@ -18,6 +18,7 @@ from .schemas import (
     ResilienceConfig,
     RunConfig,
     RunSectionConfig,
+    ServingConfig,
     TrainerConfig,
     WatchdogConfig,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "ResilienceConfig",
     "RunConfig",
     "RunSectionConfig",
+    "ServingConfig",
     "TrainerConfig",
     "WatchdogConfig",
     "load_and_validate_config",

@@ -365,6 +365,63 @@ class TelemetryConfig(BaseModel):
     model_config = _STRICT
 
 
+class ServingConfig(BaseModel):
+    """Inference-serving knobs (llmtrain_tpu/serving/, docs/serving.md).
+
+    ``mode`` selects the backend of ``llmtrain serve``/``serve-bench``:
+    ``simple`` keeps the original one-decode-at-a-time locked path;
+    ``continuous`` runs the paged-KV continuous-batching scheduler —
+    N in-flight sequences of different lengths share one jitted decode
+    program, with shape buckets bounding the XLA compile count.
+    """
+
+    mode: Literal["simple", "continuous"] = "simple"
+    # In-flight sequences the batched decode step can hold.
+    max_batch_slots: int = Field(8, ge=1)
+    # Paged KV cache: positions per block, and the pool size in blocks
+    # (0 = derived: 1 null block + max_batch_slots worst-case sequences).
+    block_tokens: int = Field(16, ge=1)
+    num_blocks: int = Field(0, ge=0)
+    # Shape buckets bounding compiles: prompts pad to the smallest
+    # prompt_bucket >= their length, the decode batch to the smallest
+    # batch_bucket >= the in-flight count. Empty = powers of two up to
+    # block_size / max_batch_slots. The engine asserts the compiled
+    # program count stays within len(prompt)+len(batch) buckets.
+    prompt_buckets: list[int] = Field(default_factory=list)
+    batch_buckets: list[int] = Field(default_factory=list)
+    # Scheduler policy: 'paged' = continuous batching (throughput);
+    # 'speculative' = draft-and-verify decode per request (latency; needs
+    # serve --draft-config/--draft-from, occupancy stays 1).
+    policy: Literal["paged", "speculative"] = "paged"
+    speculative_gamma: int = Field(4, ge=1)
+    # Request validation caps (shared by both modes).
+    max_new_tokens_cap: int = Field(256, ge=1)
+    default_max_new_tokens: int = Field(48, ge=1)
+    # Handler threads give up on a queued request after this long.
+    request_timeout_sec: float = Field(120.0, gt=0.0)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_buckets(self) -> Self:
+        for name, buckets in (
+            ("prompt_buckets", self.prompt_buckets),
+            ("batch_buckets", self.batch_buckets),
+        ):
+            if any(b < 1 for b in buckets):
+                raise ValueError(f"serving.{name} entries must be >= 1")
+            if buckets != sorted(buckets):
+                raise ValueError(f"serving.{name} must be ascending")
+        if self.batch_buckets and self.batch_buckets[-1] != self.max_batch_slots:
+            raise ValueError(
+                "the largest serving.batch_bucket must equal "
+                f"serving.max_batch_slots ({self.max_batch_slots})"
+            )
+        if self.num_blocks and self.num_blocks < 2:
+            raise ValueError("serving.num_blocks must be 0 (derived) or >= 2")
+        return self
+
+
 class MLflowConfig(BaseModel):
     """MLflow tracking options (reference schemas.py:123-136).
 
@@ -423,6 +480,7 @@ class RunConfig(BaseModel):
     distributed: DistributedConfig = Field(default_factory=DistributedConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)

@@ -115,6 +115,16 @@ class CausalSelfAttention(nn.Module):
     # rollback (cursor-only) is unaffected: rolled-back slots are
     # simply rewritten, codes and scales together.
     kv_cache_dtype: str = "model"
+    # Paged decode (serving/paged_kv.py): the cache is a POOL of
+    # fixed-size blocks shared by every in-flight sequence instead of a
+    # per-row linear buffer. The caller passes per-row absolute positions
+    # and a block table mapping logical block i -> physical pool block;
+    # N sequences of different lengths then share ONE jitted program
+    # (continuous batching, vLLM's PagedAttention layout). Batch size
+    # never shapes the cache, so join/evict needs no cache reshuffle.
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_tokens: int = 0
 
     @nn.compact
     def __call__(
@@ -123,6 +133,8 @@ class CausalSelfAttention(nn.Module):
         attention_mask: jax.Array | None = None,
         *,
         deterministic: bool = True,
+        positions: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
@@ -210,7 +222,11 @@ class CausalSelfAttention(nn.Module):
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
 
-        if self.decode:
+        if self.decode and self.paged:
+            # Paged KV decode: block-pool cache shared across sequences,
+            # per-row positions/block tables (continuous batching serving).
+            out = self._paged_decode_attention(q, k, v, positions, block_tables)
+        elif self.decode:
             # KV-cache decode: append this call's keys/values at the cache
             # cursor, attend over the filled prefix. One compiled program
             # serves both prefill (T = prompt length) and per-token steps
@@ -464,6 +480,97 @@ class CausalSelfAttention(nn.Module):
         out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)
         return out.reshape(batch, t, n_heads, head_dim)
 
+    def _paged_decode_attention(
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        positions: jax.Array | None,
+        block_tables: jax.Array | None,
+    ) -> jax.Array:
+        """Block-pool cached attention (continuous batching serving).
+
+        The cache is a pool of ``paged_num_blocks`` blocks of
+        ``paged_block_tokens`` positions each, SHARED by every in-flight
+        sequence — batch size never shapes the cache, so sequences can
+        join/leave the batch without a cache reshuffle. ``block_tables``
+        (B, max_blocks) maps row b's logical block i to a physical pool
+        block (the host-side free-list allocator in serving/paged_kv.py
+        owns the mapping; physical block 0 is the reserved null block
+        padded table entries point at). ``positions`` (B,) is each row's
+        absolute position of the FIRST token in this call; rows at
+        different depths coexist in one program — the continuous-batching
+        primitive the linear cursor cache cannot express (its cursor is
+        one scalar for the whole batch).
+
+        Token t of row b writes K/V at pool[table[b, p//bt], p%bt] with
+        p = positions[b]+t, then attends the gathered blocks masked by
+        absolute position (col <= p) — the same liveness rule as the
+        linear path, so outputs match single-sequence decode.
+        """
+        if positions is None or block_tables is None:
+            raise ValueError(
+                "paged decode requires the `positions` (B,) and "
+                "`block_tables` (B, max_blocks) call arguments"
+            )
+        nb, bt = self.paged_num_blocks, self.paged_block_tokens
+        if nb <= 1 or bt <= 0:
+            raise ValueError(
+                "paged decode requires paged_num_blocks > 1 and "
+                f"paged_block_tokens > 0 (got {nb}, {bt}) — use "
+                "GPT.for_paged_decoding()"
+            )
+        if self.rope or self.sliding_window or self.kv_cache_dtype != "model":
+            # v1 scope: learned-position, full-causal, full-precision
+            # cache (the GPT serving family). GPT.for_paged_decoding()
+            # pre-checks the fields GPT exposes (sliding_window, cache
+            # dtype); rope only reaches here via attention modules built
+            # directly (the llama family has no paged entrypoint yet).
+            raise ValueError(
+                "paged decode does not support rope/sliding_window/"
+                "quantized cache yet"
+            )
+        batch, t, n_heads, head_dim = q.shape
+        kv_width = k.shape[2]
+        paged_key = self.variable(
+            "cache", "paged_key", jnp.zeros, (nb, bt, kv_width, head_dim), k.dtype
+        )
+        paged_value = self.variable(
+            "cache", "paged_value", jnp.zeros, (nb, bt, kv_width, head_dim), v.dtype
+        )
+        # Absolute position of every token in this call, per row.
+        pos = positions[:, None] + jnp.arange(t)[None, :]  # (B, t)
+        blocks = jnp.take_along_axis(block_tables, pos // bt, axis=1)  # (B, t)
+        slots = pos % bt
+        # Distinct rows hold disjoint physical blocks (allocator invariant),
+        # so the only duplicate targets are padded rows' null-block writes —
+        # garbage nothing live ever reads.
+        paged_key.value = paged_key.value.at[blocks, slots].set(
+            k.astype(paged_key.value.dtype)
+        )
+        paged_value.value = paged_value.value.at[blocks, slots].set(
+            v.astype(paged_value.value.dtype)
+        )
+
+        s = block_tables.shape[1] * bt
+        keys = paged_key.value[block_tables].reshape(batch, s, kv_width, head_dim)
+        values = paged_value.value[block_tables].reshape(
+            batch, s, kv_width, head_dim
+        )
+        scale = 1.0 / math.sqrt(head_dim)
+        g = n_heads // kv_width  # grouped-query read, like the linear path
+        qg = q.reshape(batch, t, kv_width, g, head_dim)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys) * scale
+        scores = scores.astype(jnp.float32)
+        # Logical slot index IS the absolute position (block i covers
+        # positions [i*bt, (i+1)*bt)): causal liveness is col <= row.
+        row = pos[:, None, None, :, None]  # (B, 1, 1, t, 1)
+        col = jnp.arange(s)[None, None, None, None, :]
+        scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, values)
+        return out.reshape(batch, t, n_heads, head_dim)
+
 
 def dense_attention(
     q: jax.Array,
@@ -536,6 +643,10 @@ class TransformerBlock(nn.Module):
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     kv_cache_dtype: str = "model"  # "int8": quantized decode cache
+    # Paged block-pool decode cache (see CausalSelfAttention.paged).
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_tokens: int = 0
     # Mixture-of-Experts MLP (models/moe.py); 0 = dense MLP.
     n_experts: int = 0
     capacity_factor: float = 1.25
@@ -548,6 +659,8 @@ class TransformerBlock(nn.Module):
         x: jax.Array,
         attention_mask: jax.Array | None = None,
         deterministic: bool = True,
+        positions: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         ln_kw = dict(
             dtype=self.dtype,
@@ -571,8 +684,17 @@ class TransformerBlock(nn.Module):
             sliding_window=self.sliding_window,
             ring_slack=self.ring_slack,
             kv_cache_dtype=self.kv_cache_dtype,
+            paged=self.paged,
+            paged_num_blocks=self.paged_num_blocks,
+            paged_block_tokens=self.paged_block_tokens,
             name="attn",
-        )(h, attention_mask, deterministic=deterministic)
+        )(
+            h,
+            attention_mask,
+            deterministic=deterministic,
+            positions=positions,
+            block_tables=block_tables,
+        )
 
         h = nn.LayerNorm(name="ln_2", **ln_kw)(x)
         if self.n_experts > 0:
@@ -667,6 +789,49 @@ class GPT(nn.Module):
     # Decode-cache storage dtype (model.extra.kv_cache_dtype): "int8"
     # halves KV-cache HBM vs bf16 (see CausalSelfAttention).
     kv_cache_dtype: str = "model"
+    # Paged block-pool decode cache for continuous-batching serving
+    # (see CausalSelfAttention.paged); set via for_paged_decoding().
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_tokens: int = 0
+
+    def for_paged_decoding(
+        self, *, num_blocks: int, block_tokens: int
+    ) -> "GPT":
+        """Clone configured for paged-KV continuous-batching decode.
+
+        The cache becomes a pool of ``num_blocks`` blocks of
+        ``block_tokens`` positions each, shared by every in-flight
+        sequence; callers pass per-row ``positions`` and ``block_tables``
+        to ``apply`` (serving/engine.py owns the jitted step). Same
+        parameter structure as training (params transfer 1:1). Physical
+        block 0 is the null block padded table entries point at, so the
+        pool must hold at least 2 blocks.
+        """
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (got {num_blocks})")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1 (got {block_tokens})")
+        # (No rope check: GPT has no rope field — rotary embeddings live on
+        # CausalSelfAttention for the llama-family modules, whose paged
+        # path is guarded by the attention-level check instead.)
+        if self.sliding_window:
+            raise ValueError(
+                "paged decode does not support sliding_window models yet; "
+                "use for_decoding() (rolling-ring cache)"
+            )
+        if self.kv_cache_dtype != "model":
+            raise ValueError(
+                "paged decode does not support kv_cache_dtype="
+                f"{self.kv_cache_dtype!r} yet; use for_decoding()"
+            )
+        return self.clone(
+            decode=True,
+            paged=True,
+            remat=False,
+            paged_num_blocks=num_blocks,
+            paged_block_tokens=block_tokens,
+        )
 
     def for_decoding(
         self, cache_len: int | None = None, *, ring_slack: int = 0
@@ -698,6 +863,8 @@ class GPT(nn.Module):
         *,
         deterministic: bool = True,
         return_hidden: bool = False,
+        positions: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         _, seqlen = input_ids.shape
         if seqlen > self.block_size:
@@ -722,16 +889,25 @@ class GPT(nn.Module):
             name="position_embedding",
         )
 
-        if self.decode:
+        if self.decode and self.paged:
+            # Per-ROW absolute positions from the caller: rows at different
+            # depths share one program (continuous batching). No cursor
+            # variable — the scheduler owns each sequence's position.
+            if positions is None:
+                raise ValueError(
+                    "paged decode requires the `positions` (B,) argument"
+                )
+            pos_ids = positions[:, None] + jnp.arange(seqlen)[None, :]
+        elif self.decode:
             # Positions continue from the cache cursor across apply() calls.
             position_index = self.variable(
                 "cache", "position_index", lambda: jnp.zeros((), jnp.int32)
             )
-            positions = (position_index.value + jnp.arange(seqlen))[None, :]
+            pos_ids = (position_index.value + jnp.arange(seqlen))[None, :]
             position_index.value = position_index.value + seqlen
         else:
-            positions = jnp.arange(seqlen)[None, :]
-        x = token_embedding(input_ids) + position_embedding(positions)
+            pos_ids = jnp.arange(seqlen)[None, :]
+        x = token_embedding(input_ids) + position_embedding(pos_ids)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
@@ -752,8 +928,9 @@ class GPT(nn.Module):
                 policy=REMAT_POLICIES[self.remat_policy],
             )
 
+        paged = self.decode and self.paged
         for layer in range(self.n_layers):
-            x = block_cls(
+            block = block_cls(
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 d_ff=self.d_ff,
@@ -769,12 +946,27 @@ class GPT(nn.Module):
                 sliding_window=self.sliding_window,
                 ring_slack=self.ring_slack if self.decode else 0,
                 kv_cache_dtype=self.kv_cache_dtype,
+                paged=paged,
+                paged_num_blocks=self.paged_num_blocks if paged else 0,
+                paged_block_tokens=self.paged_block_tokens if paged else 0,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
                 router_top_k=self.router_top_k,
                 name=f"block_{layer}",
-            )(x, attention_mask, deterministic)
+            )
+            if paged:
+                # kwargs only on the paged path: the remat wrapper's
+                # positional static_argnums contract stays untouched.
+                x = block(
+                    x,
+                    attention_mask,
+                    deterministic,
+                    positions=positions,
+                    block_tables=block_tables,
+                )
+            else:
+                x = block(x, attention_mask, deterministic)
 
         x = nn.LayerNorm(
             name="ln_f",

@@ -1,0 +1,41 @@
+"""Continuous-batching inference subsystem (docs/serving.md).
+
+Package layout (promoted from the original single-module ``serving.py``,
+whose public names — ``ServerState``, ``make_server``, and the tested
+``_handle_generate_request`` — keep importing from here):
+
+* :mod:`~.http` — the stdlib HTTP surface (healthz / metrics / generate),
+  lock-protected cross-request stats;
+* :mod:`~.paged_kv` — the paged KV-cache block pool: free-list
+  allocator, admission-time budget reservation, per-sequence block
+  tables;
+* :mod:`~.engine` — bucketed jitted prefill/decode steps over the pool,
+  per-row generate()-exact sampling, compile-budget accounting;
+* :mod:`~.scheduler` — continuous (in-flight) batching: admission queue,
+  per-step join/evict, speculative decoding as a first-class policy,
+  ``serve/*`` metrics;
+* :mod:`~.loadgen` — seeded open-loop arrival harness emitting the
+  p50/p95/p99 TTFT + per-token SLO block for report.json / Prometheus.
+"""
+
+from .engine import PagedDecodeEngine, bucket_for
+from .http import ServerState, ServerStats, _handle_generate_request, make_server
+from .loadgen import build_requests, percentiles, run_loadgen
+from .paged_kv import NULL_BLOCK, BlockTable, PagedKVPool
+from .scheduler import ContinuousBatchingScheduler, ServeRequest
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockTable",
+    "ContinuousBatchingScheduler",
+    "PagedDecodeEngine",
+    "PagedKVPool",
+    "ServeRequest",
+    "ServerState",
+    "ServerStats",
+    "bucket_for",
+    "build_requests",
+    "make_server",
+    "percentiles",
+    "run_loadgen",
+]

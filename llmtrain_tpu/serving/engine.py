@@ -1,0 +1,438 @@
+"""Paged decode engine: bucketed jitted prefill/decode over the block pool.
+
+The execution layer of the continuous-batching server (scheduler.py owns
+WHEN sequences join/leave; this module owns HOW a step runs):
+
+* **Two programs, shape-bucketed.** ``prefill`` runs one joining
+  sequence's prompt (padded to a prompt-length bucket) through the paged
+  model, writing its K/V blocks and sampling its first token; ``decode``
+  advances every in-flight sequence one token (batch padded to a
+  batch-size bucket). XLA compiles once per bucket, so the total compile
+  count is bounded by ``len(prompt_buckets) + len(batch_buckets)`` — a
+  budget :meth:`compile_stats` exposes and tests assert
+  (tests/test_serving_engine.py), because unbounded recompilation is the
+  classic way a JAX server falls over in production.
+* **Per-row sampling with per-request seeds.** Greedy rows take the raw
+  argmax; sampled rows replay ``generate()``'s exact recipe —
+  temperature scale, top-k/top-p filter (same thresholds as
+  ``generation.filter_logits``), then ``categorical(fold_in(key(seed),
+  emit_index))`` — per ROW, so a batched decode emits the same tokens the
+  single-request path would (the exactness contract the acceptance test
+  pins under greedy decoding).
+* **Shared pool cache.** The paged cache is batch-shape-independent
+  (models/gpt.py ``_paged_decode_attention``), so every bucket's program
+  reads/writes the SAME donated cache buffers — join/evict never copies
+  K/V.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+from .paged_kv import PagedKVPool
+
+logger = get_logger()
+
+
+def _round_up_buckets(limit: int, *, start: int = 1) -> list[int]:
+    """Powers of two up to (and always including) ``limit``."""
+    buckets: list[int] = []
+    b = start
+    while b < limit:
+        buckets.append(b)
+        b *= 2
+    buckets.append(limit)
+    return buckets
+
+
+def bucket_for(n: int, buckets: list[int]) -> int:
+    """Smallest bucket >= n; raises when n exceeds every bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket ({buckets[-1]})")
+
+
+def _filter_rows(
+    scaled: jax.Array, top_ks: jax.Array, top_ps: jax.Array
+) -> jax.Array:
+    """Per-row top-k / top-p masking with DYNAMIC knobs.
+
+    Same thresholds as ``generation.filter_logits`` (kth-largest value;
+    exclusive-cumulative-mass nucleus cut) but per row and data-dependent,
+    so one compiled program serves every sampling configuration —
+    per-request knobs must not multiply the compile count. ``top_ks <= 0``
+    and ``top_ps`` outside (0, 1) disable the respective filter, matching
+    generate()'s out-of-band conventions.
+    """
+    v = scaled.shape[-1]
+    # top-k: threshold at each row's k-th largest (k clamped into [1, V]).
+    desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)  # (B, V)
+    k_idx = jnp.clip(top_ks - 1, 0, v - 1)
+    kth = jnp.take_along_axis(desc, k_idx[:, None], axis=-1)  # (B, 1)
+    kth = jnp.where((top_ks > 0)[:, None], kth, -jnp.inf)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p composes AFTER top-k, on the masked logits (filter_logits
+    # order): keep the smallest descending-prob prefix whose EXCLUSIVE
+    # cumulative mass is < p (always keeps the argmax).
+    desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    probs = jax.nn.softmax(desc, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    keep = exclusive < top_ps[:, None]
+    thr = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    active = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
+    return jnp.where(active & (scaled < thr), -jnp.inf, scaled)
+
+
+def _sample_rows(
+    logits: jax.Array,  # (B, V) f32
+    seeds: jax.Array,  # (B,) uint32 — per-request rng seed
+    emit_idx: jax.Array,  # (B,) int32 — tokens already emitted by the row
+    temps: jax.Array,  # (B,) f32; 0 = greedy
+    top_ks: jax.Array,  # (B,) int32; <=0 disables
+    top_ps: jax.Array,  # (B,) f32; outside (0,1) disables
+) -> jax.Array:
+    """One sampling decision per row, generate()-exact per request.
+
+    Greedy rows bypass the filter entirely (raw argmax — _sample_next's
+    temperature==0 short-circuit); sampled rows draw
+    ``categorical(fold_in(key(seed), emit_idx), filtered)`` — the same
+    key schedule generate() uses for a batch of one.
+    """
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = _filter_rows(logits / safe_t[:, None], top_ks, top_ps)
+
+    def one(seed: jax.Array, i: jax.Array, row: jax.Array) -> jax.Array:
+        key = jax.random.fold_in(jax.random.key(seed), i)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(one)(seeds, emit_idx, scaled)
+    return jnp.where(temps == 0.0, greedy_tok, sampled).astype(jnp.int32)
+
+
+def _prefill_impl(
+    model: Any,
+    params: Any,
+    cache: Any,
+    prompt: jax.Array,  # (1, Tb) padded
+    true_len: jax.Array,  # (1,) int32
+    block_tables: jax.Array,  # (1, MB) int32
+    seeds: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+) -> tuple[Any, jax.Array]:
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache},
+        prompt,
+        deterministic=True,
+        positions=jnp.zeros((prompt.shape[0],), jnp.int32),
+        block_tables=block_tables,
+        mutable=["cache"],
+    )
+    # Sample at the LAST REAL position; padded positions' K/V landed in
+    # the null block and padded-row logits are garbage nobody reads.
+    last = jnp.take_along_axis(
+        logits.astype(jnp.float32), (true_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    tok = _sample_rows(
+        last, seeds, jnp.zeros_like(true_len), temps, top_ks, top_ps
+    )
+    return mutated["cache"], tok
+
+
+def _decode_impl(
+    model: Any,
+    params: Any,
+    cache: Any,
+    tokens: jax.Array,  # (B,) int32 — each row's last emitted token
+    positions: jax.Array,  # (B,) int32 — that token's absolute position
+    block_tables: jax.Array,  # (B, MB) int32
+    seeds: jax.Array,
+    emit_idx: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+) -> tuple[Any, jax.Array]:
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache},
+        tokens[:, None],
+        deterministic=True,
+        positions=positions,
+        block_tables=block_tables,
+        mutable=["cache"],
+    )
+    tok = _sample_rows(
+        logits[:, -1].astype(jnp.float32), seeds, emit_idx, temps, top_ks, top_ps
+    )
+    return mutated["cache"], tok
+
+
+class PagedDecodeEngine:
+    """Bucketed paged-KV decode over one model + params.
+
+    Owns the device cache (donated through every step), the host-side
+    pool allocator, the bucket policy, and the compile accounting. The
+    scheduler calls :meth:`prefill` / :meth:`decode`; nothing here
+    decides admission.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        block_tokens: int = 16,
+        num_blocks: int | None = None,
+        max_batch_slots: int = 8,
+        prompt_buckets: list[int] | None = None,
+        batch_buckets: list[int] | None = None,
+    ) -> None:
+        if not hasattr(model, "for_paged_decoding"):
+            raise ValueError(
+                "paged serving needs a model exposing for_paged_decoding(); "
+                f"{type(model).__name__} does not"
+            )
+        self.model = model
+        self.params = params
+        self.block_size = int(model.block_size)
+        self.block_tokens = int(block_tokens)
+        self.max_blocks_per_seq = -(-self.block_size // self.block_tokens)
+        if num_blocks is None:
+            # Default: every slot can host a worst-case sequence, + null.
+            num_blocks = 1 + max_batch_slots * self.max_blocks_per_seq
+        self.max_batch_slots = int(max_batch_slots)
+        self.prompt_buckets = sorted(
+            prompt_buckets or _round_up_buckets(self.block_size, start=8)
+        )
+        self.batch_buckets = sorted(
+            batch_buckets or _round_up_buckets(self.max_batch_slots)
+        )
+        if self.prompt_buckets[-1] > self.block_size:
+            raise ValueError(
+                f"largest prompt bucket ({self.prompt_buckets[-1]}) exceeds "
+                f"the model block_size ({self.block_size})"
+            )
+        if self.batch_buckets[-1] != self.max_batch_slots:
+            raise ValueError(
+                f"largest batch bucket ({self.batch_buckets[-1]}) must equal "
+                f"max_batch_slots ({self.max_batch_slots})"
+            )
+        self.decode_model = model.for_paged_decoding(
+            num_blocks=num_blocks, block_tokens=self.block_tokens
+        )
+        self.pool = PagedKVPool(num_blocks, self.block_tokens)
+
+        # Zero cache pytree from an eval_shape trace — no param init work
+        # (the generation.py idiom). Cache shapes are batch-INDEPENDENT
+        # (the pool is shared), so one cache serves every bucket.
+        mb = self.max_blocks_per_seq
+        var_shapes = jax.eval_shape(
+            lambda: self.decode_model.init(
+                jax.random.key(0),
+                jnp.zeros((1, 1), jnp.int32),
+                deterministic=True,
+                positions=jnp.zeros((1,), jnp.int32),
+                block_tables=jnp.zeros((1, mb), jnp.int32),
+            )
+        )
+        self._cache_struct = var_shapes["cache"]
+        self._cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_struct
+        )
+        # Bumped whenever a failed step forces a cache rebuild: the
+        # scheduler compares epochs to learn that in-flight KV was lost.
+        self.cache_epoch = 0
+
+        # Per-engine CLOSURES under the jits: jax keys the pjit program
+        # cache on the underlying callable, so wrapping the module-level
+        # impls directly would make every engine in the process share one
+        # cache and `_cache_size()` count other engines' programs. A fresh
+        # function object per engine keeps the compile accounting local
+        # (and the closed-over model off the static-argument hash path).
+        def _prefill_bound(params: Any, cache: Any, *rest: Any) -> Any:
+            return _prefill_impl(self.decode_model, params, cache, *rest)
+
+        def _decode_bound(params: Any, cache: Any, *rest: Any) -> Any:
+            return _decode_impl(self.decode_model, params, cache, *rest)
+
+        self._prefill_jit = jax.jit(_prefill_bound, donate_argnums=(1,))
+        self._decode_jit = jax.jit(_decode_bound, donate_argnums=(1,))
+        self._prefill_shapes: set[int] = set()
+        self._decode_shapes: set[int] = set()
+
+    # --------------------------------------------------------- validation
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int) -> str | None:
+        """Why this engine can never serve the request, or None if it can.
+
+        Checked at ADMISSION (scheduler) and at the HTTP boundary (400,
+        not a late 500): the model's context bound, the largest prompt
+        bucket (prefill cannot pad past it), and the pool's total
+        capacity — a request whose worst-case block need exceeds the
+        whole pool would otherwise sit at the FIFO head forever, starving
+        everything behind it (try_reserve can only say "not yet").
+        """
+        prompt_len, total = int(prompt_len), int(prompt_len) + int(max_new_tokens)
+        if total > self.block_size:
+            return (
+                f"prompt+max_new_tokens ({total}) exceeds the model "
+                f"block_size ({self.block_size})"
+            )
+        if prompt_len > self.prompt_buckets[-1]:
+            return (
+                f"prompt length ({prompt_len}) exceeds the largest "
+                f"serving prompt bucket ({self.prompt_buckets[-1]})"
+            )
+        capacity = self.pool.num_blocks - 1
+        need = self.pool.blocks_needed(total)
+        if need > capacity:
+            return (
+                f"request needs {need} worst-case KV blocks but the pool "
+                f"only holds {capacity} — raise serving.num_blocks or "
+                f"lower max_new_tokens"
+            )
+        return None
+
+    # ----------------------------------------------------------- stepping
+
+    def prefill(
+        self,
+        prompt_ids: np.ndarray,  # (Tp,) int32
+        table_padded: list[int],
+        *,
+        seed: int,
+        temperature: float,
+        top_k: int | None,
+        top_p: float | None,
+    ) -> int:
+        """Run one joining sequence's prompt; returns its first token."""
+        tp = int(prompt_ids.shape[0])
+        tb = bucket_for(tp, self.prompt_buckets)
+        self._prefill_shapes.add(tb)
+        prompt = np.zeros((1, tb), np.int32)
+        prompt[0, :tp] = prompt_ids
+        try:
+            cache, tok = self._prefill_jit(
+                self.params,
+                self._cache,
+                jnp.asarray(prompt),
+                jnp.asarray([tp], jnp.int32),
+                jnp.asarray([table_padded], jnp.int32),
+                jnp.asarray([seed & 0xFFFFFFFF], jnp.uint32),
+                jnp.asarray([temperature], jnp.float32),
+                jnp.asarray([0 if top_k is None else top_k], jnp.int32),
+                jnp.asarray([0.0 if top_p is None else top_p], jnp.float32),
+            )
+        except Exception:
+            self._recover_cache_after_error()
+            raise
+        self._cache = cache
+        return int(tok[0])
+
+    def decode(self, rows: list[dict[str, Any]]) -> list[int]:
+        """Advance every row one token; returns next tokens, row-aligned.
+
+        Each row dict: ``token`` (last emitted), ``position`` (its
+        absolute position), ``table`` (padded physical ids), ``seed``,
+        ``emit_idx``, ``temperature``, ``top_k``, ``top_p``. The batch is
+        padded to a batch bucket with null-table greedy rows whose output
+        is discarded.
+        """
+        n = len(rows)
+        if n == 0:
+            return []
+        bb = bucket_for(n, self.batch_buckets)
+        self._decode_shapes.add(bb)
+        mb = self.max_blocks_per_seq
+
+        def col(key: str, fill: Any, dtype: Any) -> np.ndarray:
+            out = np.full((bb,), fill, dtype=dtype)
+            for i, r in enumerate(rows):
+                out[i] = r[key]
+            return out
+
+        tables = np.zeros((bb, mb), np.int32)
+        for i, r in enumerate(rows):
+            tables[i] = r["table"]
+        try:
+            cache, tok = self._decode_jit(
+                self.params,
+                self._cache,
+                jnp.asarray(col("token", 0, np.int32)),
+                jnp.asarray(col("position", 0, np.int32)),
+                jnp.asarray(tables),
+                jnp.asarray(
+                    np.array(
+                        [r["seed"] & 0xFFFFFFFF for r in rows] + [0] * (bb - n),
+                        dtype=np.uint32,
+                    )
+                ),
+                jnp.asarray(col("emit_idx", 0, np.int32)),
+                jnp.asarray(col("temperature", 0.0, np.float32)),
+                jnp.asarray(col("top_k", 0, np.int32)),
+                jnp.asarray(col("top_p", 0.0, np.float32)),
+            )
+        except Exception:
+            self._recover_cache_after_error()
+            raise
+        self._cache = cache
+        return [int(t) for t in np.asarray(jax.device_get(tok))[:n]]
+
+    def _recover_cache_after_error(self) -> None:
+        """Donation safety: a jitted call that fails at RUNTIME has already
+        consumed (deleted) the donated cache buffers, so without recovery
+        every later prefill/decode would die on "Array has been deleted" —
+        one transient device error would wedge the server for good.
+        Trace-time failures never donate: a still-live cache (and the
+        in-flight KV it holds) is kept untouched; a deleted one is rebuilt
+        zeroed and ``cache_epoch`` bumped so the scheduler fails the
+        in-flight sequences whose KV went with it.
+        """
+        leaves = jax.tree.leaves(self._cache)
+        if any(
+            leaf.is_deleted()
+            for leaf in leaves
+            if isinstance(leaf, jax.Array)
+        ):
+            self._cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), self._cache_struct
+            )
+            self.cache_epoch += 1
+
+    # --------------------------------------------------------- accounting
+
+    def compile_stats(self) -> dict[str, Any]:
+        """Bucket usage + compiled-program counts (the bounded-compile
+        contract: programs <= prompt_buckets + batch_buckets, asserted by
+        tests and reported by the load harness)."""
+        stats: dict[str, Any] = {
+            "prompt_buckets": list(self.prompt_buckets),
+            "batch_buckets": list(self.batch_buckets),
+            "prefill_shapes_used": sorted(self._prefill_shapes),
+            "decode_shapes_used": sorted(self._decode_shapes),
+            "budget": len(self.prompt_buckets) + len(self.batch_buckets),
+        }
+        try:  # jax's own cache entry count, when the API exists (0.4.x)
+            stats["prefill_programs"] = int(self._prefill_jit._cache_size())
+            stats["decode_programs"] = int(self._decode_jit._cache_size())
+        except Exception:  # noqa: BLE001 — accounting is best-effort
+            stats["prefill_programs"] = len(self._prefill_shapes)
+            stats["decode_programs"] = len(self._decode_shapes)
+        stats["within_budget"] = (
+            stats["prefill_programs"] + stats["decode_programs"]
+            <= stats["budget"]
+        )
+        return stats
+
+
+__all__ = [
+    "PagedDecodeEngine",
+    "bucket_for",
+]

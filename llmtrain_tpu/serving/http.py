@@ -1,0 +1,358 @@
+"""HTTP surface of the inference server (stdlib, no new dependencies).
+
+Promoted from the original single-module ``serving.py``; the routes keep
+their contracts and gain the batched backend:
+
+* ``GET /healthz`` — liveness + model/checkpoint metadata, now including
+  scheduler/KV-pool/compile stats when the continuous-batching engine is
+  attached.
+* ``GET /metrics`` — Prometheus exposition of the serving registry
+  (``llmtrain_serve_*``), same text format the training Jobs export.
+* ``POST /v1/generate`` — validation unchanged; with a scheduler attached
+  the request is SUBMITTED to the continuous batch and the handler thread
+  waits on its completion event (N handler threads → N in-flight
+  sequences sharing one jitted program), otherwise the legacy
+  one-decode-at-a-time lock path runs.
+
+Thread discipline: ``ThreadingHTTPServer`` runs one handler thread per
+connection, so every cross-request mutable — request counters, latency
+accumulators — lives in :class:`ServerStats` behind its own lock (the
+bare ``requests_served += 1`` this replaces was a read-modify-write race
+between handler threads).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class ServerStats:
+    """Lock-protected cross-request counters/accumulators.
+
+    ``ThreadingHTTPServer`` handler threads all mutate this; int += is a
+    read-modify-write, so every mutation happens under one lock
+    (regression-tested by hammering :meth:`record` from many threads).
+    """
+
+    _RESERVOIR = 512  # newest latencies kept for the healthz summary
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._errors = 0
+        self._tokens_out = 0
+        self._latency_sum_ms = 0.0
+        self._latencies_ms: list[float] = []
+
+    def record(self, *, latency_ms: float, tokens: int) -> None:
+        with self._lock:
+            self._requests += 1
+            self._tokens_out += tokens
+            self._latency_sum_ms += latency_ms
+            self._latencies_ms.append(latency_ms)
+            if len(self._latencies_ms) > self._RESERVOIR:
+                del self._latencies_ms[: -self._RESERVOIR]
+
+    def record_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+
+    @property
+    def requests_served(self) -> int:
+        with self._lock:
+            return self._requests
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            n = self._requests
+            lat = sorted(self._latencies_ms)
+            return {
+                "requests_served": n,
+                "errors": self._errors,
+                "tokens_out": self._tokens_out,
+                "mean_latency_ms": round(self._latency_sum_ms / n, 3) if n else None,
+                "p50_latency_ms": round(lat[len(lat) // 2], 3) if lat else None,
+            }
+
+
+@dataclass
+class ServerState:
+    """Everything a request needs; built once by the CLI before serving."""
+
+    model: Any
+    params: Any
+    tokenizer: Any | None
+    step: int
+    checkpoint: str
+    eos_token_id: int | None = None
+    max_new_tokens_cap: int = 256
+    default_max_new_tokens: int = 48
+    # Legacy path only — one decode at a time behind the device lock. The
+    # scheduler path replaces the lock with the admission queue.
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    stats: ServerStats = field(default_factory=ServerStats)
+    # Continuous-batching backend (serving/scheduler.py), None = legacy.
+    scheduler: Any | None = None
+    # Telemetry registry served on GET /metrics (llmtrain_serve_*).
+    registry: Any | None = None
+    request_timeout_sec: float = 120.0
+
+    @property
+    def requests_served(self) -> int:
+        """Back-compat alias for the pre-ServerStats counter field."""
+        return self.stats.requests_served
+
+
+def _bad_request(msg: str) -> tuple[int, dict]:
+    return 400, {"error": msg}
+
+
+def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]:
+    """Pure request logic (no HTTP): validate -> decode -> respond."""
+    from ..generation import generate
+
+    if not isinstance(body, dict):
+        return _bad_request("request body must be a JSON object")
+    unknown = set(body) - {
+        "prompt", "prompt_ids", "max_new_tokens", "temperature",
+        "top_k", "top_p", "seed", "eos_token_id",
+    }
+    if unknown:
+        return _bad_request(f"unknown fields: {sorted(unknown)}")
+    if ("prompt" in body) == ("prompt_ids" in body):
+        return _bad_request("provide exactly one of 'prompt' or 'prompt_ids'")
+
+    vocab = int(getattr(state.model, "vocab_size", 0) or 0)
+    if "prompt" in body:
+        if state.tokenizer is None:
+            return _bad_request(
+                "this server has no tokenizer; send 'prompt_ids' instead"
+            )
+        if not isinstance(body["prompt"], str) or not body["prompt"]:
+            return _bad_request("'prompt' must be a non-empty string")
+        ids = np.asarray(state.tokenizer.encode(body["prompt"]), dtype=np.int32)
+    else:
+        raw = body["prompt_ids"]
+        if (
+            not isinstance(raw, list)
+            or not raw
+            or not all(isinstance(t, int) for t in raw)
+        ):
+            return _bad_request("'prompt_ids' must be a non-empty list of ints")
+        bound = vocab or 2**31 - 1  # int32 dtype bound when vocab unknown
+        if not all(0 <= t < bound for t in raw):
+            return _bad_request(f"prompt token ids must be in [0, {bound})")
+        ids = np.asarray(raw, dtype=np.int32)
+    if ids.size == 0:
+        return _bad_request("prompt encodes to zero tokens")
+
+    # A server started with a cap below the default must still accept
+    # knob-less requests: the effective default is min(default, cap).
+    max_new = body.get(
+        "max_new_tokens",
+        min(state.default_max_new_tokens, state.max_new_tokens_cap),
+    )
+    if not isinstance(max_new, int) or max_new < 1:
+        return _bad_request("'max_new_tokens' must be a positive int")
+    if max_new > state.max_new_tokens_cap:
+        return _bad_request(
+            f"'max_new_tokens' exceeds the server cap "
+            f"({state.max_new_tokens_cap})"
+        )
+    block_size = int(getattr(state.model, "block_size", 10**9))
+    if ids.size + max_new > block_size:
+        return _bad_request(
+            f"prompt ({ids.size}) + max_new_tokens ({max_new}) exceeds the "
+            f"model block_size ({block_size})"
+        )
+    engine = getattr(state.scheduler, "engine", None)
+    if engine is not None:
+        # Paged-backend bounds (prompt bucket, pool capacity): reject at
+        # the HTTP boundary as a 400, not a late 500 from inside prefill.
+        reason = engine.validate_request(int(ids.size), int(max_new))
+        if reason is not None:
+            return _bad_request(reason)
+    temperature = body.get("temperature", 1.0)
+    if not isinstance(temperature, (int, float)) or isinstance(temperature, bool):
+        return _bad_request("'temperature' must be a number")
+    if temperature < 0:
+        return _bad_request("'temperature' must be >= 0")
+    top_k = body.get("top_k")
+    if top_k is not None and (not isinstance(top_k, int) or isinstance(top_k, bool)):
+        return _bad_request("'top_k' must be an int")
+    top_p = body.get("top_p")
+    if top_p is not None and (
+        not isinstance(top_p, (int, float)) or isinstance(top_p, bool)
+    ):
+        return _bad_request("'top_p' must be a number")
+    seed = body.get("seed", 0)
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        return _bad_request("'seed' must be an int")
+    eos = body.get("eos_token_id", state.eos_token_id)
+    if eos is not None and (not isinstance(eos, int) or isinstance(eos, bool)):
+        return _bad_request("'eos_token_id' must be an int")
+
+    t0 = time.monotonic()
+    extra: dict[str, Any] = {}
+    if state.scheduler is not None:
+        # Continuous batching: enqueue and wait; the scheduler thread
+        # joins this sequence into the in-flight batch.
+        from .scheduler import ServeRequest
+
+        req = ServeRequest(
+            prompt_ids=ids,
+            max_new_tokens=max_new,
+            temperature=float(temperature),
+            top_k=top_k,
+            top_p=top_p,
+            seed=seed,
+            eos_token_id=eos,
+        )
+        state.scheduler.submit(req)
+        if not req.done.wait(timeout=state.request_timeout_sec):
+            # Tell the scheduler this waiter is gone: under sustained
+            # overload the queue would otherwise fill with requests that
+            # still get fully decoded for nobody, and the server could
+            # never catch up.
+            req.abandon()
+            state.stats.record_error()
+            return 503, {"error": "request timed out in the serving queue"}
+        if req.error is not None:
+            state.stats.record_error()
+            return 500, {"error": f"generation failed: {req.error}"}
+        completion = list(req.tokens)
+        if req.ttft_ms is not None:
+            extra["ttft_ms"] = round(req.ttft_ms, 3)
+        extra["finish_reason"] = req.finish_reason
+    else:
+        with state.lock:
+            out = generate(
+                state.model,
+                state.params,
+                ids[None, :],
+                max_new_tokens=max_new,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                eos_token_id=eos,
+                rng=jax.random.key(seed),
+            )
+        completion = [int(t) for t in np.asarray(out)[0, ids.size :]]
+        if eos is not None and eos in completion:
+            completion = completion[: completion.index(eos) + 1]
+    latency_ms = (time.monotonic() - t0) * 1000.0
+    state.stats.record(latency_ms=latency_ms, tokens=len(completion))
+    if state.registry is not None and state.scheduler is None:
+        # The scheduler publishes its own serve/* metrics; the legacy
+        # path still counts requests for the /metrics endpoint.
+        state.registry.inc("serve/requests")
+
+    text = None
+    if state.tokenizer is not None:
+        try:
+            text = state.tokenizer.decode(completion)
+        except Exception:  # noqa: BLE001 — decode is best-effort for ids
+            text = None
+    return 200, {
+        "completion_ids": completion,
+        "text": text,
+        "prompt_tokens": int(ids.size),
+        "latency_ms": round(latency_ms, 3),
+        **extra,
+    }
+
+
+def _handle_health(state: ServerState) -> tuple[int, dict]:
+    payload: dict[str, Any] = {
+        "status": "ok",
+        "model": type(state.model).__name__,
+        "step": state.step,
+        "checkpoint": state.checkpoint,
+        "requests_served": state.stats.requests_served,
+        "stats": state.stats.snapshot(),
+    }
+    if state.scheduler is not None:
+        payload["scheduler"] = state.scheduler.stats()
+    return 200, payload
+
+
+def _handle_metrics(state: ServerState) -> tuple[int, str]:
+    """Prometheus text for GET /metrics (requires a registry)."""
+    if state.registry is None:
+        return 404, "no metrics registry attached\n"
+    from ..telemetry.prometheus import render_prometheus
+
+    return 200, render_prometheus(
+        state.registry.latest(),
+        state.registry.counters(),
+        {"component": "serve", "checkpoint": state.checkpoint},
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by make_server().
+    state: ServerState = None  # type: ignore[assignment]
+
+    def _respond(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            self._respond(*_handle_health(self.state))
+        elif self.path.split("?")[0] == "/metrics":
+            code, text = _handle_metrics(self.state)
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._respond(404, {"error": f"no route for GET {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path != "/v1/generate":
+            self._respond(404, {"error": f"no route for POST {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"null")
+        except (ValueError, json.JSONDecodeError):
+            self._respond(400, {"error": "body is not valid JSON"})
+            return
+        try:
+            self._respond(*_handle_generate_request(self.state, body))
+        except Exception as exc:  # noqa: BLE001 — server must not die
+            self.state.stats.record_error()
+            self._respond(500, {"error": f"generation failed: {exc}"})
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        from ..utils.logging import get_logger
+
+        get_logger().info("serve: %s", fmt % args)
+
+
+def make_server(
+    state: ServerState, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral; read ``server_address[1]``), don't serve."""
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+__all__ = ["ServerState", "ServerStats", "make_server"]

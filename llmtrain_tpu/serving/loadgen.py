@@ -1,0 +1,188 @@
+"""Open-loop load generator + SLO aggregation for the serving stack.
+
+Throughput claims must be measured, not asserted (ROADMAP item 1): this
+module drives the continuous-batching scheduler with a SEEDED open-loop
+arrival process — requests arrive on a Poisson clock that does NOT wait
+for completions, the arrival model under which tail latency means
+anything (a closed loop self-throttles and hides queueing collapse) —
+and aggregates the scheduler's server-side measurements into the SLO
+numbers operators page on:
+
+* **TTFT** (submit → first token) p50/p95/p99,
+* **per-token latency** (inter-token gaps) p50/p95/p99,
+* tokens/s and requests/s over the run,
+* batch-occupancy and KV-pool peaks, and the compile-budget accounting.
+
+The result feeds three sinks: the ``serving`` block in
+``report.json``/``report.md`` (telemetry/report.py), ``llmtrain_serve_*``
+Prometheus gauges via the MetricsRegistry, and the ``serve-bench`` CLI's
+stdout summary. Everything is deterministic per (seed, rate, request
+count) except wall-clock timing itself.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from .scheduler import ContinuousBatchingScheduler, ServeRequest
+
+
+def percentiles(samples: list[float]) -> dict[str, float | None]:
+    """p50/p95/p99/mean/max by nearest-rank on the sorted samples."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None, "mean": None, "max": None}
+    s = sorted(samples)
+
+    def rank(p: float) -> float:
+        return s[min(len(s) - 1, max(0, int(np.ceil(p * len(s))) - 1))]
+
+    return {
+        "p50": round(rank(0.50), 3),
+        "p95": round(rank(0.95), 3),
+        "p99": round(rank(0.99), 3),
+        "mean": round(float(np.mean(s)), 3),
+        "max": round(s[-1], 3),
+    }
+
+
+def build_requests(
+    *,
+    num_requests: int,
+    seed: int,
+    vocab_size: int,
+    prompt_tokens_min: int,
+    prompt_tokens_max: int,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_token_id: int | None = None,
+) -> list[ServeRequest]:
+    """Seeded request population: prompt lengths/ids and per-request rng
+    seeds all derive from one numpy Generator, so a run is replayable —
+    the property the bitwise parity check against ``generate()`` needs."""
+    rng = np.random.default_rng(seed)
+    reqs: list[ServeRequest] = []
+    for i in range(num_requests):
+        tp = int(rng.integers(prompt_tokens_min, prompt_tokens_max + 1))
+        prompt = rng.integers(0, vocab_size, size=tp, dtype=np.int64).astype(
+            np.int32
+        )
+        reqs.append(
+            ServeRequest(
+                prompt_ids=prompt,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                seed=int(rng.integers(0, 2**31 - 1)),
+                eos_token_id=eos_token_id,
+            )
+        )
+    return reqs
+
+
+def run_loadgen(
+    scheduler: ContinuousBatchingScheduler,
+    requests: list[ServeRequest],
+    *,
+    rate_rps: float,
+    seed: int,
+    timeout_sec: float = 300.0,
+) -> dict[str, Any]:
+    """Submit ``requests`` on a seeded open-loop Poisson clock and block
+    until every one completes (or ``timeout_sec`` lapses); returns the
+    ``serving`` report block. The scheduler must already be running
+    (``scheduler.start()``)."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    arrival_rng = np.random.default_rng(seed ^ 0x5EED)
+    offsets = np.cumsum(arrival_rng.exponential(1.0 / rate_rps, len(requests)))
+
+    t0 = time.monotonic()
+    for req, offset in zip(requests, offsets):
+        delay = (t0 + offset) - time.monotonic()
+        if delay > 0:
+            # Open loop: the sleep tracks the ARRIVAL clock, never the
+            # completion of earlier requests.
+            time.sleep(delay)
+        scheduler.submit(req)
+
+    deadline = time.monotonic() + timeout_sec
+    for req in requests:
+        if not req.done.wait(timeout=max(0.0, deadline - time.monotonic())):
+            req.abandon()  # shed: don't keep decoding for a lapsed run
+    wall_sec = time.monotonic() - t0
+
+    # Classify from FINAL state, after the scheduler has either retired
+    # or shed every abandoned request — a request finishing in the window
+    # between its lapsed wait() and the next shed check is a completion,
+    # not a timeout (it must not be double-counted as both and fail the
+    # bench run).
+    for req in requests:
+        req.done.wait(timeout=30.0)
+    completed = [r for r in requests if r.finish_reason in ("eos", "length")]
+    failed = [r for r in requests if r.finish_reason == "error"]
+    incomplete = len(requests) - len(completed) - len(failed)
+    ttft = [r.ttft_ms for r in completed if r.ttft_ms is not None]
+    per_token: list[float] = []
+    for r in completed:
+        for a, b in zip(r.token_times, r.token_times[1:]):
+            per_token.append((b - a) * 1e3)
+    new_tokens = sum(len(r.tokens) for r in completed)
+
+    stats = scheduler.stats()
+    block: dict[str, Any] = {
+        "arrival": {
+            "process": "poisson-open-loop",
+            "rate_rps": rate_rps,
+            "seed": seed,
+        },
+        "requests": {
+            "submitted": len(requests),
+            "completed": len(completed),
+            "failed": len(failed),
+            "timed_out": incomplete,
+        },
+        "slo": {
+            "ttft_ms": percentiles(ttft),
+            "per_token_ms": percentiles(per_token),
+        },
+        "throughput": {
+            "wall_sec": round(wall_sec, 3),
+            "new_tokens": new_tokens,
+            "tokens_per_sec": round(new_tokens / wall_sec, 3) if wall_sec else None,
+            "requests_per_sec": (
+                round(len(completed) / wall_sec, 3) if wall_sec else None
+            ),
+        },
+        "occupancy": {
+            "peak": stats["peak_batch_occupancy"],
+            "mean": stats["mean_batch_occupancy"],
+            "max_batch_slots": stats["max_batch_slots"],
+        },
+        "policy": stats["policy"],
+    }
+    if "kv_pool" in stats:
+        block["kv_pool"] = stats["kv_pool"]
+    if "compile" in stats:
+        block["compile"] = stats["compile"]
+
+    registry = scheduler.registry
+    if registry is not None:
+        for name, stat in (("ttft_ms", ttft), ("per_token_ms", per_token)):
+            pct = percentiles(stat)
+            for q in ("p50", "p95", "p99"):
+                if pct[q] is not None:
+                    registry.publish({f"serve/{name}_{q}": pct[q]})
+        if block["throughput"]["tokens_per_sec"] is not None:
+            registry.publish(
+                {"serve/tokens_per_sec": block["throughput"]["tokens_per_sec"]}
+            )
+    return block
+
+
+__all__ = ["build_requests", "percentiles", "run_loadgen"]

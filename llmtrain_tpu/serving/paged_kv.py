@@ -1,0 +1,162 @@
+"""Paged KV-cache pool: host-side free-list allocator + block tables.
+
+The device-side cache is a pool of ``num_blocks`` fixed-size blocks of
+``block_tokens`` positions each, owned per layer by the paged decode
+model (models/gpt.py ``_paged_decode_attention``). THIS module owns the
+host-side accounting that makes the pool safe to share between N
+in-flight sequences (the vLLM PagedAttention layout, PAPERS.md MinT —
+multiplexing many requests onto one accelerator is where serving
+throughput/$ is decided):
+
+* a **free list** of physical block ids (block 0 is the reserved null
+  block — padded block-table entries point at it and its contents are
+  garbage by construction, never read by a live query);
+* **admission-time budget reservation**: a sequence reserves its
+  worst-case block count (``ceil((prompt+max_new)/block_tokens)``) before
+  joining the batch, so mid-flight allocation can never fail — the
+  continuous scheduler admits only what the pool can finish;
+* **lazy physical allocation**: reserved blocks are bound to physical ids
+  only when the sequence actually reaches them, so pool occupancy tracks
+  REAL cache bytes, not worst cases (the utilization gauge the serving
+  telemetry exports).
+
+Pure host-side Python (no jax): allocation is scheduler-thread-only and
+lock-free here — the scheduler serializes all calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+NULL_BLOCK = 0
+
+
+@dataclass
+class BlockTable:
+    """One sequence's logical→physical block mapping."""
+
+    reserved: int  # admission-time budget (blocks), upper bound
+    block_tokens: int
+    blocks: list[int] = field(default_factory=list)  # physical ids, in order
+
+    @property
+    def allocated(self) -> int:
+        return len(self.blocks)
+
+    def padded(self, max_blocks: int) -> list[int]:
+        """Physical ids padded with the null block to ``max_blocks``
+        (the static shape the jitted decode step consumes)."""
+        if len(self.blocks) > max_blocks:
+            raise ValueError(
+                f"table holds {len(self.blocks)} blocks > max_blocks "
+                f"({max_blocks})"
+            )
+        return self.blocks + [NULL_BLOCK] * (max_blocks - len(self.blocks))
+
+
+class PagedKVPool:
+    """Free-list allocator over the physical block pool.
+
+    Invariant: ``available`` (unreserved budget) never exceeds the free
+    list, so a reserved sequence's :meth:`grow` cannot fail — admission
+    control (:meth:`try_reserve`) is the only place that says no.
+    """
+
+    def __init__(self, num_blocks: int, block_tokens: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the null block), "
+                f"got {num_blocks}"
+            )
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+        # LIFO free list, block 0 excluded (null block).
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._available = num_blocks - 1  # capacity minus live reservations
+        self._tables: set[int] = set()  # live table object ids (double-free guard)
+        self.peak_allocated = 0
+        self.peak_reserved = 0
+
+    # ------------------------------------------------------------- sizing
+
+    def blocks_needed(self, total_tokens: int) -> int:
+        """Worst-case blocks for a sequence of ``total_tokens`` positions."""
+        return max(1, -(-int(total_tokens) // self.block_tokens))
+
+    # --------------------------------------------------------- allocation
+
+    @property
+    def available_blocks(self) -> int:
+        """Unreserved budget — what admission control may still promise."""
+        return self._available
+
+    @property
+    def allocated_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def try_reserve(self, total_tokens: int) -> BlockTable | None:
+        """Admit a sequence of ``total_tokens`` worst-case positions.
+
+        Returns its table (budget reserved, nothing bound yet) or None
+        when the pool cannot guarantee completion — the scheduler then
+        leaves the request queued instead of admitting work it would
+        have to evict mid-flight.
+        """
+        need = self.blocks_needed(total_tokens)
+        if need > self._available:
+            return None
+        self._available -= need
+        table = BlockTable(reserved=need, block_tokens=self.block_tokens)
+        self._tables.add(id(table))
+        self.peak_reserved = max(
+            self.peak_reserved, (self.num_blocks - 1) - self._available
+        )
+        return table
+
+    def grow(self, table: BlockTable, upto_tokens: int) -> None:
+        """Bind physical blocks so positions < ``upto_tokens`` are backed.
+
+        Cannot fail within the reservation (the invariant admission
+        bought); exceeding it is a scheduler bug and raises.
+        """
+        if id(table) not in self._tables:
+            raise ValueError("grow() on a released or foreign block table")
+        need = self.blocks_needed(upto_tokens)
+        if need > table.reserved:
+            raise ValueError(
+                f"sequence needs {need} blocks > its reservation "
+                f"({table.reserved}) — admission sizing bug"
+            )
+        while table.allocated < need:
+            table.blocks.append(self._free.pop())
+        self.peak_allocated = max(self.peak_allocated, self.allocated_blocks)
+
+    def release(self, table: BlockTable) -> None:
+        """Retire a sequence: free its blocks and its unused budget."""
+        if id(table) not in self._tables:
+            raise ValueError("release() on a released or foreign block table")
+        self._tables.remove(id(table))
+        self._free.extend(reversed(table.blocks))
+        self._available += table.reserved
+        table.blocks = []
+        table.reserved = 0
+
+    # ------------------------------------------------------------ telemetry
+
+    def stats(self) -> dict[str, float]:
+        capacity = self.num_blocks - 1
+        return {
+            "capacity_blocks": capacity,
+            "block_tokens": self.block_tokens,
+            "allocated_blocks": self.allocated_blocks,
+            "reserved_blocks": capacity - self._available,
+            "utilization": round(self.allocated_blocks / capacity, 4),
+            "peak_allocated_blocks": self.peak_allocated,
+            "peak_reserved_blocks": self.peak_reserved,
+            "active_sequences": len(self._tables),
+        }
+
+
+__all__ = ["NULL_BLOCK", "BlockTable", "PagedKVPool"]

@@ -1,0 +1,495 @@
+"""Continuous (in-flight) batching scheduler over the paged decode engine.
+
+The control layer of the serving subsystem: requests enter an admission
+queue from any thread (HTTP handlers, the load generator); a single
+scheduler thread runs :meth:`ContinuousBatchingScheduler.step` in a loop —
+each step **joins** queued arrivals whose worst-case KV blocks the pool
+can guarantee (prefill, first token), advances every in-flight sequence
+one token, and **retires** finishers (EOS / max tokens) without draining
+the batch. That per-step join/evict is what turns one accelerator into a
+multi-tenant device (MinT, PAPERS.md): a long generation no longer
+blocks a short one behind it, and batch occupancy — not queue discipline
+— sets throughput.
+
+Policies:
+
+* ``paged`` (default) — the continuous-batching path above.
+* ``speculative`` — draft-and-verify decode (speculative.py) as a
+  first-class scheduler policy: requests flow through the SAME queue,
+  metrics, and SLO accounting, but each is served by
+  ``speculative_generate`` (batch-1 by that algorithm's contract, so
+  occupancy stays 1 — the latency-optimal regime, while ``paged`` is the
+  throughput-optimal one).
+
+SLO accounting is server-side and per-request: submit→first-token (TTFT)
+and inter-token gaps, the numbers the load harness (loadgen.py)
+aggregates into p50/p95/p99. Metrics publish into the PR-4
+MetricsRegistry under ``serve/*`` (→ ``llmtrain_serve_*`` in Prometheus).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..utils.logging import get_logger
+from .engine import PagedDecodeEngine
+
+logger = get_logger()
+
+_REQ_IDS = itertools.count()
+
+
+@dataclass
+class ServeRequest:
+    """One generation request + its server-side measurements."""
+
+    prompt_ids: np.ndarray  # (Tp,) int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int = 0
+    eos_token_id: int | None = None
+    request_id: int = field(default_factory=lambda: next(_REQ_IDS))
+    # Measurements (scheduler-thread writes, reader waits on `done`).
+    submitted_t: float = 0.0
+    first_token_t: float | None = None
+    finished_t: float | None = None
+    token_times: list[float] = field(default_factory=list)
+    tokens: list[int] = field(default_factory=list)
+    finish_reason: str | None = None
+    error: str | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    # Set by a waiter that gave up (HTTP timeout, loadgen deadline): the
+    # scheduler sheds the request — queued or in flight — instead of
+    # spending device time decoding for a departed client.
+    abandoned: threading.Event = field(default_factory=threading.Event)
+
+    def abandon(self) -> None:
+        self.abandoned.set()
+
+    @property
+    def ttft_ms(self) -> float | None:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.submitted_t) * 1e3
+
+    @property
+    def latency_ms(self) -> float | None:
+        if self.finished_t is None:
+            return None
+        return (self.finished_t - self.submitted_t) * 1e3
+
+
+@dataclass
+class _Row:
+    """One in-flight sequence's scheduler-side state."""
+
+    req: ServeRequest
+    table: Any  # BlockTable
+    prompt_len: int
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + per-step join/evict over a PagedDecodeEngine."""
+
+    def __init__(
+        self,
+        engine: PagedDecodeEngine | None,
+        *,
+        max_batch_slots: int | None = None,
+        registry: Any | None = None,  # telemetry MetricsRegistry
+        policy: str = "paged",
+        model: Any | None = None,
+        params: Any | None = None,
+        draft_model: Any | None = None,
+        draft_params: Any | None = None,
+        gamma: int = 4,
+    ) -> None:
+        if policy not in ("paged", "speculative"):
+            raise ValueError(
+                f"serving policy {policy!r} unknown; expected 'paged' or "
+                "'speculative'"
+            )
+        if policy == "paged" and engine is None:
+            raise ValueError("policy='paged' requires a PagedDecodeEngine")
+        if policy == "speculative" and (
+            draft_model is None or draft_params is None
+            or model is None or params is None
+        ):
+            raise ValueError(
+                "policy='speculative' requires model/params AND "
+                "draft_model/draft_params"
+            )
+        self.engine = engine
+        self.policy = policy
+        self.registry = registry
+        self.max_batch_slots = int(
+            max_batch_slots
+            or (engine.max_batch_slots if engine is not None else 1)
+        )
+        self._model, self._params = model, params
+        self._draft_model, self._draft_params = draft_model, draft_params
+        self._gamma = int(gamma)
+
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: deque[ServeRequest] = deque()
+        self._active: list[_Row] = []
+        self._closed = False
+        self._thread: threading.Thread | None = None
+
+        # Aggregate accounting (scheduler thread only).
+        self.requests_finished = 0
+        self.tokens_generated = 0
+        self.prefill_tokens = 0
+        self.peak_occupancy = 0
+        self._occupancy_samples = 0
+        self._occupancy_total = 0
+
+    # ----------------------------------------------------------- frontend
+
+    def submit(self, req: ServeRequest) -> ServeRequest:
+        """Thread-safe enqueue; returns immediately (wait on ``req.done``)."""
+        req.submitted_t = time.monotonic()
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._queue.append(req)
+            self._wake.notify()
+        return req
+
+    # ------------------------------------------------------------- backend
+
+    def step(self) -> bool:
+        """One scheduler iteration: join, advance, evict. Returns whether
+        any work happened (False = idle)."""
+        if self.policy == "speculative":
+            return self._step_speculative()
+        return self._step_paged()
+
+    def _step_paged(self) -> bool:
+        engine = self.engine
+        assert engine is not None
+        epoch = engine.cache_epoch
+        # ---- join: admit while a slot AND a worst-case block budget exist.
+        # Head-of-line order — admission is FIFO so a huge request cannot
+        # be starved by a stream of small ones slipping past it.
+        admitted = 0
+        while len(self._active) < self.max_batch_slots:
+            with self._lock:
+                req = self._queue[0] if self._queue else None
+            if req is None:
+                break
+            if req.abandoned.is_set():
+                with self._lock:
+                    self._queue.popleft()
+                self._retire_abandoned(req)
+                continue
+            # The HTTP layer pre-validates, but the scheduler must survive
+            # direct submitters too: a request this engine can NEVER serve
+            # (context bound, prompt bucket, worst-case need > whole pool)
+            # fails ALONE instead of wedging the FIFO head forever —
+            # try_reserve only distinguishes "not yet", not "never".
+            reason = engine.validate_request(
+                int(req.prompt_ids.shape[0]), int(req.max_new_tokens)
+            )
+            if reason is not None:
+                with self._lock:
+                    self._queue.popleft()
+                self._fail(req, ValueError(reason))
+                continue
+            total = int(req.prompt_ids.shape[0]) + int(req.max_new_tokens)
+            table = engine.pool.try_reserve(total)
+            if table is None:
+                break  # pool full: stays queued, retried next step
+            with self._lock:
+                self._queue.popleft()
+            tp = int(req.prompt_ids.shape[0])
+            engine.pool.grow(table, tp)
+            try:
+                tok = engine.prefill(
+                    req.prompt_ids,
+                    table.padded(engine.max_blocks_per_seq),
+                    seed=req.seed,
+                    temperature=req.temperature,
+                    top_k=req.top_k,
+                    top_p=req.top_p,
+                )
+            except Exception as exc:  # noqa: BLE001 — fail THIS request only
+                engine.pool.release(table)
+                self._fail(req, exc)
+                if engine.cache_epoch != epoch:
+                    # The failed call had already consumed the donated
+                    # cache: every in-flight sequence's KV went with it.
+                    self._fail_all_active(exc)
+                    epoch = engine.cache_epoch
+                continue
+            now = time.monotonic()
+            req.first_token_t = now
+            req.token_times.append(now)
+            req.tokens.append(tok)
+            self.prefill_tokens += tp
+            self.tokens_generated += 1
+            row = _Row(req=req, table=table, prompt_len=tp)
+            if self._is_finished(row):
+                self._retire(row)
+            else:
+                self._active.append(row)
+            admitted += 1
+
+        # ---- shed abandoned in-flight work (the waiter already got its
+        # timeout response) so the device never decodes for a gone client.
+        kept: list[_Row] = []
+        for r in self._active:
+            if r.req.abandoned.is_set():
+                engine.pool.release(r.table)
+                self._retire_abandoned(r.req)
+            else:
+                kept.append(r)
+        self._active = kept
+
+        # ---- advance every in-flight sequence one token.
+        stepped = False
+        if self._active:
+            occupancy = len(self._active)
+            self.peak_occupancy = max(self.peak_occupancy, occupancy)
+            self._occupancy_samples += 1
+            self._occupancy_total += occupancy
+            rows = []
+            for r in self._active:
+                # The fed token's absolute position; grow() binds its
+                # block within the admission-time reservation.
+                pos = r.prompt_len + len(r.req.tokens) - 1
+                engine.pool.grow(r.table, pos + 1)
+                rows.append(
+                    {
+                        "token": r.req.tokens[-1],
+                        "position": pos,
+                        "table": r.table.padded(engine.max_blocks_per_seq),
+                        "seed": r.req.seed,
+                        "emit_idx": len(r.req.tokens),
+                        "temperature": r.req.temperature,
+                        "top_k": 0 if r.req.top_k is None else r.req.top_k,
+                        "top_p": 0.0 if r.req.top_p is None else r.req.top_p,
+                    }
+                )
+            try:
+                toks = engine.decode(rows)
+            except Exception as exc:  # noqa: BLE001 — contain: a decode
+                # failure must not kill the scheduler thread (every later
+                # waiter would time out against a dead loop). The batch's
+                # step output is unusable either way, so each in-flight
+                # request fails loudly — and if the donated cache was
+                # consumed the engine has already rebuilt it zeroed.
+                self._fail_all_active(exc)
+                self._publish_metrics()
+                return True
+            now = time.monotonic()
+            survivors: list[_Row] = []
+            for r, tok in zip(self._active, toks):
+                r.req.tokens.append(int(tok))
+                r.req.token_times.append(now)
+                self.tokens_generated += 1
+                if self._is_finished(r):
+                    self._retire(r)
+                else:
+                    survivors.append(r)
+            self._active = survivors
+            stepped = True
+
+        self._publish_metrics()
+        return stepped or admitted > 0
+
+    def _step_speculative(self) -> bool:
+        from ..speculative import speculative_generate
+
+        with self._lock:
+            req = self._queue.popleft() if self._queue else None
+        if req is None:
+            self._publish_metrics()
+            return False
+        if req.abandoned.is_set():
+            self._retire_abandoned(req)
+            self._publish_metrics()
+            return True
+        self.peak_occupancy = max(self.peak_occupancy, 1)
+        self._occupancy_samples += 1
+        self._occupancy_total += 1
+        try:
+            out = speculative_generate(
+                self._model,
+                self._params,
+                self._draft_model,
+                self._draft_params,
+                req.prompt_ids[None, :],
+                max_new_tokens=req.max_new_tokens,
+                gamma=self._gamma,
+                temperature=req.temperature,
+                top_k=req.top_k,
+                top_p=req.top_p,
+                eos_token_id=req.eos_token_id,
+                rng=jax.random.key(req.seed),
+            )
+        except Exception as exc:  # noqa: BLE001 — fail THIS request only
+            self._fail(req, exc)
+            self._publish_metrics()
+            return True
+        now = time.monotonic()
+        completion = [int(t) for t in out[0, req.prompt_ids.shape[0] :]]
+        if req.eos_token_id is not None and req.eos_token_id in completion:
+            completion = completion[: completion.index(req.eos_token_id) + 1]
+            req.finish_reason = "eos"
+        else:
+            req.finish_reason = "length"
+        # The whole-loop jit emits every token in one dispatch: TTFT and
+        # completion coincide (documented in docs/serving.md).
+        req.first_token_t = now
+        req.token_times = [now] * len(completion)
+        req.tokens = completion
+        self.tokens_generated += len(completion)
+        self.prefill_tokens += int(req.prompt_ids.shape[0])
+        req.finished_t = now
+        self.requests_finished += 1
+        if self.registry is not None:
+            self.registry.inc("serve/requests")
+        req.done.set()
+        self._publish_metrics()
+        return True
+
+    # ------------------------------------------------------------ plumbing
+
+    def _is_finished(self, row: _Row) -> bool:
+        req = row.req
+        if req.eos_token_id is not None and req.tokens[-1] == req.eos_token_id:
+            req.finish_reason = "eos"
+            return True
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
+
+    def _retire(self, row: _Row) -> None:
+        assert self.engine is not None
+        self.engine.pool.release(row.table)
+        row.req.finished_t = time.monotonic()
+        self.requests_finished += 1
+        if self.registry is not None:
+            self.registry.inc("serve/requests")
+        row.req.done.set()
+
+    def _retire_abandoned(self, req: ServeRequest) -> None:
+        logger.warning(
+            "serve request %d abandoned by its waiter; shed", req.request_id
+        )
+        req.finish_reason = "abandoned"
+        req.finished_t = time.monotonic()
+        if self.registry is not None:
+            self.registry.inc("serve/requests_abandoned")
+        req.done.set()
+
+    def _fail_all_active(self, cause: Exception) -> None:
+        assert self.engine is not None
+        for r in self._active:
+            self.engine.pool.release(r.table)
+            self._fail(
+                r.req,
+                RuntimeError(
+                    f"in-flight KV lost to a failed engine step: {cause}"
+                ),
+            )
+        self._active = []
+
+    def _fail(self, req: ServeRequest, exc: Exception) -> None:
+        logger.warning("serve request %d failed: %s", req.request_id, exc)
+        req.error = str(exc)
+        req.finish_reason = "error"
+        req.finished_t = time.monotonic()
+        if self.registry is not None:
+            self.registry.inc("serve/request_errors")
+        req.done.set()
+
+    def _publish_metrics(self) -> None:
+        if self.registry is None:
+            return
+        with self._lock:
+            depth = len(self._queue)
+        metrics = {
+            "serve/queue_depth": float(depth),
+            "serve/batch_occupancy": float(len(self._active)),
+            "serve/peak_batch_occupancy": float(self.peak_occupancy),
+            "serve/tokens_generated": float(self.tokens_generated),
+        }
+        if self.engine is not None:
+            pool = self.engine.pool.stats()
+            metrics["serve/kv_pool_used_blocks"] = pool["allocated_blocks"]
+            metrics["serve/kv_pool_utilization"] = pool["utilization"]
+            metrics["serve/kv_pool_reserved_blocks"] = pool["reserved_blocks"]
+        self.registry.publish(metrics)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            depth = len(self._queue)
+        mean_occ = (
+            self._occupancy_total / self._occupancy_samples
+            if self._occupancy_samples
+            else 0.0
+        )
+        out: dict[str, Any] = {
+            "policy": self.policy,
+            "queue_depth": depth,
+            "active_sequences": len(self._active),
+            "max_batch_slots": self.max_batch_slots,
+            "requests_finished": self.requests_finished,
+            "tokens_generated": self.tokens_generated,
+            "prefill_tokens": self.prefill_tokens,
+            "peak_batch_occupancy": self.peak_occupancy,
+            "mean_batch_occupancy": round(mean_occ, 4),
+        }
+        if self.engine is not None:
+            out["kv_pool"] = self.engine.pool.stats()
+            out["compile"] = self.engine.compile_stats()
+        return out
+
+    def run_forever(self, poll_sec: float = 0.005) -> None:
+        """Scheduler loop body for the background thread."""
+        while True:
+            with self._wake:
+                if self._closed and not self._queue and not self._active:
+                    return
+                if not self._queue and not self._active and not self._closed:
+                    self._wake.wait(timeout=poll_sec * 20)
+            if self._closed and not self._queue and not self._active:
+                return
+            if not self.step():
+                time.sleep(poll_sec)
+
+    def start(self) -> "ContinuousBatchingScheduler":
+        self._thread = threading.Thread(
+            target=self.run_forever, name="serve-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain in-flight work, then stop the loop (bounded)."""
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.warning("serve scheduler did not drain in %.0fs", timeout)
+
+
+__all__ = ["ContinuousBatchingScheduler", "ServeRequest"]

@@ -54,6 +54,7 @@ def build_report(
     memory: Any | None,  # MemoryMonitor
     wall_time_sec: float,
     train_result: dict[str, Any] | None = None,
+    serving: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Aggregate the telemetry state into the report dict."""
     latest = registry.latest()
@@ -153,6 +154,11 @@ def build_report(
         "spans": span_block,
         "events": events,
     }
+    if serving is not None:
+        # SLO block from the serving load harness (serving/loadgen.py):
+        # TTFT/per-token percentiles, throughput, occupancy, KV-pool and
+        # compile accounting — docs/serving.md documents the schema.
+        report["serving"] = serving
     if train_result is not None:
         report["train_result"] = train_result
     return report
@@ -272,6 +278,55 @@ def render_markdown(report: dict[str, Any]) -> str:
             lines.append(f"- tracker errors (degraded to warnings): {events['tracker_errors']}")
         if events.get("timeline_events_dropped"):
             lines.append(f"- timeline events dropped (cap): {events['timeline_events_dropped']}")
+    serving = report.get("serving") or {}
+    if serving:
+        lines += ["", "## Serving", ""]
+        req = serving.get("requests") or {}
+        lines.append(
+            f"- requests: {_fmt(req.get('completed'))}/{_fmt(req.get('submitted'))}"
+            f" completed, {_fmt(req.get('failed'))} failed, "
+            f"{_fmt(req.get('timed_out'))} timed out"
+        )
+        slo = serving.get("slo") or {}
+        for key, label in (("ttft_ms", "TTFT"), ("per_token_ms", "per-token")):
+            pct = slo.get(key) or {}
+            lines.append(
+                f"- {label} p50/p95/p99: {_fmt(pct.get('p50'))} / "
+                f"{_fmt(pct.get('p95'))} / {_fmt(pct.get('p99'))} ms"
+            )
+        tpt = serving.get("throughput") or {}
+        lines.append(
+            f"- tokens/sec: {_fmt(tpt.get('tokens_per_sec'))} "
+            f"({_fmt(tpt.get('new_tokens'))} new tokens in "
+            f"{_fmt(tpt.get('wall_sec'))} s)"
+        )
+        occ = serving.get("occupancy") or {}
+        lines.append(
+            f"- batch occupancy: peak {_fmt(occ.get('peak'))}, mean "
+            f"{_fmt(occ.get('mean'))} of {_fmt(occ.get('max_batch_slots'))} slots"
+        )
+        kv = serving.get("kv_pool") or {}
+        if kv:
+            lines.append(
+                f"- KV pool: peak {_fmt(kv.get('peak_allocated_blocks'))} of "
+                f"{_fmt(kv.get('capacity_blocks'))} blocks "
+                f"({_fmt(kv.get('block_tokens'))} tokens each)"
+            )
+        comp = serving.get("compile") or {}
+        if comp:
+            lines.append(
+                f"- compiled programs: {_fmt(comp.get('prefill_programs'))} "
+                f"prefill + {_fmt(comp.get('decode_programs'))} decode "
+                f"(budget {_fmt(comp.get('budget'))}, within: "
+                f"{comp.get('within_budget')})"
+            )
+        par = serving.get("parity") or {}
+        if par:
+            lines.append(
+                f"- parity vs sequential generate(): "
+                f"{_fmt(par.get('checked', 0) - par.get('mismatched', 0))}/"
+                f"{_fmt(par.get('checked'))} bitwise-identical"
+            )
     result = report.get("train_result")
     if result:
         lines += ["", "## Result", ""]

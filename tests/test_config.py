@@ -240,3 +240,52 @@ class TestUnknownExtraWarnings:
         )
         assert proc.returncode == 0, proc.stderr
         assert "los_impl" in proc.stderr and "warning" in proc.stderr
+
+
+class TestServingConfig:
+    """serving: section (llmtrain_tpu/serving/, docs/serving.md)."""
+
+    def test_defaults(self):
+        cfg = RunConfig.model_validate(MINIMAL)
+        assert cfg.serving.mode == "simple"  # opt-in: serve keeps its old path
+        assert cfg.serving.policy == "paged"
+        assert cfg.serving.max_batch_slots == 8
+        assert cfg.serving.block_tokens == 16
+        assert cfg.serving.num_blocks == 0  # derived from the slot count
+        assert cfg.serving.prompt_buckets == []
+        assert cfg.serving.batch_buckets == []
+        assert cfg.serving.max_new_tokens_cap == 256
+
+    def test_continuous_with_buckets(self):
+        cfg = RunConfig.model_validate(
+            {
+                **MINIMAL,
+                "serving": {
+                    "mode": "continuous",
+                    "max_batch_slots": 4,
+                    "prompt_buckets": [8, 16, 32],
+                    "batch_buckets": [2, 4],
+                },
+            }
+        )
+        assert cfg.serving.mode == "continuous"
+        assert cfg.serving.batch_buckets[-1] == cfg.serving.max_batch_slots
+
+    @pytest.mark.parametrize(
+        "serving",
+        [
+            {"mode": "warp"},
+            {"policy": "draft"},
+            {"max_batch_slots": 0},
+            {"block_tokens": 0},
+            {"num_blocks": 1},  # 0 (derived) or >= 2
+            {"prompt_buckets": [16, 8]},  # must be ascending
+            {"prompt_buckets": [0, 8]},  # entries >= 1
+            {"max_batch_slots": 4, "batch_buckets": [2, 8]},  # last != slots
+            {"request_timeout_sec": 0},
+            {"bogus": 1},
+        ],
+    )
+    def test_rejections(self, serving):
+        with pytest.raises(Exception):
+            RunConfig.model_validate({**MINIMAL, "serving": serving})

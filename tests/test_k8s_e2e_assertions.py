@@ -19,6 +19,7 @@ Two closures here:
 
 from __future__ import annotations
 
+import json
 import os
 import sqlite3
 import subprocess
@@ -221,7 +222,7 @@ def manifests():
         name: _load_all(name)
         for name in (
             "job.yaml", "job-tpu-v5e.yaml", "infra.yaml", "configmap.yaml",
-            "dashboard-admin.yaml", "kind-config.yaml",
+            "dashboard-admin.yaml", "kind-config.yaml", "serve.yaml",
         )
     }
 
@@ -274,10 +275,12 @@ class TestManifestStructure:
 
     def test_configmap_heartbeat_paths_match_the_probes(self, manifests):
         """watchdog.heartbeat_path in every embedded train.yaml must be the
-        container-local path the livenessProbe execs stat."""
+        container-local path the livenessProbe execs stat. (Scoped to the
+        TRAIN payloads: the serve.yaml payload feeds the Deployment, whose
+        liveness is a real HTTP /healthz probe, not the heartbeat file.)"""
         for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
             for key, raw in cm.get("data", {}).items():
-                if key.endswith(".yaml"):
+                if key.startswith("train") and key.endswith(".yaml"):
                     cfg = yaml.safe_load(raw)
                     wd = cfg["resilience"]["watchdog"]
                     assert wd["enabled"] is True
@@ -337,7 +340,7 @@ class TestManifestStructure:
                 if key.endswith(".yaml"):
                     RunConfig.model_validate(yaml.safe_load(raw))
                     payloads += 1
-        assert payloads >= 2  # kind CPU config + v5e TPU config
+        assert payloads >= 3  # kind CPU config + v5e TPU config + serve config
 
     def test_entrypoint_config_path_matches_configmap_key(self, manifests):
         """entrypoint.sh defaults to /config/train.yaml; the configmap must
@@ -376,11 +379,85 @@ class TestManifestStructure:
             )
         for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
             for key, raw in cm.get("data", {}).items():
-                if key.endswith(".yaml"):
+                if key.startswith("train") and key.endswith(".yaml"):
                     cfg = yaml.safe_load(raw)
                     tele = cfg["telemetry"]
                     assert tele["prometheus"] is True
                     assert tele["prometheus_port"] in ports
+
+
+class TestServeManifest:
+    """k8s/serve.yaml: the inference Deployment + Service contracts
+    (docs/serving.md "Kubernetes rollout")."""
+
+    def test_deployment_selector_and_service_agree(self, manifests):
+        (dep,) = _by_kind(manifests["serve.yaml"], "Deployment")
+        (svc,) = _by_kind(manifests["serve.yaml"], "Service")
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert dep["spec"]["selector"]["matchLabels"].items() <= labels.items()
+        assert svc["spec"]["selector"].items() <= labels.items()
+        (port,) = svc["spec"]["ports"]
+        (ctr,) = dep["spec"]["template"]["spec"]["containers"]
+        names = {p["name"] for p in ctr["ports"]}
+        assert port["targetPort"] in names
+
+    def test_healthz_probes_on_the_serve_port(self, manifests):
+        """Readiness gates traffic on /healthz (the server binds only
+        after checkpoint load + engine build); liveness restarts a wedged
+        process but must not probe-kill cold-cache compiles."""
+        (dep,) = _by_kind(manifests["serve.yaml"], "Deployment")
+        (ctr,) = dep["spec"]["template"]["spec"]["containers"]
+        for probe_name in ("readinessProbe", "livenessProbe"):
+            probe = ctr[probe_name]
+            assert probe["httpGet"]["path"] == "/healthz"
+        assert ctr["livenessProbe"]["initialDelaySeconds"] >= 60
+
+    def test_prometheus_annotations_point_at_the_serve_port(self, manifests):
+        """The inference server exposes llmtrain_serve_* on its OWN HTTP
+        port (serving/http.py /metrics) — the scrape annotation must
+        advertise that port, not the training telemetry port."""
+        (dep,) = _by_kind(manifests["serve.yaml"], "Deployment")
+        annotations = dep["spec"]["template"]["metadata"]["annotations"]
+        assert annotations["prometheus.io/scrape"] == "true"
+        assert annotations["prometheus.io/path"] == "/metrics"
+        (ctr,) = dep["spec"]["template"]["spec"]["containers"]
+        container_ports = {p["containerPort"] for p in ctr["ports"]}
+        assert int(annotations["prometheus.io/port"]) in container_ports
+        # The CLI is told to bind the same port.
+        assert str(annotations["prometheus.io/port"]) in ctr["command"]
+
+    def test_references_resolve_and_serve_config_is_continuous(self, manifests):
+        (dep,) = _by_kind(manifests["serve.yaml"], "Deployment")
+        pod = dep["spec"]["template"]["spec"]
+        sa_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["infra.yaml"], "ServiceAccount")}
+        assert pod["serviceAccountName"] in sa_names
+        pvc_names = {
+            d["metadata"]["name"]
+            for d in _by_kind(manifests["infra.yaml"], "PersistentVolumeClaim")
+        }
+        cm_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["configmap.yaml"], "ConfigMap")}
+        serve_cfgs = []
+        for vol in pod["volumes"]:
+            if "persistentVolumeClaim" in vol:
+                assert vol["persistentVolumeClaim"]["claimName"] in pvc_names
+            if "configMap" in vol:
+                assert vol["configMap"]["name"] in cm_names
+                for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+                    if cm["metadata"]["name"] == vol["configMap"]["name"]:
+                        assert "serve.yaml" in cm["data"]
+                        serve_cfgs.append(yaml.safe_load(cm["data"]["serve.yaml"]))
+        # The mounted config must select the continuous backend and match
+        # the training model shape (the checkpoint must load 1:1).
+        assert serve_cfgs, "Deployment mounts no configmap with serve.yaml"
+        for cfg, cm in zip(serve_cfgs, [c for c in _by_kind(
+                manifests["configmap.yaml"], "ConfigMap")
+                if "serve.yaml" in c.get("data", {})]):
+            assert cfg["serving"]["mode"] == "continuous"
+            train = yaml.safe_load(cm["data"]["train.yaml"])
+            for key in ("name", "d_model", "n_layers", "n_heads", "block_size"):
+                assert cfg["model"][key] == train["model"][key]
 
 
 class TestAssertTelemetryArtifacts:
@@ -419,3 +496,84 @@ class TestAssertPrometheusScrape:
         scrape.write_text("# just comments\nother_metric 1\n")
         r = _sh(f'assert_prometheus_scrape "{scrape}"')
         assert r.returncode != 0
+
+
+class TestAssertServingReport:
+    """assert_serving_report validates the load-harness SLO block
+    (k8s/test_e2e_local.sh serving phase, docs/serving.md)."""
+
+    @staticmethod
+    def _block(**overrides):
+        pct = {"p50": 1.0, "p95": 2.0, "p99": 3.0, "mean": 1.5, "max": 3.0}
+        block = {
+            "requests": {"submitted": 4, "completed": 4, "failed": 0,
+                         "timed_out": 0},
+            "slo": {"ttft_ms": dict(pct), "per_token_ms": dict(pct)},
+            "throughput": {"wall_sec": 1.0, "new_tokens": 16,
+                           "tokens_per_sec": 16.0},
+            "occupancy": {"peak": 3, "mean": 2.0, "max_batch_slots": 4},
+            "compile": {"within_budget": True, "budget": 5},
+        }
+        block.update(overrides)
+        return block
+
+    def _write(self, tmp_path, block):
+        report = tmp_path / "report.json"
+        report.write_text(json.dumps({"serving": block}))
+        return report
+
+    def test_passes_on_valid_block(self, tmp_path):
+        r = _sh(f'assert_serving_report "{self._write(tmp_path, self._block())}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "occupancy>=2" in r.stdout
+
+    def test_fails_on_missing_file(self, tmp_path):
+        r = _sh(f'assert_serving_report "{tmp_path}/report.json"')
+        assert r.returncode != 0
+        assert "no serving report" in r.stderr
+
+    def test_fails_when_never_batched(self, tmp_path):
+        block = self._block(occupancy={"peak": 1, "mean": 1.0,
+                                       "max_batch_slots": 4})
+        r = _sh(f'assert_serving_report "{self._write(tmp_path, block)}"')
+        assert r.returncode != 0
+
+    def test_fails_on_compile_budget_overrun(self, tmp_path):
+        block = self._block(compile={"within_budget": False, "budget": 5})
+        r = _sh(f'assert_serving_report "{self._write(tmp_path, block)}"')
+        assert r.returncode != 0
+
+    def test_fails_on_missing_percentile(self, tmp_path):
+        block = self._block()
+        block["slo"]["ttft_ms"]["p99"] = None
+        r = _sh(f'assert_serving_report "{self._write(tmp_path, block)}"')
+        assert r.returncode != 0
+
+
+class TestAssertServingScrape:
+    def test_passes_on_real_serving_metrics(self, tmp_path):
+        """Rendered through the REAL registry + renderer, not a synthetic
+        string — pins the llmtrain_serve_* naming end to end."""
+        from llmtrain_tpu.telemetry import render_prometheus
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+        registry = MetricsRegistry(None)
+        registry.publish({
+            "serve/queue_depth": 0.0,
+            "serve/batch_occupancy": 2.0,
+            "serve/kv_pool_utilization": 0.5,
+        })
+        registry.inc("serve/requests", 4)
+        scrape = tmp_path / "serve.prom"
+        scrape.write_text(
+            render_prometheus(registry.latest(), registry.counters(), {})
+        )
+        r = _sh(f'assert_serving_scrape "{scrape}"')
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_fails_on_training_only_scrape(self, tmp_path):
+        scrape = tmp_path / "serve.prom"
+        scrape.write_text("llmtrain_train_loss 1.0\n")
+        r = _sh(f'assert_serving_scrape "{scrape}"')
+        assert r.returncode != 0
+        assert "llmtrain_serve_requests_total missing" in r.stderr

@@ -1,8 +1,11 @@
-"""HTTP inference server (serving.py + the ``serve`` CLI subcommand).
+"""HTTP inference server (serving/ package + the ``serve`` CLI subcommand).
 
 Beyond-reference serving surface. Unit tests drive the request logic
-and a live in-process server over a tiny model; one CLI test boots the
-real subprocess on an ephemeral port and round-trips a request.
+and a live in-process server over a tiny model — in BOTH backends (the
+legacy one-decode-at-a-time lock and the continuous-batching
+scheduler); one CLI test boots the real subprocess on an ephemeral port
+and round-trips a request. The engine/scheduler internals live in
+tests/test_serving_engine.py.
 """
 
 from __future__ import annotations
@@ -19,10 +22,17 @@ import jax.numpy as jnp
 import pytest
 from flax.linen import meta as nn_meta
 
-from llmtrain_tpu.serving import ServerState, _handle_generate_request, make_server
+from llmtrain_tpu.serving import (
+    ContinuousBatchingScheduler,
+    PagedDecodeEngine,
+    ServerState,
+    ServerStats,
+    _handle_generate_request,
+    make_server,
+)
 
 
-def _tiny_state(**kw):
+def _tiny_model():
     from llmtrain_tpu.models.gpt import GPT
 
     model = GPT(
@@ -38,6 +48,11 @@ def _tiny_state(**kw):
     params = nn_meta.unbox(
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
     )
+    return model, params
+
+
+def _tiny_state(**kw):
+    model, params = _tiny_model()
     defaults = dict(
         model=model,
         params=params,
@@ -48,6 +63,75 @@ def _tiny_state(**kw):
         default_max_new_tokens=4,
     )
     return ServerState(**{**defaults, **kw})
+
+
+def _continuous_state(**kw):
+    """ServerState over a real continuous-batching scheduler (started).
+
+    Callers must close ``state.scheduler``."""
+    from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+    model, params = _tiny_model()
+    engine = PagedDecodeEngine(
+        model,
+        params,
+        block_tokens=4,
+        max_batch_slots=2,
+        prompt_buckets=[4, 8],
+        batch_buckets=[1, 2],
+    )
+    registry = MetricsRegistry(None)
+    scheduler = ContinuousBatchingScheduler(engine, registry=registry).start()
+    defaults = dict(
+        model=model,
+        params=params,
+        tokenizer=None,
+        step=7,
+        checkpoint="mem://tiny",
+        max_new_tokens_cap=8,
+        default_max_new_tokens=4,
+        scheduler=scheduler,
+        registry=registry,
+    )
+    return ServerState(**{**defaults, **kw})
+
+
+class TestServerStats:
+    def test_concurrent_record_hammer(self):
+        """The satellite regression: ``requests_served += 1`` from N
+        ThreadingHTTPServer handler threads was a read-modify-write race;
+        every mutation now lands under the lock, so the totals are exact."""
+        stats = ServerStats()
+        threads_n, per_thread = 8, 250
+        barrier = threading.Barrier(threads_n)
+
+        def hammer():
+            barrier.wait()  # maximize interleaving
+            for _ in range(per_thread):
+                stats.record(latency_ms=1.0, tokens=3)
+                stats.record_error()
+
+        threads = [threading.Thread(target=hammer) for _ in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        expected = threads_n * per_thread
+        assert stats.requests_served == expected
+        snap = stats.snapshot()
+        assert snap["requests_served"] == expected
+        assert snap["errors"] == expected
+        assert snap["tokens_out"] == 3 * expected
+        assert snap["mean_latency_ms"] == 1.0
+
+    def test_latency_reservoir_is_bounded(self):
+        stats = ServerStats()
+        for i in range(ServerStats._RESERVOIR + 100):
+            stats.record(latency_ms=float(i), tokens=1)
+        snap = stats.snapshot()
+        assert snap["requests_served"] == ServerStats._RESERVOIR + 100
+        assert len(stats._latencies_ms) == ServerStats._RESERVOIR
+        assert snap["p50_latency_ms"] is not None
 
 
 class TestRequestLogic:
@@ -112,6 +196,99 @@ class TestRequestLogic:
         assert code == 200
         assert out2["completion_ids"][-1] == eos
         assert len(out2["completion_ids"]) <= 6
+
+
+class TestContinuousBackend:
+    """The scheduler-backed request path (serving.mode: continuous)."""
+
+    @pytest.fixture()
+    def cstate(self):
+        state = _continuous_state()
+        yield state
+        state.scheduler.close()
+
+    def test_greedy_matches_legacy_lock_path(self, cstate):
+        """Same weights, same request: the continuous backend emits the
+        same tokens the legacy one-decode-at-a-time path does, plus the
+        serving extras (ttft_ms, finish_reason)."""
+        body = {"prompt_ids": [1, 2, 3], "max_new_tokens": 4, "temperature": 0.0}
+        code, out = _handle_generate_request(cstate, body)
+        assert code == 200
+        assert out["finish_reason"] == "length"
+        assert out["ttft_ms"] > 0
+        code2, out2 = _handle_generate_request(_tiny_state(), body)
+        assert code2 == 200
+        assert out["completion_ids"] == out2["completion_ids"]
+        assert cstate.stats.requests_served == 1
+
+    def test_request_error_is_500_not_a_dead_scheduler(self, cstate):
+        """A request the scheduler fails (oversized for the engine,
+        submitted past HTTP validation) answers 500; the NEXT request
+        still succeeds — errors are per-request."""
+        cstate.max_new_tokens_cap = 64  # let the bad request through
+        code, out = _handle_generate_request(
+            cstate,
+            {"prompt_ids": [1, 2], "max_new_tokens": 14, "temperature": 0.0},
+        )
+        assert code == 200  # 2 + 14 fits block_size 16: sanity
+        code, out = _handle_generate_request(
+            cstate,
+            {"prompt_ids": list(range(1, 10)), "max_new_tokens": 10,
+             "temperature": 0.0},
+        )
+        assert code == 400  # http bound still applies
+        # Paged-backend bound: a prompt past the largest prompt bucket is
+        # a 400 at the boundary, not a late 500 from inside prefill.
+        code, out = _handle_generate_request(
+            cstate,
+            {"prompt_ids": list(range(1, 11)), "max_new_tokens": 2,
+             "temperature": 0.0},
+        )
+        assert code == 400
+        assert "prompt bucket" in out["error"]
+        # Bypass HTTP validation: submit an oversized ServeRequest directly.
+        import numpy as np
+
+        from llmtrain_tpu.serving import ServeRequest
+
+        bad = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3], np.int32), max_new_tokens=20
+        )
+        cstate.scheduler.submit(bad)
+        assert bad.done.wait(timeout=60)
+        assert bad.finish_reason == "error"
+        code, out = _handle_generate_request(
+            cstate, {"prompt_ids": [5], "max_new_tokens": 2, "temperature": 0.0}
+        )
+        assert code == 200  # scheduler survived
+
+    def test_healthz_and_metrics_surfaces(self, cstate):
+        """/healthz carries scheduler/KV-pool/compile stats; /metrics
+        exposes llmtrain_serve_* in Prometheus text format."""
+        from llmtrain_tpu.serving.http import _handle_health, _handle_metrics
+
+        _handle_generate_request(
+            cstate, {"prompt_ids": [1, 2], "max_new_tokens": 3,
+                     "temperature": 0.0}
+        )
+        code, payload = _handle_health(cstate)
+        assert code == 200
+        sched = payload["scheduler"]
+        assert sched["policy"] == "paged"
+        assert sched["requests_finished"] == 1
+        assert sched["kv_pool"]["active_sequences"] == 0
+        assert sched["compile"]["within_budget"]
+        code, text = _handle_metrics(cstate)
+        assert code == 200
+        assert "llmtrain_serve_requests_total 1" in text
+        assert "llmtrain_serve_queue_depth" in text
+        assert "llmtrain_serve_kv_pool_utilization" in text
+
+    def test_metrics_404_without_registry(self):
+        from llmtrain_tpu.serving.http import _handle_metrics
+
+        code, _ = _handle_metrics(_tiny_state())
+        assert code == 404
 
 
 class TestLiveServer:
@@ -185,6 +362,160 @@ class TestLiveServer:
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(server + "/nope", timeout=30)
         assert err.value.code == 404
+
+
+class TestServeBenchCLI:
+    def test_nonpositive_max_new_tokens_is_a_config_error(self, tmp_path):
+        """--max-new-tokens 0 used to sail past validation, emit one
+        unavoidable prefill token per request, and then fail --verify-parity
+        against generate()'s empty continuation — a misleading train-failure
+        exit. It must be rejected up front as a config error."""
+        import yaml
+
+        from llmtrain_tpu.cli import main
+        from llmtrain_tpu.resilience.exit_codes import EXIT_CONFIG_ERROR
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "run": {"name": "mnt0", "seed": 0, "device": "cpu"},
+                    "model": {"name": "dummy_gpt"},
+                    "data": {"name": "dummy_text"},
+                    "trainer": {"max_steps": 1},
+                    "mlflow": {"enabled": False},
+                    "output": {"root_dir": str(tmp_path / "runs")},
+                }
+            )
+        )
+        rc = main(
+            ["serve-bench", "--config", str(cfg_path), "--from", "nope",
+             "--max-new-tokens", "0"]
+        )
+        assert rc == EXIT_CONFIG_ERROR
+
+    @pytest.mark.slow
+    def test_serve_bench_and_continuous_serve_subprocess(self, tmp_path):
+        """Real CLI, one tiny checkpoint, both serving entrypoints:
+
+        1. ``serve-bench --verify-parity`` — seeded open-loop load run;
+           report.json gains the serving block with p50/p95/p99, >= 2
+           sequences were concurrently in flight, the compile count is
+           within the bucket budget, and batched output matched
+           sequential generate() bitwise (the flag exits nonzero else).
+        2. ``serve --mode continuous`` — live HTTP server; concurrent
+           posts succeed and /metrics exposes llmtrain_serve_*.
+        """
+        import yaml
+
+        cfg = {
+            "run": {"name": "sbench", "seed": 0, "device": "cpu"},
+            "model": {
+                "name": "gpt",
+                "block_size": 32,
+                "d_model": 32,
+                "n_layers": 1,
+                "n_heads": 2,
+                "d_ff": 64,
+                "dropout": 0.0,
+                "vocab_size": 64,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": 4,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "warmup_steps": 0,
+                "log_every_steps": 2,
+                "eval_every_steps": 4,
+                "save_every_steps": 4,
+            },
+            "serving": {
+                "mode": "continuous",
+                "max_batch_slots": 4,
+                "block_tokens": 8,
+                "prompt_buckets": [8, 16],
+                "batch_buckets": [2, 4],
+            },
+            "mlflow": {"enabled": False},
+            "output": {"root_dir": str(tmp_path / "runs")},
+        }
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg, sort_keys=False))
+        train = subprocess.run(
+            [sys.executable, "-m", "llmtrain_tpu", "train", "--config",
+             str(cfg_path), "--run-id", "sbench"],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert train.returncode == 0, train.stderr
+
+        out_dir = tmp_path / "bench_report"
+        bench = subprocess.run(
+            [sys.executable, "-m", "llmtrain_tpu", "serve-bench",
+             "--config", str(cfg_path), "--from", "sbench",
+             "--requests", "6", "--rate-rps", "64", "--max-new-tokens", "6",
+             "--prompt-tokens-max", "12", "--verify-parity",
+             "--out", str(out_dir)],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert bench.returncode == 0, bench.stderr
+        report = json.loads((out_dir / "report.json").read_text())
+        serving = report["serving"]
+        assert serving["requests"]["completed"] == 6
+        assert serving["occupancy"]["peak"] >= 2
+        for q in ("p50", "p95", "p99"):
+            assert serving["slo"]["ttft_ms"][q] is not None
+            assert serving["slo"]["per_token_ms"][q] is not None
+        assert serving["compile"]["within_budget"] is True
+        assert serving["parity"]["bitwise_identical"] is True
+        assert "## Serving" in (out_dir / "report.md").read_text()
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "llmtrain_tpu", "serve", "--config",
+             str(cfg_path), "--from", "sbench", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            lines: list[str] = []
+            reader = threading.Thread(
+                target=lambda: lines.append(proc.stdout.readline()), daemon=True
+            )
+            reader.start()
+            reader.join(timeout=300)
+            assert lines and lines[0], "serve never printed its ready line"
+            ready = json.loads(lines[0])
+            assert ready["mode"] == "continuous"  # from the config
+            assert ready["policy"] == "paged"
+            url = f"http://127.0.0.1:{ready['port']}"
+            results = []
+
+            def post():
+                req = urllib.request.Request(
+                    url + "/v1/generate",
+                    data=json.dumps(
+                        {"prompt_ids": [1, 2, 3], "max_new_tokens": 4,
+                         "temperature": 0.0}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    results.append(json.loads(resp.read()))
+
+            threads = [threading.Thread(target=post) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert len(results) == 2
+            assert results[0]["completion_ids"] == results[1]["completion_ids"]
+            assert all("ttft_ms" in r for r in results)
+            with urllib.request.urlopen(url + "/metrics", timeout=60) as resp:
+                metrics = resp.read().decode()
+            assert "llmtrain_serve_requests_total 2" in metrics
+            assert "llmtrain_serve_kv_pool_utilization" in metrics
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
 
 
 class TestServeCLI:

@@ -1,0 +1,602 @@
+"""Continuous-batching serving subsystem (llmtrain_tpu/serving/).
+
+The contracts docs/serving.md promises, pinned:
+
+* the paged KV pool's free-list/reservation invariants (admission is the
+  ONLY place allocation can fail);
+* batched paged decode emits token-ids **bitwise identical** to
+  sequential single-request ``generate()`` for identical seeds/sampling
+  params — greedy AND sampled (per-request temperature/top-k/top-p);
+* the decode loop compiles once per shape bucket and the total program
+  count stays within the configured budget;
+* continuous batching holds >= 2 sequences in flight and retires
+  finishers without draining the batch;
+* the speculative scheduler policy is token-identical to ``generate()``
+  under greedy sampling;
+* the seeded open-loop load harness emits the p50/p95/p99 SLO block the
+  telemetry report consumes.
+
+Everything runs the tiny GPT (1-2 layers, 32-wide) so the tier-1 gate
+stays cheap; the longer soak is ``@pytest.mark.slow`` (make
+verify-serving runs it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.generation import generate
+from llmtrain_tpu.models.gpt import GPT
+from llmtrain_tpu.serving import (
+    ContinuousBatchingScheduler,
+    PagedDecodeEngine,
+    PagedKVPool,
+    ServeRequest,
+    bucket_for,
+    build_requests,
+    percentiles,
+    run_loadgen,
+)
+from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+VOCAB = 32
+BLOCK = 32
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # 1 layer: the pool/engine/scheduler logic is layer-count-uniform
+    # (per-layer cache vars are created by the same code path), and the
+    # tier-1 gate runs this file serially against a tight time budget.
+    model = GPT(
+        vocab_size=VOCAB,
+        block_size=BLOCK,
+        d_model=32,
+        n_layers=1,
+        n_heads=2,
+        d_ff=64,
+        dropout=0.0,
+        tie_embeddings=True,
+    )
+    params = nn_meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    )
+    return model, params
+
+
+def _engine(model, params, **kw):
+    defaults = dict(
+        block_tokens=8,
+        max_batch_slots=4,
+        prompt_buckets=[8, 16, BLOCK],
+        batch_buckets=[2, 4],
+    )
+    return PagedDecodeEngine(model, params, **{**defaults, **kw})
+
+
+def _drain(scheduler, requests, max_steps=500):
+    """Run the scheduler loop inline (no thread) until every request is
+    done — deterministic, and failures surface as assertions rather than
+    a wedged background thread."""
+    steps = 0
+    while not all(r.done.is_set() for r in requests):
+        scheduler.step()
+        steps += 1
+        assert steps < max_steps, "scheduler failed to finish the batch"
+    return steps
+
+
+def _reference(model, params, req: ServeRequest) -> list[int]:
+    """What sequential single-request generate() emits for this request."""
+    out = generate(
+        model,
+        params,
+        req.prompt_ids[None, :],
+        max_new_tokens=req.max_new_tokens,
+        temperature=req.temperature,
+        top_k=req.top_k,
+        top_p=req.top_p,
+        eos_token_id=req.eos_token_id,
+        rng=jax.random.key(req.seed),
+    )
+    ref = [int(t) for t in np.asarray(out)[0, req.prompt_ids.shape[0]:]]
+    if req.eos_token_id is not None and req.eos_token_id in ref:
+        ref = ref[: ref.index(req.eos_token_id) + 1]
+    return ref
+
+
+class TestPagedKVPool:
+    def test_sizing_and_reservation_accounting(self):
+        pool = PagedKVPool(num_blocks=9, block_tokens=4)
+        assert pool.blocks_needed(1) == 1
+        assert pool.blocks_needed(4) == 1
+        assert pool.blocks_needed(5) == 2
+        assert pool.available_blocks == 8  # block 0 is the null block
+        t1 = pool.try_reserve(10)  # 3 blocks
+        assert t1 is not None and pool.available_blocks == 5
+        t2 = pool.try_reserve(20)  # 5 blocks
+        assert t2 is not None and pool.available_blocks == 0
+        assert pool.try_reserve(1) is None  # admission is the only "no"
+        pool.release(t1)
+        assert pool.available_blocks == 3
+        pool.release(t2)
+        assert pool.available_blocks == 8
+        assert pool.allocated_blocks == 0
+
+    def test_grow_is_lazy_and_bounded_by_reservation(self):
+        pool = PagedKVPool(num_blocks=9, block_tokens=4)
+        table = pool.try_reserve(12)  # 3 blocks reserved
+        assert table.allocated == 0  # nothing bound at admission
+        pool.grow(table, 4)
+        assert table.allocated == 1
+        pool.grow(table, 4)  # idempotent
+        assert table.allocated == 1
+        pool.grow(table, 12)
+        assert table.allocated == 3
+        with pytest.raises(ValueError, match="admission sizing bug"):
+            pool.grow(table, 13)  # beyond the reservation
+        assert 0 not in table.blocks  # the null block is never handed out
+
+    def test_release_guards_double_free(self):
+        pool = PagedKVPool(num_blocks=5, block_tokens=2)
+        table = pool.try_reserve(4)
+        pool.grow(table, 4)
+        pool.release(table)
+        with pytest.raises(ValueError, match="released or foreign"):
+            pool.release(table)
+        with pytest.raises(ValueError, match="released or foreign"):
+            pool.grow(table, 2)
+
+    def test_padded_table_and_stats(self):
+        pool = PagedKVPool(num_blocks=9, block_tokens=4)
+        table = pool.try_reserve(8)
+        pool.grow(table, 8)
+        padded = table.padded(4)
+        assert len(padded) == 4
+        assert padded[2:] == [0, 0]  # null-block padding
+        stats = pool.stats()
+        assert stats["allocated_blocks"] == 2
+        assert stats["reserved_blocks"] == 2
+        assert stats["active_sequences"] == 1
+        assert 0.0 < stats["utilization"] <= 1.0
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="num_blocks"):
+            PagedKVPool(num_blocks=1, block_tokens=4)
+        with pytest.raises(ValueError, match="block_tokens"):
+            PagedKVPool(num_blocks=4, block_tokens=0)
+
+
+class TestBuckets:
+    def test_bucket_for(self):
+        assert bucket_for(1, [2, 4, 8]) == 2
+        assert bucket_for(3, [2, 4, 8]) == 4
+        assert bucket_for(8, [2, 4, 8]) == 8
+        with pytest.raises(ValueError, match="exceeds"):
+            bucket_for(9, [2, 4, 8])
+
+    def test_engine_bucket_validation(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match="prompt bucket"):
+            _engine(model, params, prompt_buckets=[8, 2 * BLOCK])
+        with pytest.raises(ValueError, match="must equal"):
+            _engine(model, params, batch_buckets=[2, 3])
+
+
+class TestBatchedParity:
+    def test_greedy_bitwise_parity_mixed_lengths(self, tiny_model):
+        """The acceptance contract: >= 2 sequences concurrently in flight,
+        batched output token-ids bitwise identical to sequential
+        generate(), compile count within the bucket budget."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        scheduler = ContinuousBatchingScheduler(engine, registry=MetricsRegistry(None))
+        rng = np.random.default_rng(7)
+        requests = [
+            ServeRequest(
+                prompt_ids=rng.integers(0, VOCAB, size=tp).astype(np.int32),
+                max_new_tokens=mnt,
+                seed=int(rng.integers(0, 2**31 - 1)),
+            )
+            for tp, mnt in ((3, 6), (9, 4), (5, 8))
+        ]
+        for req in requests:
+            scheduler.submit(req)
+        _drain(scheduler, requests)
+
+        assert scheduler.peak_occupancy >= 2  # genuinely batched
+        for req in requests:
+            assert req.finish_reason == "length"
+            assert req.tokens == _reference(model, params, req)
+        # Finished sequences returned their blocks to the pool.
+        stats = engine.pool.stats()
+        assert stats["active_sequences"] == 0
+        assert stats["allocated_blocks"] == 0
+        assert engine.compile_stats()["within_budget"]
+
+    @pytest.mark.slow  # tier-1 pins greedy parity; `make verify-serving`
+    # (and the k8s e2e's serve-bench) still run this sampled variant.
+    def test_sampled_parity_per_request_knobs(self, tiny_model):
+        """Sampled rows replay generate()'s exact per-request recipe even
+        when temperature/top-k/top-p DIFFER across the in-flight batch."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        scheduler = ContinuousBatchingScheduler(engine)
+        requests = [
+            ServeRequest(
+                prompt_ids=np.asarray([1, 2, 3], np.int32),
+                max_new_tokens=5,
+                temperature=0.8,
+                top_k=5,
+                seed=11,
+            ),
+            ServeRequest(
+                prompt_ids=np.asarray([4, 5, 6, 7, 8], np.int32),
+                max_new_tokens=5,
+                temperature=1.3,
+                top_p=0.9,
+                seed=22,
+            ),
+            ServeRequest(
+                prompt_ids=np.asarray([9, 10], np.int32),
+                max_new_tokens=5,
+                temperature=0.0,  # greedy row in the same batch
+                seed=33,
+            ),
+        ]
+        for req in requests:
+            scheduler.submit(req)
+        _drain(scheduler, requests)
+        assert scheduler.peak_occupancy >= 2
+        for req in requests:
+            assert req.tokens == _reference(model, params, req), req.request_id
+
+    def test_eos_retires_without_draining_the_batch(self, tiny_model):
+        """A finisher leaves per-step while the other sequence keeps
+        decoding — continuous batching, not drain-and-refill."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        scheduler = ContinuousBatchingScheduler(engine)
+        short = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3], np.int32), max_new_tokens=2, seed=0
+        )
+        long = ServeRequest(
+            prompt_ids=np.asarray([4, 5, 6], np.int32), max_new_tokens=7, seed=0
+        )
+        scheduler.submit(short)
+        scheduler.submit(long)
+        steps = 0
+        while not short.done.is_set():
+            scheduler.step()
+            steps += 1
+            assert steps < 50
+        # The long request is still mid-flight after the short one retired.
+        assert not long.done.is_set()
+        assert len(scheduler._active) == 1
+        _drain(scheduler, [long])
+        assert short.tokens == _reference(model, params, short)
+        assert long.tokens == _reference(model, params, long)
+
+    def test_pool_exhaustion_queues_instead_of_evicting(self, tiny_model):
+        """Admission control: a request the pool cannot guarantee stays
+        queued (FIFO) and joins when a finisher frees its budget."""
+        model, params = tiny_model
+        # Pool sized for ONE worst-case sequence: 1 null + 2 blocks.
+        engine = _engine(
+            model, params, num_blocks=3, max_batch_slots=2, batch_buckets=[2]
+        )
+        scheduler = ContinuousBatchingScheduler(engine)
+        a = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3, 4], np.int32),
+            max_new_tokens=12,  # reserves ceil(16/8)=2 blocks — whole pool
+            seed=0,
+        )
+        b = ServeRequest(
+            prompt_ids=np.asarray([5, 6], np.int32), max_new_tokens=4, seed=0
+        )
+        scheduler.submit(a)
+        scheduler.submit(b)
+        scheduler.step()
+        assert len(scheduler._active) == 1  # b is queued, not admitted
+        assert scheduler.stats()["queue_depth"] == 1
+        _drain(scheduler, [a, b])
+        assert a.finish_reason == "length" and b.finish_reason == "length"
+        assert b.tokens == _reference(model, params, b)
+
+    def test_never_fitting_request_fails_instead_of_wedging_the_queue(
+        self, tiny_model
+    ):
+        """A request this engine can NEVER serve (oversized for the
+        context, the prompt buckets, or the whole pool) must fail alone —
+        try_reserve can only say 'not yet', so without the
+        validate_request guard it would sit at the FIFO head forever and
+        starve everything behind it."""
+        model, params = tiny_model
+        # Pool capacity: 2 blocks = 16 positions total.
+        engine = _engine(
+            model, params, num_blocks=3, max_batch_slots=2, batch_buckets=[2]
+        )
+        assert "block_size" in engine.validate_request(4, BLOCK)
+        # (the prompt-bucket reason is pinned at the HTTP boundary in
+        # tests/test_serving.py — a 400, not a late 500)
+        assert "pool" in engine.validate_request(4, 20)  # needs 3 > 2
+        assert engine.validate_request(4, 12) is None  # exactly fits
+        never = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3, 4], np.int32),
+            max_new_tokens=20,  # 24 <= block_size, but needs 3 pool blocks
+            seed=0,
+        )
+        behind = ServeRequest(
+            prompt_ids=np.asarray([5, 6], np.int32), max_new_tokens=3, seed=0
+        )
+        scheduler = ContinuousBatchingScheduler(engine)
+        scheduler.submit(never)
+        scheduler.submit(behind)
+        _drain(scheduler, [never, behind])
+        assert never.finish_reason == "error"
+        assert "pool" in never.error
+        assert behind.finish_reason == "length"  # not starved
+
+
+class TestFailureContainment:
+    def test_abandonment_shedding_and_donated_cache_recovery(self, tiny_model):
+        """One engine/scheduler, two containment contracts (a single test
+        so tier-1 pays the prefill/decode compiles once):
+
+        1. A waiter that gave up (HTTP 503 timeout, lapsed loadgen
+           deadline) must not keep consuming device time: an abandoned
+           queued request is skipped without prefill, an abandoned
+           in-flight one is evicted with its blocks released, and traffic
+           behind both is unaffected.
+        2. The prefill/decode jits donate the cache, so a call failing at
+           RUNTIME has already deleted it. The engine must rebuild a
+           zeroed cache (not leave every later request dying on 'Array
+           has been deleted'), the scheduler must fail the in-flight
+           sequences whose KV went with it — and must itself survive the
+           decode exception (it used to escape step() and kill the loop
+           thread)."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        scheduler = ContinuousBatchingScheduler(engine)
+
+        # --- 1: abandoned requests are shed, queued and in flight.
+        flying = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3], np.int32), max_new_tokens=8, seed=0
+        )
+        scheduler.submit(flying)
+        scheduler.step()  # admitted: prefill + one decode advance
+        assert not flying.done.is_set()
+        tokens_at_shed = len(flying.tokens)
+        assert tokens_at_shed >= 1
+        queued = ServeRequest(
+            prompt_ids=np.asarray([4, 5], np.int32), max_new_tokens=4, seed=0
+        )
+        survivor = ServeRequest(
+            prompt_ids=np.asarray([6, 7], np.int32), max_new_tokens=4, seed=0
+        )
+        flying.abandon()
+        queued.abandon()
+        scheduler.submit(queued)
+        scheduler.submit(survivor)
+        _drain(scheduler, [flying, queued, survivor])
+        assert flying.finish_reason == "abandoned"
+        assert queued.finish_reason == "abandoned"
+        assert queued.tokens == []  # never prefilled
+        assert len(flying.tokens) == tokens_at_shed  # never advanced again
+        assert survivor.tokens == _reference(model, params, survivor)
+        stats = engine.pool.stats()
+        assert stats["allocated_blocks"] == 0 and stats["active_sequences"] == 0
+
+        # --- 2: runtime failure consumes the donated cache; recover.
+        victim = ServeRequest(
+            prompt_ids=np.asarray([1, 2, 3], np.int32), max_new_tokens=6, seed=0
+        )
+        scheduler.submit(victim)
+        scheduler.step()
+        assert len(scheduler._active) == 1
+        real_decode = engine._decode_jit
+
+        def exploding_decode(params_, cache, *rest):
+            for leaf in jax.tree.leaves(cache):
+                leaf.delete()  # what donation does on a runtime failure
+            raise RuntimeError("injected device failure")
+
+        engine._decode_jit = exploding_decode
+        scheduler.step()  # must not raise
+        assert victim.done.is_set() and victim.finish_reason == "error"
+        assert "injected device failure" in victim.error
+        assert engine.cache_epoch == 1  # rebuilt, not left deleted
+        engine._decode_jit = real_decode
+        after = ServeRequest(
+            prompt_ids=np.asarray([4, 5, 6], np.int32), max_new_tokens=4, seed=1
+        )
+        scheduler.submit(after)
+        _drain(scheduler, [after])
+        assert after.tokens == _reference(model, params, after)
+        assert engine.pool.stats()["allocated_blocks"] == 0
+
+
+class TestCompileBudget:
+    def test_decode_compiles_once_per_bucket(self, tiny_model):
+        """Repeating a bucket shape must NOT grow the program count —
+        unbounded recompilation is how a JAX server falls over."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        scheduler = ContinuousBatchingScheduler(engine)
+
+        def burst(seed):
+            reqs = [
+                ServeRequest(
+                    prompt_ids=np.asarray([seed, 2, 3], np.int32),
+                    max_new_tokens=3,
+                    seed=seed,
+                ),
+                ServeRequest(
+                    prompt_ids=np.asarray([seed, 5], np.int32),
+                    max_new_tokens=3,
+                    seed=seed,
+                ),
+            ]
+            for r in reqs:
+                scheduler.submit(r)
+            _drain(scheduler, reqs)
+
+        burst(1)
+        first = engine.compile_stats()
+        burst(2)  # same shapes again
+        second = engine.compile_stats()
+        assert second["prefill_programs"] == first["prefill_programs"]
+        assert second["decode_programs"] == first["decode_programs"]
+        assert second["within_budget"]
+        assert (
+            second["prefill_programs"] + second["decode_programs"]
+            <= second["budget"]
+        )
+        # The used shapes are real buckets, not raw request shapes.
+        assert set(second["prefill_shapes_used"]) <= set(engine.prompt_buckets)
+        assert set(second["decode_shapes_used"]) <= set(engine.batch_buckets)
+
+
+class TestSpeculativePolicy:
+    def test_speculative_greedy_token_identical_to_generate(self, tiny_model):
+        """Speculative decoding as a scheduler policy: same queue, same
+        SLO accounting, token-identical output under greedy sampling."""
+        model, params = tiny_model
+        scheduler = ContinuousBatchingScheduler(
+            None,
+            policy="speculative",
+            model=model,
+            params=params,
+            draft_model=model,  # self-draft: always accepted, still exact
+            draft_params=params,
+            gamma=3,
+            registry=MetricsRegistry(None),
+        )
+        requests = [
+            ServeRequest(
+                prompt_ids=np.asarray([1, 2, 3], np.int32),
+                max_new_tokens=6,
+                seed=0,
+            ),
+            ServeRequest(
+                prompt_ids=np.asarray([7, 8], np.int32),
+                max_new_tokens=4,
+                seed=0,
+            ),
+        ]
+        for req in requests:
+            scheduler.submit(req)
+        _drain(scheduler, requests)
+        for req in requests:
+            assert req.finish_reason == "length"
+            assert req.tokens == _reference(model, params, req)
+        assert scheduler.stats()["policy"] == "speculative"
+        assert scheduler.peak_occupancy == 1  # batch-1 by contract
+
+    def test_policy_validation(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match="unknown"):
+            ContinuousBatchingScheduler(None, policy="warp")
+        with pytest.raises(ValueError, match="PagedDecodeEngine"):
+            ContinuousBatchingScheduler(None, policy="paged")
+        with pytest.raises(ValueError, match="draft_model"):
+            ContinuousBatchingScheduler(
+                None, policy="speculative", model=model, params=params
+            )
+
+
+class TestLoadgen:
+    def test_percentiles(self):
+        assert percentiles([])["p50"] is None
+        pct = percentiles([float(i) for i in range(1, 101)])
+        assert pct["p50"] == 50.0
+        assert pct["p95"] == 95.0
+        assert pct["p99"] == 99.0
+        assert pct["max"] == 100.0
+
+    def test_build_requests_is_seeded(self):
+        kw = dict(
+            num_requests=5,
+            seed=42,
+            vocab_size=VOCAB,
+            prompt_tokens_min=2,
+            prompt_tokens_max=10,
+            max_new_tokens=4,
+        )
+        a, b = build_requests(**kw), build_requests(**kw)
+        for ra, rb in zip(a, b):
+            assert np.array_equal(ra.prompt_ids, rb.prompt_ids)
+            assert ra.seed == rb.seed
+        assert any(
+            not np.array_equal(ra.prompt_ids, rb.prompt_ids)
+            for ra, rb in zip(a, build_requests(**{**kw, "seed": 43}))
+        )
+
+    def test_loadgen_slo_block_and_registry(self, tiny_model):
+        """Open-loop seeded run → the serving report block: percentiles,
+        throughput, occupancy >= 2 in flight, and llmtrain_serve_* gauges
+        in the registry (the Prometheus surface)."""
+        model, params = tiny_model
+        engine = _engine(model, params)
+        registry = MetricsRegistry(None)
+        scheduler = ContinuousBatchingScheduler(engine, registry=registry).start()
+        try:
+            requests = build_requests(
+                num_requests=6,
+                seed=9,
+                vocab_size=VOCAB,
+                prompt_tokens_min=2,
+                prompt_tokens_max=12,
+                max_new_tokens=5,
+            )
+            # High rate => arrivals overlap => a real in-flight batch.
+            block = run_loadgen(
+                scheduler, requests, rate_rps=200.0, seed=9, timeout_sec=120.0
+            )
+        finally:
+            scheduler.close()
+        assert block["requests"]["completed"] == 6
+        assert block["requests"]["failed"] == 0
+        assert block["slo"]["ttft_ms"]["p50"] is not None
+        assert block["slo"]["ttft_ms"]["p99"] >= block["slo"]["ttft_ms"]["p50"]
+        assert block["slo"]["per_token_ms"]["p50"] is not None
+        assert block["throughput"]["new_tokens"] == 6 * 5
+        assert block["throughput"]["tokens_per_sec"] > 0
+        assert block["occupancy"]["peak"] >= 2
+        assert block["compile"]["within_budget"]
+        assert block["arrival"]["process"] == "poisson-open-loop"
+        latest = registry.latest()
+        assert "serve/ttft_ms_p50" in latest
+        assert "serve/tokens_per_sec" in latest
+        assert latest["serve/peak_batch_occupancy"][0] >= 2
+        assert registry.counters()["serve/requests"] == 6
+
+    @pytest.mark.slow
+    def test_loadgen_soak_parity(self, tiny_model):
+        """Longer seeded soak (make verify-serving): every completion
+        bitwise-identical to sequential generate()."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_batch_slots=4)
+        scheduler = ContinuousBatchingScheduler(engine).start()
+        try:
+            requests = build_requests(
+                num_requests=24,
+                seed=123,
+                vocab_size=VOCAB,
+                prompt_tokens_min=2,
+                prompt_tokens_max=16,
+                max_new_tokens=8,
+            )
+            block = run_loadgen(
+                scheduler, requests, rate_rps=100.0, seed=123, timeout_sec=300.0
+            )
+        finally:
+            scheduler.close()
+        assert block["requests"]["completed"] == 24
+        assert block["occupancy"]["peak"] >= 2
+        for req in requests:
+            assert req.tokens == _reference(model, params, req)
