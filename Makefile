@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -42,6 +42,16 @@ verify-prefetch:
 # (LLMTRAIN_CHAOS_SOAK=1 enables it).
 verify-elastic:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
+
+# ZeRO sharded-optimizer-state suite (docs/perf.md "Sharded optimizer
+# state"): opt_state_shardings partition specs, bitwise loss-trajectory
+# parity zero on/off (stage 1) incl. host offload, checkpoint round-trips
+# zero<->non-zero, elastic ws2<->ws1 resume with sharded state, the
+# indivisible-leaf replicated fallback warning, and the report.json
+# opt_state_bytes accounting. Includes the @pytest.mark.slow cases plain
+# `make test` skips.
+verify-zero:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_zero.py -q
 
 # Telemetry subsystem suite (docs/observability.md): runs a real smoke fit
 # and asserts report.json + report.md + a Perfetto-loadable trace.json are

@@ -32,10 +32,13 @@ import time
 _MFU_TARGET = 0.30
 _CHILD_ENV = "LLMTRAIN_BENCH_CHILD"
 _PROBE_ENV = "LLMTRAIN_BENCH_PROBE"
-# stderr sentinel: the child prints this right before starting the optional
-# auto-sweep, so a parent-side timeout after it is "optional sweep cut
-# short", not a failure of the main measurement.
+_ZERO_ENV = "LLMTRAIN_BENCH_ZERO_CHILD"
+# stderr sentinels: the child prints one right before starting an OPTIONAL
+# phase (auto-sweep / ZeRO scenario), so a parent-side timeout after it is
+# "optional phase cut short", not a failure of the main measurement.
 _SWEEP_MARKER = "[bench] starting auto-sweep"
+_ZERO_MARKER = "[bench] starting zero scenario"
+_OPTIONAL_MARKERS = (_SWEEP_MARKER, _ZERO_MARKER)
 
 
 # --------------------------------------------------------------------------
@@ -142,15 +145,15 @@ def _watchdog_main() -> None:
         result = _last_json_line(stdout)
         if result is not None:
             if rc != 0:
-                if _SWEEP_MARKER in stderr:
+                if any(marker in stderr for marker in _OPTIONAL_MARKERS):
                     # The main measurement completed and printed its line;
-                    # only the OPTIONAL auto-sweep timed out or crashed the
-                    # process (e.g. libtpu SIGABRT on OOM bypasses Python
-                    # exception handling). Not a failure of the captured
-                    # number.
+                    # only an OPTIONAL phase (auto-sweep or the ZeRO
+                    # scenario) timed out or crashed the process (e.g.
+                    # libtpu SIGABRT on OOM bypasses Python exception
+                    # handling). Not a failure of the captured number.
                     how = "timed out" if rc is None else f"died rc={rc}"
                     print(
-                        f"{label}: optional auto-sweep {how}; main result stands",
+                        f"{label}: optional phase {how}; main result stands",
                         file=sys.stderr,
                         flush=True,
                     )
@@ -399,6 +402,36 @@ def _child_main() -> None:
     # timeout, the watchdog still parses this line from the captured stdout.
     print(json.dumps(result), flush=True)
 
+    deadline = float(os.environ.get("LLMTRAIN_BENCH_DEADLINE_SEC", "600"))
+    # ZeRO scenario column (trainer.zero, docs/perf.md "Sharded optimizer
+    # state"): zero on/off at the r05 bench shape on an emulated 4-device
+    # mesh, quantifying the per-device opt-state reduction and the
+    # all-gather overhead. CPU children only — it runs in a CPU
+    # subprocess, and burning a TPU child's watchdog budget on it would
+    # risk the chip number. The updated line (detail.zero attached)
+    # REPLACES the banked one via last-JSON-wins; a failed/skipped
+    # scenario leaves the banked line standing.
+    zero_info = None
+    if (
+        not on_tpu
+        and not explicit
+        and not fallback_child
+        and os.environ.get("LLMTRAIN_BENCH_ZERO", "1") != "0"
+    ):
+        zero_budget = min(deadline - (time.perf_counter() - t0) - 60.0, 300.0)
+        if zero_budget > 60.0:
+            print(_ZERO_MARKER, file=sys.stderr, flush=True)
+            zero_info = _zero_scenario(zero_budget)
+            if zero_info is not None:
+                result["detail"]["zero"] = zero_info
+                print(json.dumps(result), flush=True)
+        else:
+            print(
+                "zero scenario skipped: not enough of the deadline budget left",
+                file=sys.stderr,
+                flush=True,
+            )
+
     force_sweep = os.environ.get("LLMTRAIN_BENCH_SWEEP") == "1"  # CPU testing
     # The sweep only makes sense when the main measurement ran the config
     # as requested — after a ladder degradation (smaller batch / dense
@@ -408,7 +441,6 @@ def _child_main() -> None:
     undegraded = result["detail"]["batch"] == batch and result["detail"][
         "attention"
     ].startswith(att)
-    deadline = float(os.environ.get("LLMTRAIN_BENCH_DEADLINE_SEC", "600"))
     has_budget = first_cost * 2.2 < deadline - (time.perf_counter() - t0)
     if (on_tpu or force_sweep) and not explicit and not fallback_child and undegraded:
         if not has_budget:
@@ -452,7 +484,139 @@ def _child_main() -> None:
             if alt["value"] <= best["value"]:
                 break
             best = alt
+            if zero_info is not None:
+                # The sweep line supersedes the banked one (last JSON
+                # wins); carry the zero scenario forward so it survives.
+                best["detail"]["zero"] = zero_info
             print(json.dumps(best), flush=True)
+
+
+def _zero_scenario(timeout_sec: float) -> dict | None:
+    """Run the ZeRO on/off comparison in a CPU subprocess with an emulated
+    4-device mesh (the main child's backend has 1 CPU device, which would
+    make the sharding a no-op). Returns the scenario dict, or None when
+    the subprocess failed/timed out — the banked main line stands either
+    way."""
+    env = dict(os.environ)
+    env.pop(_CHILD_ENV, None)
+    env[_ZERO_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Pin the emulated mesh to exactly 4 devices, REPLACING any inherited
+    # count (test harnesses export 8, operators may export 1): the
+    # scenario's reduction claim is meaningless at a different dp degree.
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_sec,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"zero scenario timed out after {timeout_sec:.0f}s; skipping", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and "zero_scenario" in parsed:
+                return parsed["zero_scenario"]
+    tail = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else "no stderr"
+    print(f"zero scenario child failed rc={proc.returncode} ({tail[:200]})", file=sys.stderr)
+    return None
+
+
+def _zero_main() -> None:
+    """ZeRO scenario child: the r05 bench shape trained through the REAL
+    Trainer (sharding + jitted step + telemetry paths) on a 4-way
+    data-parallel mesh, zero off then on. Prints one
+    ``{"zero_scenario": ...}`` JSON line (no "metric" key — it must never
+    shadow the headline line in the parent's last-JSON-wins parse) with
+    tokens/s, step_time, hbm_peak and the per-device optimizer-state
+    bytes, quantifying the memory reduction AND the all-gather overhead."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.registry import initialize_registries
+    from llmtrain_tpu.tracking import NullTracker
+    from llmtrain_tpu.training import Trainer
+
+    initialize_registries()
+    ndev = len(jax.devices())
+    steps = int(os.environ.get("LLMTRAIN_BENCH_ZERO_STEPS", "4"))
+
+    def run(zero_on: bool) -> dict:
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "bench-zero", "device": "cpu"},
+                "model": {
+                    "name": "gpt",
+                    "block_size": 128,
+                    "d_model": 1280,
+                    "n_layers": 2,
+                    "n_heads": 8,
+                    "d_ff": 5120,
+                    "dropout": 0.0,
+                    "vocab_size": 1024,
+                    "extra": {"assume_packed": True},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": steps,
+                    "micro_batch_size": max(16 // ndev, 1),
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 0,
+                    "log_every_steps": 1,
+                    "eval_every_steps": 1_000_000,
+                    "save_every_steps": 1_000_000,
+                    "prefetch_depth": 0,
+                    "zero": {"enabled": zero_on},
+                },
+                "distributed": {"mesh": {"data": ndev}},
+                "mlflow": {"enabled": False},
+            }
+        )
+        trainer = Trainer(cfg, None, NullTracker(), None)
+        result = trainer.fit()
+        latest = trainer._telemetry.metrics.latest()
+        mem = trainer._opt_state_memory()
+        monitor = trainer._telemetry.memory
+        hbm_peak = monitor.peaks()["hbm_peak_bytes"] if monitor is not None else 0.0
+        return {
+            "tokens_per_sec": round(latest["train/tokens_per_sec"][0], 1),
+            "step_time_ms": round(latest["train/step_time_sec"][0] * 1e3, 2),
+            "hbm_peak_bytes": int(hbm_peak),
+            "opt_state_bytes": int(mem["opt_state_bytes"]),
+            "opt_state_bytes_per_device": int(mem["opt_state_bytes_per_device"]),
+            "final_loss": result.final_loss,
+        }
+
+    off = run(False)
+    on = run(True)
+    out = {
+        "devices": ndev,
+        "model": f"gpt L2 d1280 T128 b16 (r05 bench shape, {ndev}-dev CPU emulation)",
+        "zero_off": off,
+        "zero_on": on,
+        "opt_state_reduction": round(
+            off["opt_state_bytes_per_device"]
+            / max(on["opt_state_bytes_per_device"], 1),
+            2,
+        ),
+        "loss_bitwise_identical": off["final_loss"] == on["final_loss"],
+    }
+    print(json.dumps({"zero_scenario": out}), flush=True)
 
 
 def _measure_with_ladder(run, att: str, batch: int, loss_impl: str, attempts: int) -> dict:
@@ -657,7 +821,9 @@ def _run(
 
 
 if __name__ == "__main__":
-    if os.environ.get(_PROBE_ENV) == "1":
+    if os.environ.get(_ZERO_ENV) == "1":
+        _zero_main()
+    elif os.environ.get(_PROBE_ENV) == "1":
         _probe_main()
     elif os.environ.get(_CHILD_ENV) == "1":
         _child_main()

@@ -101,6 +101,52 @@ class DataConfig(BaseModel):
     model_config = _STRICT
 
 
+class ZeroConfig(BaseModel):
+    """ZeRO-style cross-replica optimizer-state sharding
+    (parallel/sharding.py:opt_state_shardings, docs/perf.md "Sharded
+    optimizer state").
+
+    With ``enabled`` the AdamW/adafactor state leaves are partitioned
+    along the combined data-parallel axes (``data``/``fsdp``/``expert``)
+    instead of being replicated on every replica — the weight-update
+    sharding of Xu et al. (arXiv:2004.13336). Per-replica optimizer
+    memory drops ~N_dp×; the loss trajectory is bitwise-identical to the
+    replicated path at the default ``stage`` 1.
+
+    ``stage`` picks how gradients synchronize:
+
+    * ``1`` — gradients keep the parameter layout (XLA's all-reduce, as
+      today); only the update compute + state storage shard. Bitwise-
+      identical trajectories zero on/off (tests/test_zero.py pins it).
+    * ``2`` — gradients are constrained to the sharded layout too, so
+      GSPMD emits reduce-scatter and the full gradient tree never
+      materializes replicated after accumulation. The global-norm clip
+      then reduces shard partials first, which reassociates the float
+      sum: trajectories track the replicated path to ~1e-6, not bitwise.
+
+    ``host_offload`` pins the (sharded) optimizer state to host memory
+    between steps: on backends with a ``pinned_host`` memory space (TPU)
+    via memory-kind shardings, elsewhere via an explicit host round-trip
+    around the step — HBM for the state drops to ~0 at the cost of a
+    per-step H2D/D2H of the state shard.
+    """
+
+    enabled: bool = False
+    stage: Literal[1, 2] = 1
+    host_offload: bool = False
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_offload(self) -> Self:
+        if self.host_offload and not self.enabled:
+            raise ValueError(
+                "trainer.zero.host_offload requires trainer.zero.enabled: "
+                "true (the offload pins the ZeRO-sharded state tree)"
+            )
+        return self
+
+
 class TrainerConfig(BaseModel):
     """Training-loop pacing, optimizer and logging cadence.
 
@@ -124,6 +170,10 @@ class TrainerConfig(BaseModel):
     # identical either way — the prefetcher only changes WHEN batches are
     # built, never what is built (tests/test_prefetch.py).
     prefetch_depth: int = Field(2, ge=0)
+    # ZeRO-style optimizer-state sharding over the data-parallel axes
+    # (see ZeroConfig above; off by default — replicated state, the
+    # pre-zero layout, stays the bit-exact parity baseline).
+    zero: ZeroConfig = Field(default_factory=ZeroConfig)
     extra: dict[str, Any] = Field(default_factory=dict)
 
     model_config = _STRICT

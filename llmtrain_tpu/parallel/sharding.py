@@ -91,6 +91,26 @@ def batch_sharding(mesh: Mesh, *, with_accum_dim: bool = False) -> NamedSharding
     return NamedSharding(mesh, P(batch_axes, "sequence"))
 
 
+# Leaves whose unsatisfiable sharding spec was already repaired (and warned
+# about) once this process — keyed by (tree path, shape, spec) so distinct
+# leaves each warn exactly once and re-derivations stay silent.
+_REPAIR_WARNED: set[tuple] = set()
+
+
+def _spec_fits(mesh: Mesh, spec, shape: tuple) -> bool:
+    """Every sharded dim of ``shape`` is divisible by its mapped axis product."""
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        names = (axes,) if isinstance(axes, str) else axes
+        shards = 1
+        for name in names:
+            shards *= mesh.shape[name]
+        if dim % shards != 0:
+            return False
+    return True
+
+
 def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_RULES):
     """NamedShardings for a pytree whose leaves may carry logical metadata.
 
@@ -100,43 +120,168 @@ def state_shardings(mesh: Mesh, abstract_tree: Any, rules=DEFAULT_LOGICAL_AXIS_R
     dims (optax.adafactor's factored ``v_row``/``v_col``, rank reduced by
     one, and its shape-(1,) placeholders) carry the full spec through the
     flax boxes, and applying it to the reduced array is a pjit error.
-    The repair is deliberately NARROW: spec longer than the rank, or a
-    1-element leaf whose spec the mesh cannot satisfy (adafactor's (1,)
-    placeholders carrying an ``embed``-style spec). A shape-(1,) leaf
-    whose spec IS satisfiable (all mapped axes size 1) keeps it, and a
-    full-rank param whose dim the mesh axis doesn't divide still fails
-    loudly at jit time instead of silently losing its sharding.
+    Repairs: spec longer than the rank, and any leaf whose spec the mesh
+    cannot satisfy (a sharded dim not divisible by the mapped axis
+    product — which previously surfaced as an opaque pjit error at jit
+    time) fall back to replicated, the latter with a one-time warning
+    NAMING the leaf so a silently-unsharded giant embedding is visible.
     """
     logical_spec = nn.get_partition_spec(abstract_tree)
     shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
 
-    def spec_fits(sharding: NamedSharding, shape: tuple) -> bool:
-        for dim, axes in zip(shape, sharding.spec):
-            if axes is None:
-                continue
-            names = (axes,) if isinstance(axes, str) else axes
-            shards = 1
-            for name in names:
-                shards *= mesh.shape[name]
-            if dim % shards != 0:
-                return False
-        return True
-
-    def finalize(sharding: Any, leaf: Any) -> Any:
+    def finalize(path, sharding: Any, leaf: Any) -> Any:
         value = nn.meta.unbox(leaf)
         shape = getattr(value, "shape", None)
         if shape is None or not isinstance(sharding, NamedSharding):
             return sharding
-        if len(sharding.spec) > len(shape) or (
-            tuple(shape) == (1,) and not spec_fits(sharding, tuple(shape))
-        ):
+        if len(sharding.spec) > len(shape):
+            return replicated(mesh)
+        if not _spec_fits(mesh, sharding.spec, tuple(shape)):
+            if tuple(shape) != (1,):
+                # (1,) placeholders (adafactor) are structural noise; a
+                # full-rank leaf losing its sharding is worth one warning.
+                key = (jax.tree_util.keystr(path), tuple(shape), str(sharding.spec))
+                if key not in _REPAIR_WARNED:
+                    _REPAIR_WARNED.add(key)
+                    from ..utils.logging import get_logger
+
+                    get_logger().warning(
+                        "sharding spec %s does not divide leaf %s with shape "
+                        "%s on mesh %s; storing this leaf REPLICATED (pick "
+                        "dims divisible by the mapped axis sizes to shard it)",
+                        sharding.spec,
+                        jax.tree_util.keystr(path),
+                        tuple(shape),
+                        dict(mesh.shape),
+                    )
             return replicated(mesh)
         return sharding
 
-    return jax.tree.map(
+    return jax.tree_util.tree_map_with_path(
         finalize,
         shardings,
         abstract_tree,
+        is_leaf=lambda s: isinstance(s, NamedSharding),
+    )
+
+
+# Axes whose product is the data-parallel degree — the replicas that hold
+# redundant optimizer-state copies, i.e. the ZeRO partitioning dimension.
+ZERO_PARTITION_AXES = ("data", "fsdp", "expert")
+
+
+def opt_state_shardings(
+    mesh: Mesh,
+    abstract_state: Any,
+    rules=DEFAULT_LOGICAL_AXIS_RULES,
+    *,
+    subject: str = "optimizer-state",
+):
+    """ZeRO-style shardings: partition every optimizer-state leaf across
+    the combined data-parallel axes (``data``/``fsdp``/``expert``).
+
+    The weight-update sharding of Xu et al. (arXiv:2004.13336): replicas
+    that hold redundant copies of the AdamW moments each keep only a
+    1/N_dp shard instead. Per-leaf derivation starts from the param-
+    inherited spec (:func:`state_shardings` — the moments carry the flax
+    ``Partitioned`` metadata through optax's init) and then APPENDS the
+    data-parallel axes the spec does not already use to the first dim
+    that can absorb them: the dim's size must be divisible by its
+    existing shard product times the free-axis product. Leaves with no
+    such dim (scalars like Adam's ``count``, indivisible shapes,
+    adafactor's ``(1,)`` placeholders) keep their base spec — replicated
+    across the dp axes — with a one-time warning for non-trivial leaves,
+    so the fallback is visible instead of silently eating the memory win.
+
+    Applying the same derivation to the abstract PARAM tree yields the
+    gradient layout of ZeRO stage 2 (reduce-scattered grads) — the
+    train step's ``grad_shardings`` constraint (training/train_step.py).
+    """
+    base = state_shardings(mesh, abstract_state, rules)
+    free_template = [a for a in ZERO_PARTITION_AXES if mesh.shape.get(a, 1) > 1]
+    if not free_template:
+        return base
+
+    def extend(path, sharding: Any, leaf: Any) -> Any:
+        value = nn.meta.unbox(leaf)
+        shape = getattr(value, "shape", None)
+        if shape is None or not shape or not isinstance(sharding, NamedSharding):
+            return sharding  # scalars / non-array leaves stay replicated
+        spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+        used: set[str] = set()
+        for axes in spec:
+            if axes is None:
+                continue
+            used.update((axes,) if isinstance(axes, str) else axes)
+        free = [a for a in free_template if a not in used]
+        if not free:
+            return sharding
+        free_product = 1
+        for a in free:
+            free_product *= mesh.shape[a]
+        for i, dim in enumerate(shape):
+            axes = spec[i]
+            names = () if axes is None else (
+                (axes,) if isinstance(axes, str) else tuple(axes)
+            )
+            current = 1
+            for name in names:
+                current *= mesh.shape[name]
+            if dim % (current * free_product) == 0:
+                spec[i] = tuple(names) + tuple(free)
+                return NamedSharding(mesh, P(*spec))
+        if _leaf_size(shape) > 1:
+            key = ("zero", subject, jax.tree_util.keystr(path), tuple(shape))
+            if key not in _REPAIR_WARNED:
+                _REPAIR_WARNED.add(key)
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "ZeRO: %s leaf %s with shape %s has no dim "
+                    "divisible by the data-parallel product %d; this leaf "
+                    "stays REPLICATED across the %s axes",
+                    subject,
+                    jax.tree_util.keystr(path),
+                    tuple(shape),
+                    free_product,
+                    "/".join(free),
+                )
+        return sharding
+
+    return jax.tree_util.tree_map_with_path(
+        extend,
+        base,
+        abstract_state,
+        is_leaf=lambda s: isinstance(s, NamedSharding),
+    )
+
+
+def _leaf_size(shape: tuple) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def host_memory_kind(mesh: Mesh) -> str | None:
+    """``"pinned_host"`` when the mesh devices expose a host memory space
+    jit shardings can target (TPU backends with the memories API), else
+    None — callers fall back to an explicit host round-trip. The CPU
+    backend only exposes ``unpinned_host``, which IS device memory there,
+    so offloading to it would be a no-op pretending otherwise."""
+    try:
+        device = mesh.devices.flat[0]
+        kinds = {m.kind for m in device.addressable_memories()}
+    except Exception:  # noqa: BLE001 — memories API is backend-optional
+        return None
+    return "pinned_host" if "pinned_host" in kinds else None
+
+
+def with_memory_kind(shardings: Any, kind: str) -> Any:
+    """Re-target every NamedSharding leaf of a sharding tree at ``kind``."""
+    return jax.tree.map(
+        lambda s: s.with_memory_kind(kind) if isinstance(s, NamedSharding) else s,
+        shardings,
         is_leaf=lambda s: isinstance(s, NamedSharding),
     )
 

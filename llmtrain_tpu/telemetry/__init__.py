@@ -169,6 +169,17 @@ class Telemetry:
             },
         )
 
+    def record_opt_state_bytes(self, info: dict[str, float]) -> None:
+        """Static optimizer-state footprint (trainer.zero memory
+        accounting): lands in the report's memory block AND as ``mem/*``
+        gauges so Prometheus/trackers see the ZeRO reduction live.
+        Gated with the memory monitor — the telemetry master switch
+        removes ALL ``mem/*`` traffic, accounting included."""
+        if self.memory is None:
+            return
+        self.memory.record_opt_state(info)
+        self.metrics.publish({f"mem/{k}": float(v) for k, v in info.items()})
+
     def flush(self, step: int | None = None) -> None:
         """The per-log-interval flush point: sample memory, push the pending
         metrics sample to the tracker (degraded on failure), persist the

@@ -104,6 +104,7 @@ class MemoryMonitor:
         self._source = "unsampled"
         self._in_excursion = False
         self.headroom_warnings = 0
+        self._opt_state: dict[str, float] = {}
 
     @property
     def source(self) -> str:
@@ -178,14 +179,24 @@ class MemoryMonitor:
         elif frac < self._warn_frac:
             self._in_excursion = False
 
+    def record_opt_state(self, info: dict[str, float]) -> None:
+        """Static optimizer-state footprint (trainer._opt_state_memory):
+        ``opt_state_bytes`` (logical total), ``opt_state_bytes_per_device``
+        (resident on one device — the ZeRO ~N_dp× reduction shows here),
+        ``opt_state_bytes_host`` (held off-device by host offload).
+        Merged into the report's memory block."""
+        self._opt_state = {k: float(v) for k, v in info.items()}
+
     def peaks(self) -> dict[str, float]:
         """End-of-run summary block for the report."""
-        return {
+        out = {
             "hbm_peak_bytes": self._peak_hbm,
             "host_rss_peak_bytes": self._peak_rss,
             "live_array_peak_bytes": float(self._peak_live_bytes),
             "headroom_warnings": float(self.headroom_warnings),
         }
+        out.update(self._opt_state)
+        return out
 
 
 __all__ = ["MemoryMonitor"]

@@ -248,6 +248,16 @@ def render_markdown(report: dict[str, Any]) -> str:
             f"(source: {mem.get('source', 'unknown')})"
         )
         lines.append(f"- host RSS peak: {_fmt_bytes(mem.get('host_rss_peak_bytes'))}")
+        if mem.get("opt_state_bytes") is not None:
+            # ZeRO accounting (trainer.zero, docs/perf.md): per-device vs
+            # total is the sharding win; host bytes appear under offload.
+            line = (
+                f"- optimizer state: {_fmt_bytes(mem['opt_state_bytes'])} total, "
+                f"{_fmt_bytes(mem.get('opt_state_bytes_per_device'))} per device"
+            )
+            if mem.get("opt_state_bytes_host"):
+                line += f", {_fmt_bytes(mem['opt_state_bytes_host'])} host-offloaded"
+            lines.append(line)
         warns = int(mem.get("headroom_warnings") or 0)
         if warns:
             lines.append(f"- **headroom warnings: {warns}** (see timeline)")

@@ -89,6 +89,7 @@ def make_train_step(
     use_dropout: bool,
     nonfinite_guard: bool = False,
     inject_nan_window: tuple[int, int] | None = None,
+    grad_shardings: Any | None = None,
 ) -> Callable:
     """Build the pure train step: (state, batch(A,B,T), run_key) -> (state, metrics).
 
@@ -102,6 +103,19 @@ def make_train_step(
     (resilience/faults.py): loss and grads are poisoned with NaN for
     optimizer steps ``start .. start+n-1``, compiled into the step so the
     guard's recovery is exercised inside the real XLA program.
+
+    ``grad_shardings`` (ZeRO, trainer.zero — a NamedSharding pytree over
+    the param structure) pins the accumulated gradients' layout with
+    ``with_sharding_constraint`` so GSPMD emits the intended gradient
+    collective. Stage 1 passes the PARAM shardings: the grad sync stays
+    the all-reduce of the replicated path (bitwise-identical math — the
+    global-norm clip sees the exact same layout), while the optimizer
+    update downstream is sharded by the state's in/out shardings and the
+    new params all-gather. Stage 2 passes the ZeRO-sharded layout: the
+    sync becomes a reduce-scatter and the full grad tree never
+    materializes replicated after accumulation (the norm clip then
+    reduces shard partials — ~1e-6 float reassociation vs zero-off).
+    None (zero off) adds no constraint: the pre-zero program, bit-exact.
     """
     loss_fn = make_loss_fn(adapter, model, use_dropout=use_dropout)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -122,6 +136,8 @@ def make_train_step(
             micro, zeros, (batch, idxs)
         )
         grads = jax.tree.map(lambda g: g / grad_accum_steps, grads_sum)
+        if grad_shardings is not None:
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
         if inject_nan_window is not None:
             first, length = inject_nan_window

@@ -41,10 +41,13 @@ from ..parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     batch_sharding,
     data_parallel_degree,
+    host_memory_kind,
     mesh_axis_sizes,
+    opt_state_shardings,
     replicated,
     reshard_state,
     state_shardings,
+    with_memory_kind,
 )
 from ..registry import get_data_module
 from ..resilience import (
@@ -242,7 +245,7 @@ class Trainer:
         # read them: per-example arrays are otherwise batch-sharded and not
         # addressable across hosts. They are tiny; the all-gather is noise.
         use_dropout = cfg.model.dropout > 0.0
-        self._train_step_fn = jax.jit(
+        step_fn = jax.jit(
             make_train_step(
                 self._adapter,
                 self._model,
@@ -251,10 +254,36 @@ class Trainer:
                 use_dropout=use_dropout,
                 nonfinite_guard=cfg.resilience.nonfinite_guard,
                 inject_nan_window=self._faults.nan_window(),
+                grad_shardings=self._grad_shardings,
             ),
             donate_argnums=(0,),
             out_shardings=(self._state_shardings, replicated(self._mesh)),
         )
+        if self._zero_offload_mode == "roundtrip":
+            # Explicit host round-trip (no pinned_host memory space on this
+            # backend): the state's opt leaves live as host numpy between
+            # steps; each step lands them on the mesh through a jit
+            # identity (NOT device_put — on the CPU backend device_put
+            # aliases host numpy zero-copy and the donating step would
+            # then write into memory numpy still owns, see reshard_state)
+            # and pulls the updated shards back to owned host copies.
+            to_device = jax.jit(
+                lambda t: t, out_shardings=self._state_shardings.opt_state
+            )
+
+            def step_with_host_opt(state, batch, run_key):
+                state = state.replace(opt_state=to_device(state.opt_state))
+                new_state, metrics = step_fn(state, batch, run_key)
+                return (
+                    new_state.replace(
+                        opt_state=self._opt_state_to_host(new_state.opt_state)
+                    ),
+                    metrics,
+                )
+
+            self._train_step_fn = step_with_host_opt
+        else:
+            self._train_step_fn = step_fn
         self._eval_step_fn = jax.jit(
             make_eval_step(self._adapter, self._model),
             out_shardings=replicated(self._mesh),
@@ -292,6 +321,16 @@ class Trainer:
         Params keep their flax ``Partitioned`` metadata inside the state so
         optimizer moments inherit the same logical specs; shardings are
         computed from an ``eval_shape`` trace and applied via out_shardings.
+
+        With ``trainer.zero.enabled`` the optimizer-state leaves swap their
+        replicated fallback for the ZeRO partitioning over the combined
+        data-parallel axes (parallel/sharding.py:opt_state_shardings) —
+        the jitted step's in/out shardings then make XLA/GSPMD emit the
+        sharded update + param all-gather, no step-code change. With
+        ``host_offload`` the state additionally pins to the backend's
+        ``pinned_host`` memory space when one exists; otherwise
+        ``_zero_offload_mode`` records the explicit round-trip fallback
+        the step wrapper applies.
         """
         cfg = self._cfg
         init_rng = jax.random.key(cfg.run.seed)
@@ -314,8 +353,68 @@ class Trainer:
 
         abstract = jax.eval_shape(create, init_rng)
         shardings = state_shardings(self._mesh, abstract, self._rules)
+        self._grad_shardings = None
+        self._zero_offload_mode: str | None = None
+        zero = cfg.trainer.zero
+        if zero.enabled:
+            opt_sh = opt_state_shardings(self._mesh, abstract.opt_state, self._rules)
+            # Stage 1 pins grads to the PARAM layout (the replicated path's
+            # exact all-reduce, bitwise math); stage 2 pins them to the
+            # ZeRO layout so GSPMD reduce-scatters instead.
+            self._grad_shardings = (
+                shardings.params
+                if zero.stage == 1
+                else opt_state_shardings(
+                    self._mesh, abstract.params, self._rules, subject="gradient"
+                )
+            )
+            offload_kind = None
+            if zero.host_offload:
+                offload_kind = host_memory_kind(self._mesh)
+                if offload_kind is not None:
+                    opt_sh = with_memory_kind(opt_sh, offload_kind)
+                    self._zero_offload_mode = "memory_kind"
+                else:
+                    self._zero_offload_mode = "roundtrip"
+                    logger.warning(
+                        "trainer.zero.host_offload: this backend exposes no "
+                        "pinned_host memory space; using the explicit host "
+                        "round-trip (full opt-state H2D/D2H each step — "
+                        "correct, but slower than memory-kind offload)"
+                    )
+            shardings = shardings.replace(opt_state=opt_sh)
+            logger.info(
+                "ZeRO optimizer-state sharding enabled: stage %d over %d-way "
+                "data parallel%s",
+                zero.stage,
+                self._dp,
+                (
+                    f", host offload via {self._zero_offload_mode}"
+                    if zero.host_offload
+                    else ""
+                ),
+            )
         self._state_shardings = shardings
-        return jax.jit(create, out_shardings=shardings)(init_rng)
+        state = jax.jit(create, out_shardings=shardings)(init_rng)
+        if self._zero_offload_mode == "roundtrip":
+            state = state.replace(
+                opt_state=self._opt_state_to_host(state.opt_state)
+            )
+        return state
+
+    @staticmethod
+    def _opt_state_to_host(opt_state: Any) -> Any:
+        """Owned host-numpy copies of every opt-state leaf (round-trip
+        offload), flax boxes preserved so the state's pytree structure
+        never changes mid-run. Shares the checkpoint module's
+        owned-copy rule (zero-copy views of donated device buffers are
+        the aliasing trap), DMA prestart (transfers pipeline instead of
+        serializing leaf-by-leaf), and multi-host allgather for shards
+        another process owns."""
+        from .checkpoint import host_fetch, start_host_transfers
+
+        start_host_transfers(opt_state)
+        return jax.tree.map(host_fetch, opt_state)
 
     @property
     def _is_main(self) -> bool:
@@ -711,6 +810,20 @@ class Trainer:
             self._telemetry.metrics.inc("faults/injected"),
         )
         self._telemetry.start()
+        # Optimizer-state footprint (docs/perf.md "Sharded optimizer
+        # state"): static for the whole fit, recorded once so the ZeRO
+        # memory win is a measured number in report.json/metrics, not a
+        # claim. Recorded after a resume's reshard too (fit restores
+        # above), so the bytes describe the state actually training.
+        opt_mem = self._opt_state_memory()
+        self._telemetry.record_opt_state_bytes(opt_mem)
+        logger.info(
+            "optimizer state: %.1f MiB total, %.1f MiB on device 0, "
+            "%.1f MiB host-resident",
+            opt_mem["opt_state_bytes"] / 2**20,
+            opt_mem["opt_state_bytes_per_device"] / 2**20,
+            opt_mem["opt_state_bytes_host"] / 2**20,
+        )
 
         self._telemetry.metrics.safe_log_params(cfg.model_dump())
 
@@ -1758,7 +1871,6 @@ class Trainer:
             nn_meta.unbox(self._state.opt_state), payload["opt_state"]
         )
         boxed_params = _rebox_like(self._state.params, host_params)
-        boxed_opt = _rebox_like(self._state.opt_state, host_opt)
         # Resilience scalars (guard counter, rollback/data-offset, spike
         # trend) ride in an optional payload key; absent in pre-resilience
         # checkpoints, which restore with zeroed guard state.
@@ -1769,17 +1881,44 @@ class Trainer:
             nonfinite_count = jnp.asarray(
                 int(resil.get("nonfinite_count", 0)), jnp.int32
             )
-        restored = TrainState(
-            step=jnp.asarray(step, jnp.int32),
-            params=boxed_params,
-            opt_state=boxed_opt,
-            nonfinite_count=nonfinite_count,
-        )
         # Placement onto THIS run's mesh (parallel/sharding.py): the
         # checkpoint holds full host arrays, so restoring onto a different
         # data-parallel/fsdp degree is the same device_put as restoring
-        # onto the saving one — this line IS the elastic reshard.
-        self._state = reshard_state(restored, self._state_shardings)
+        # onto the saving one — this line IS the elastic reshard. With
+        # trainer.zero the sharding tree carries the ZeRO partition specs,
+        # so the SAME jit-identity lands the full host arrays as per-
+        # replica state shards (zero on/off and any dp size compose
+        # freely across a resume: the payload is always full arrays).
+        if self._zero_offload_mode == "roundtrip":
+            # Round-trip offload keeps opt state as host numpy between
+            # steps — and the checkpoint ALREADY holds full host arrays,
+            # so landing them on the mesh just to gather them straight
+            # back would be two wasted full-state transfers per restore.
+            # Reshard only the on-device fields; re-box the opt tree as
+            # owned host copies directly.
+            placed = reshard_state(
+                {"step": jnp.asarray(step, jnp.int32), "params": boxed_params,
+                 "nonfinite_count": nonfinite_count},
+                {"step": self._state_shardings.step,
+                 "params": self._state_shardings.params,
+                 "nonfinite_count": self._state_shardings.nonfinite_count},
+            )
+            self._state = TrainState(
+                step=placed["step"],
+                params=placed["params"],
+                opt_state=_rebox_like(
+                    self._state.opt_state, host_opt, device=False
+                ),
+                nonfinite_count=placed["nonfinite_count"],
+            )
+        else:
+            restored = TrainState(
+                step=jnp.asarray(step, jnp.int32),
+                params=boxed_params,
+                opt_state=_rebox_like(self._state.opt_state, host_opt),
+                nonfinite_count=nonfinite_count,
+            )
+            self._state = reshard_state(restored, self._state_shardings)
         logger.info("resumed from %s at step %d", path, step)
         return step
 
@@ -1789,6 +1928,44 @@ class Trainer:
         from ..utils.hw import peak_memory_bytes
 
         return peak_memory_bytes()
+
+    def _opt_state_memory(self) -> dict[str, int]:
+        """Optimizer-state footprint: logical total, bytes resident on the
+        first mesh device, and bytes held off-device (host offload). With
+        ZeRO off, per-device == total (every replica holds a full copy);
+        with ZeRO on it drops to ~total/N_dp — the measured number behind
+        report.json ``memory.opt_state_bytes`` (docs/perf.md)."""
+        device0 = self._mesh.devices.flat[0]
+        try:
+            default_kind = device0.default_memory().kind
+        except Exception:  # noqa: BLE001 — memories API is backend-optional
+            default_kind = None
+        total = per_device = on_host = 0
+        for leaf in jax.tree.leaves(nn_meta.unbox(self._state.opt_state)):
+            nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+            total += nbytes
+            if isinstance(leaf, jax.Array):
+                kind = getattr(leaf.sharding, "memory_kind", None)
+                if (
+                    kind is not None
+                    and default_kind is not None
+                    and kind != default_kind
+                ):
+                    # memory-kind offload: resident in the host space, not
+                    # in the device's default (HBM) space.
+                    on_host += nbytes
+                    continue
+                for shard in leaf.addressable_shards:
+                    if shard.device == device0:
+                        per_device += int(shard.data.nbytes)
+            else:
+                # Round-trip offload keeps host numpy between steps.
+                on_host += nbytes
+        return {
+            "opt_state_bytes": total,
+            "opt_state_bytes_per_device": per_device,
+            "opt_state_bytes_host": on_host,
+        }
 
 
 class _StepProfiler:
@@ -1896,13 +2073,20 @@ class _StepProfiler:
             self._active = False
 
 
-def _rebox_like(boxed_template: Any, values: Any) -> Any:
-    """Re-attach Partitioned metadata from ``boxed_template`` onto ``values``."""
+def _rebox_like(boxed_template: Any, values: Any, *, device: bool = True) -> Any:
+    """Re-attach Partitioned metadata from ``boxed_template`` onto ``values``.
+
+    ``device=False`` keeps the leaves as OWNED host numpy (round-trip
+    offload restore: the opt state lives on host between steps, so the
+    usual jnp.asarray device placement would be an immediate waste)."""
+    from .checkpoint import owned_host_copy
+
+    convert = jnp.asarray if device else owned_host_copy
 
     def rebox(template_leaf, value):
         if isinstance(template_leaf, nn_meta.Partitioned):
-            return template_leaf.replace_boxed(jnp.asarray(value))
-        return jnp.asarray(value)
+            return template_leaf.replace_boxed(convert(value))
+        return convert(value)
 
     return jax.tree.map(
         rebox, boxed_template, values, is_leaf=lambda x: isinstance(x, nn_meta.Partitioned)

@@ -289,3 +289,36 @@ class TestServingConfig:
     def test_rejections(self, serving):
         with pytest.raises(Exception):
             RunConfig.model_validate({**MINIMAL, "serving": serving})
+
+
+class TestZeroConfig:
+    """trainer.zero: section (parallel/sharding.py:opt_state_shardings,
+    docs/perf.md "Sharded optimizer state")."""
+
+    def test_defaults_off(self):
+        cfg = RunConfig.model_validate(MINIMAL)
+        assert cfg.trainer.zero.enabled is False
+        assert cfg.trainer.zero.stage == 1
+        assert cfg.trainer.zero.host_offload is False
+
+    def test_enabled_with_stage_2(self):
+        cfg = RunConfig.model_validate(
+            {**MINIMAL, "trainer": {**MINIMAL["trainer"], "zero": {"enabled": True, "stage": 2}}}
+        )
+        assert cfg.trainer.zero.enabled is True
+        assert cfg.trainer.zero.stage == 2
+
+    @pytest.mark.parametrize(
+        "zero",
+        [
+            {"stage": 3},  # only ZeRO-1/2 semantics exist here
+            {"stage": 0},
+            {"host_offload": True},  # offload requires enabled
+            {"bogus": 1},
+        ],
+    )
+    def test_rejections(self, zero):
+        with pytest.raises(Exception):
+            RunConfig.model_validate(
+                {**MINIMAL, "trainer": {**MINIMAL["trainer"], "zero": zero}}
+            )
