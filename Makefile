@@ -1,6 +1,6 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet train-smoke train-multiproc bench \
 	chip-evidence mlflow \
-	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-logs k8s-clean \
+	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
 
 # -n auto: xdist parallelism scales the gate to the host (1 worker on a
@@ -52,6 +52,17 @@ verify-elastic:
 # `make test` skips.
 verify-zero:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_zero.py -q
+
+# Multi-tenant fleet suite (docs/robustness.md "Fleet: many tenants,
+# shared capacity"): the deterministic scheduling-policy tables, tenant
+# state machine, and SIGTERM->SIGKILL escalation ladder units — PLUS the
+# @pytest.mark.slow drills plain `make test` skips: the 3-tenant seeded
+# preemption storm (capacity drop + evictions + one mid-checkpoint kill,
+# per-tenant bitwise parity vs uninterrupted references), the
+# twice-evicted resume_count==2 fairness pin, the elastic 1->2-device
+# resize, and the `llmtrain fleet` CLI round-trip.
+verify-fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 
 # Telemetry subsystem suite (docs/observability.md): runs a real smoke fit
 # and asserts report.json + report.md + a Perfetto-loadable trace.json are
@@ -146,6 +157,12 @@ k8s-train:
 # training Job's committed checkpoint with continuous batching.
 k8s-serve:
 	kubectl apply -f k8s/infra.yaml -f k8s/configmap.yaml -f k8s/serve.yaml
+
+# Multi-tenant fleet supervisor Job (docs/robustness.md "Fleet: many
+# tenants, shared capacity"): one pod schedules the ConfigMap's fleet
+# tenants onto an emulated device pool with preemption-aware scheduling.
+k8s-fleet:
+	kubectl apply -f k8s/infra.yaml -f k8s/configmap.yaml -f k8s/fleet.yaml
 
 k8s-logs:
 	kubectl logs -l app=llmtrain-tpu --all-containers --prefix -f

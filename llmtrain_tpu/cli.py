@@ -451,6 +451,66 @@ def build_parser() -> argparse.ArgumentParser:
     )
     avg.add_argument("--json", action="store_true", help="emit stats as JSON")
 
+    fleet = sub.add_parser(
+        "fleet",
+        help="multi-tenant fleet supervisor: schedule fleet.tenants onto a "
+        "bounded emulated device pool with preemption-aware scheduling, "
+        "quotas, and the SIGTERM->SIGKILL escalation ladder (fleet/, "
+        "docs/robustness.md)",
+    )
+    fleet.add_argument("--config", required=True, help="path to the YAML run config")
+    fleet.add_argument(
+        "--storm",
+        action="store_true",
+        help="run the seeded preemption-storm acceptance drill instead of "
+        "a plain fleet run: capacity drop + seeded evictions + one "
+        "mid-checkpoint kill, then per-tenant bitwise parity against "
+        "uninterrupted references (fleet/chaos.py)",
+    )
+    fleet.add_argument(
+        "--seed", type=int, default=0, help="seed for the storm schedule "
+        "and the per-tenant respawn-backoff streams"
+    )
+    fleet.add_argument(
+        "--max-steps",
+        type=int,
+        default=None,
+        help="override trainer.max_steps for every tenant (keep it small)",
+    )
+    fleet.add_argument(
+        "--save-every",
+        type=int,
+        default=None,
+        help="override trainer.save_every_steps for every tenant",
+    )
+    fleet.add_argument(
+        "--work-dir",
+        default=None,
+        help="supervisor working directory (default: "
+        "{output.root_dir}/fleet_{run.name} or fleet_storm_{run.name}_s{seed})",
+    )
+    fleet.add_argument(
+        "--timeout-sec",
+        type=float,
+        default=900.0,
+        help="whole-fleet wall-clock budget",
+    )
+    fleet.add_argument(
+        "--step-delay-sec",
+        type=float,
+        default=0.15,
+        help="storm only: per-step tenant throttle so external evictions "
+        "land mid-run (trainer.extra.step_delay_sec)",
+    )
+    fleet.add_argument(
+        "--fresh",
+        action="store_true",
+        help="wipe the work dir's runs tree before starting (default: a "
+        "restarted supervisor auto-resumes every tenant from its newest "
+        "commit; --storm always starts fresh)",
+    )
+    fleet.add_argument("--json", action="store_true", help="emit the result as JSON")
+
     chaos = sub.add_parser(
         "chaos",
         help="seeded chaos-recovery drill: repeated SIGKILL/resume cycles "
@@ -2023,6 +2083,112 @@ def _handle_chaos(args: argparse.Namespace) -> int:
     return EXIT_OK
 
 
+def _handle_fleet(args: argparse.Namespace) -> int:
+    """Multi-tenant fleet supervisor / preemption-storm drill.
+
+    Exit 0 when every tenant completed (and, under --storm, every parity
+    and scheduling invariant held); exit 1 when a tenant failed or an
+    invariant broke; exit 2 for config problems."""
+    try:
+        cfg, _, resolved = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    configure_platform(cfg.run.device)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    from .resilience.harness import DrillInvariantError
+
+    try:
+        if args.storm:
+            from .fleet.chaos import run_fleet_storm
+
+            result = run_fleet_storm(
+                args.config,
+                seed=args.seed,
+                max_steps=args.max_steps,
+                save_every=args.save_every,
+                work_dir=args.work_dir,
+                timeout_sec=args.timeout_sec,
+                step_delay_sec=args.step_delay_sec,
+            )
+            if args.json:
+                print(json.dumps(result))
+            else:
+                parities = {
+                    n: r["parity"] for n, r in result["tenants"].items()
+                }
+                print(
+                    f"fleet storm passed: {result['total_evictions']} "
+                    f"eviction(s) (mid-checkpoint kill on "
+                    f"{result['mid_checkpoint_kill_tenant']}), "
+                    f"{result['total_respawns']} respawn(s), "
+                    f"{result['capacity_changes']} capacity change(s) across "
+                    f"{len(result['tenants'])} tenant(s); per-tenant parity "
+                    f"{parities}; artifacts in {result['work_dir']}"
+                )
+            return EXIT_OK
+
+        from .fleet.supervisor import FleetSupervisor
+
+        work_dir = args.work_dir or str(
+            Path(cfg.output.root_dir) / f"fleet_{cfg.run.name}"
+        )
+        try:
+            sup = FleetSupervisor(
+                cfg,
+                resolved,
+                work_dir=work_dir,
+                seed=args.seed,
+                max_steps=args.max_steps,
+                save_every=args.save_every,
+                fresh=args.fresh,
+            )
+        except ValueError as exc:
+            # Constructor-time validation only (no tenants, wrong device,
+            # infeasible world sizes): deterministic config problems. A
+            # ValueError INSIDE the run is a runtime failure and takes the
+            # taxonomy path below.
+            _emit_error(str(exc))
+            return EXIT_CONFIG_ERROR
+        try:
+            report = sup.run(timeout_sec=args.timeout_sec)
+        except DrillInvariantError:
+            raise  # the outer handler maps it to EXIT_TRAIN_FAILURE
+        except Exception as exc:  # noqa: BLE001 — run-time, NOT config
+            # Includes ValueError: past construction, nothing about the
+            # config is in question — route through the taxonomy instead
+            # of the outer config-error mapping.
+            logger.exception("fleet run errored: %s", exc)
+            _emit_error(f"fleet run errored: {exc}")
+            return exit_code_for_exception(exc)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(
+                f"fleet run finished: {report['totals']['completed']}/"
+                f"{len(report['tenants'])} tenant(s) completed, "
+                f"{report['totals']['evictions']} eviction(s), "
+                f"{report['totals']['respawns']} respawn(s); report in "
+                f"{sup.work_dir / 'fleet_report.json'}"
+            )
+        return EXIT_OK if report["totals"]["failed"] == 0 else EXIT_TRAIN_FAILURE
+    except DrillInvariantError as exc:
+        logger.error("fleet invariant violated: %s", exc)
+        _emit_error(f"fleet invariant violated: {exc}")
+        return EXIT_TRAIN_FAILURE
+    except ValueError as exc:
+        # Storm pre-run validation (tenant count, infeasible fault
+        # windows, supervisor construction) raises ValueError before any
+        # subprocess launches — deterministic config problems.
+        _emit_error(str(exc))
+        return EXIT_CONFIG_ERROR
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        logger.exception("fleet run errored: %s", exc)
+        _emit_error(f"fleet run errored: {exc}")
+        return exit_code_for_exception(exc)
+
+
 def _handle_train(args: argparse.Namespace) -> int:
     try:
         cfg, _, resolved = load_and_validate_config(args.config)
@@ -2238,6 +2404,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_train(args)
     if args.command == "chaos":
         return _handle_chaos(args)
+    if args.command == "fleet":
+        return _handle_fleet(args)
     if args.command == "generate":
         return _handle_generate(args)
     if args.command == "serve":

@@ -23,6 +23,7 @@ TRAINER_EXTRA_KEYS = frozenset(
         "profile_all_hosts",
         "optimizer",
         "ema_decay",
+        "step_delay_sec",
     }
 )
 

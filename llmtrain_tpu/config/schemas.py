@@ -271,6 +271,13 @@ class FaultInjectionConfig(BaseModel):
     spike_loss_scale: float = Field(100.0, gt=1.0)
     # Deliver SIGTERM to this process right after dispatching this step.
     sigterm_at_step: int | None = Field(None, ge=1)
+    # Preemption-named twin of sigterm_at_step: a real SIGTERM delivered
+    # to self at EXACTLY this step, driving the clean-preemption save +
+    # exit-0 path — the same seeded, in-config treatment kill_at_step
+    # gives SIGKILL. The fleet storm schedule (fleet/chaos.py) uses this
+    # for step-exact graceful evictions; mutually exclusive with
+    # sigterm_at_step (they share the one-shot delivery slot).
+    preempt_at_step: int | None = Field(None, ge=1)
     # Hard-kill (SIGKILL — no handler, no cleanup, no checkpoint) this
     # process right after dispatching this step. The crash-shaped failure
     # the atomic commit protocol + chaos harness (resilience/chaos.py)
@@ -306,6 +313,15 @@ class FaultInjectionConfig(BaseModel):
     hang_in_prefetcher: bool = False
 
     model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_preempt_alias(self) -> Self:
+        if self.preempt_at_step is not None and self.sigterm_at_step is not None:
+            raise ValueError(
+                "faults.preempt_at_step and faults.sigterm_at_step are the "
+                "same one-shot SIGTERM injection — set exactly one"
+            )
+        return self
 
 
 class WatchdogConfig(BaseModel):
@@ -472,6 +488,104 @@ class ServingConfig(BaseModel):
         return self
 
 
+class FleetTenantConfig(BaseModel):
+    """One tenant of the multi-tenant fleet supervisor (llmtrain_tpu/fleet/,
+    ``llmtrain fleet``, docs/robustness.md "Fleet: many tenants, shared
+    capacity").
+
+    A tenant is a full training job derived from the enclosing config:
+    ``overrides`` deep-merges into the resolved base (different lr, LoRA
+    block, data mix, ...), the supervisor re-roots its output under the
+    fleet work dir and launches it as a real ``train --auto-resume``
+    subprocess with a stable run id (= the tenant name), so evictions
+    resume from the newest commit and ``resilience/resume_count`` keeps
+    accumulating across respawns.
+
+    ``min_devices``/``max_devices`` bound the tenant's data-parallel world
+    size on the shared pool (``max_devices`` is the quota). The scheduler
+    only ever assigns world sizes that divide the tenant's global
+    micro-batch (``trainer.micro_batch_size`` after overrides) so every
+    resize is an ELASTIC topology change — ``micro_batch_size × dp`` stays
+    constant and the trajectory is preserved (resilience/elastic.py).
+    """
+
+    name: str
+    # Higher priority wins capacity first; ties break by name so the
+    # scheduling policy is a deterministic pure function.
+    priority: int = 0
+    min_devices: int = Field(1, ge=1)
+    max_devices: int = Field(1, ge=1)
+    overrides: dict[str, Any] = Field(default_factory=dict)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_bounds(self) -> Self:
+        if self.max_devices < self.min_devices:
+            raise ValueError(
+                f"tenant {self.name!r}: max_devices ({self.max_devices}) "
+                f"must be >= min_devices ({self.min_devices})"
+            )
+        if not self.name or "/" in self.name or self.name.startswith("."):
+            raise ValueError(
+                "tenant names become run ids and directory names; "
+                f"{self.name!r} is not a safe path component"
+            )
+        return self
+
+
+class FleetConfig(BaseModel):
+    """Multi-tenant fleet supervisor over a bounded emulated device pool
+    (llmtrain_tpu/fleet/supervisor.py).
+
+    ``pool_devices`` bounds total capacity; the deterministic scheduling
+    policy (fleet/policy.py) grants every runnable tenant its
+    ``min_devices`` in priority order, suspends (never crashes) what no
+    longer fits when the pool shrinks, and grows tenants toward their
+    quota with whatever is left. Preemption is graceful-first:
+    SIGTERM (clean preemption save) → ``preempt_grace_sec`` deadline →
+    SIGKILL, with seeded full-jitter backoff (``retry_rng``) pacing each
+    tenant's respawns.
+    """
+
+    pool_devices: int = Field(2, ge=1)
+    tenants: list[FleetTenantConfig] = Field(default_factory=list)
+    # Escalation ladder: how long a SIGTERM'd tenant gets to finish its
+    # clean preemption save before the supervisor hard-kills it.
+    preempt_grace_sec: float = Field(20.0, gt=0.0)
+    # Full-jitter respawn backoff (resilience/faults.py retry semantics):
+    # eviction k of a tenant sleeps uniform(0, min(max, base·2^(k-1))).
+    respawn_backoff_base_sec: float = Field(0.05, ge=0.0)
+    respawn_backoff_max_sec: float = Field(2.0, gt=0.0)
+    # Supervisor reconcile cadence.
+    tick_sec: float = Field(0.1, gt=0.0)
+    # A tenant exceeding this many respawns is failed instead of
+    # crash-looping the pool forever.
+    max_respawns_per_tenant: int = Field(20, ge=1)
+    # Per-segment wall-clock budget; a tenant subprocess exceeding it is
+    # killed and the drill invariant machinery reports the wedge.
+    segment_timeout_sec: float = Field(600.0, gt=0.0)
+    # A running tenant whose watchdog heartbeat file is staler than this
+    # is counted unhealthy in the fleet view (llmtrain_fleet_* gauges).
+    heartbeat_stale_sec: float = Field(30.0, gt=0.0)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_tenants(self) -> Self:
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"fleet tenant names must be unique, got {names}")
+        for t in self.tenants:
+            if t.min_devices > self.pool_devices:
+                raise ValueError(
+                    f"tenant {t.name!r} needs min_devices={t.min_devices} "
+                    f"but the pool only has {self.pool_devices} devices — "
+                    "it could never be scheduled"
+                )
+        return self
+
+
 class MLflowConfig(BaseModel):
     """MLflow tracking options (reference schemas.py:123-136).
 
@@ -531,6 +645,7 @@ class RunConfig(BaseModel):
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    fleet: FleetConfig = Field(default_factory=FleetConfig)
     mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)

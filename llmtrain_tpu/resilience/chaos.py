@@ -28,17 +28,16 @@ and after the final (uninterrupted) cycle:
   uninterrupted reference run's at every overlapping step, and the final
   checkpoints' params/opt_state are bitwise-identical tree-wide.
 
-Driven by the ``llmtrain chaos`` CLI subcommand and
-``make verify-elastic``; see docs/robustness.md.
+The segment/invariant machinery lives in ``resilience/harness.py`` and is
+shared with the multi-tenant fleet storm (``fleet/chaos.py``): this module
+keeps the single-job drill and its ``llmtrain chaos`` CLI contract.
+Driven by ``make verify-elastic``; see docs/robustness.md.
 """
 
 from __future__ import annotations
 
 import json
 import random
-import re
-import subprocess
-import sys
 import time
 from pathlib import Path
 from typing import Any
@@ -46,16 +45,42 @@ from typing import Any
 import yaml
 
 from ..utils.logging import get_logger
+from .harness import (
+    KILL_RETURNCODES as _KILL_RETURNCODES,
+)
+from .harness import (
+    RESUMED_RE as _RESUMED_RE,  # noqa: F401 — re-exported for drills/tests
+)
+from .harness import (
+    DrillInvariantError,
+    aligned_log_every,
+    derive_segment_config,
+    next_save_boundary,
+    run_train_segment,
+)
+from .harness import (
+    assert_newest_loadable as _harness_assert_newest_loadable,
+)
+from .harness import (
+    log_size as _log_size,
+)
+from .harness import (
+    newest_committed_step as _newest_committed_step,
+)
+from .harness import (
+    segment_resumed_step as _segment_resumed_step,
+)
+from .harness import (
+    summary_of as _harness_summary_of,
+)
+from .harness import (
+    trees_bitwise_equal as _trees_bitwise_equal,
+)
 
 logger = get_logger()
 
-_RESUMED_RE = re.compile(r"resumed from .*step_(\d{6,})\.ckpt at step (\d+)")
 
-# SIGKILL surfaces as -9 from Popen (or 128+9 through a shell).
-_KILL_RETURNCODES = (-9, 137)
-
-
-class ChaosInvariantError(RuntimeError):
+class ChaosInvariantError(DrillInvariantError):
     """A recovery invariant failed — the crash-consistency contract is
     broken (this is the harness's whole reason to exist, so it is loud)."""
 
@@ -69,156 +94,44 @@ def _derive_config(
     log_every: int,
     faults: dict[str, Any] | None,
 ) -> dict[str, Any]:
-    """One chaos segment's config: the user's run, re-rooted into the
-    harness work dir, with cadence pinned and the cycle's fault plan
-    installed. Tracker/endpoint integrations are forced off — segments
-    are killed mid-flight and must not strand external state."""
-    cfg = json.loads(json.dumps(resolved))  # deep copy, JSON-safe by construction
-    cfg.setdefault("output", {})["root_dir"] = root_dir
-    trainer = cfg.setdefault("trainer", {})
-    trainer["max_steps"] = max_steps
-    trainer["save_every_steps"] = save_every
-    trainer["log_every_steps"] = log_every
-    # Eval adds wall-clock without touching the trajectory contract.
-    trainer["eval_every_steps"] = max_steps
-    cfg.setdefault("mlflow", {})["enabled"] = False
-    cfg.setdefault("telemetry", {})["prometheus"] = False
-    resilience = cfg.setdefault("resilience", {})
-    resilience["faults"] = dict(faults or {})
-    return cfg
-
-
-def _newest_committed_step(ckpt_dir: Path) -> int:
-    """Step of the newest verifying commit, 0 when none exists."""
-    from ..training.checkpoint import CheckpointManager
-
-    newest = CheckpointManager(ckpt_dir).latest_valid_checkpoint()
-    if newest is None:
-        return 0
-    return int(newest.stem.split("_")[1])
+    """One chaos segment's config (harness.derive_segment_config with this
+    drill's historical signature — kept because tests and docs pin it)."""
+    return derive_segment_config(
+        resolved,
+        root_dir=root_dir,
+        max_steps=max_steps,
+        save_every=save_every,
+        log_every=log_every,
+        faults=faults,
+    )
 
 
 def _assert_newest_loadable(ckpt_dir: Path) -> int:
-    """Invariant: the newest committed checkpoint must load. Returns its
-    step (0 when the dir holds no checkpoints yet — a kill before the
-    first commit costs progress, not restorability)."""
-    from ..training.checkpoint import (
-        CheckpointManager,
-        read_manifest,
+    return _harness_assert_newest_loadable(ckpt_dir, error_cls=ChaosInvariantError)
+
+
+def _run_segment(cfg_path: Path, run_id: str, *, timeout_sec: float, label: str):
+    return run_train_segment(
+        cfg_path,
+        run_id,
+        timeout_sec=timeout_sec,
+        label=label,
+        error_cls=ChaosInvariantError,
     )
 
-    mgr = CheckpointManager(ckpt_dir)
-    if not mgr.all_checkpoints() and not mgr.all_manifests():
-        return 0
-    newest = mgr.latest_valid_checkpoint()
-    if newest is None:
-        raise ChaosInvariantError(
-            f"checkpoints exist under {ckpt_dir} but none verifies — "
-            "the run lost its ability to resume"
-        )
-    if read_manifest(newest) is None:
-        raise ChaosInvariantError(
-            f"selected checkpoint {newest.name} has no commit manifest"
-        )
-    payload = mgr.load(newest)  # raises CheckpointError on damage
-    return int(payload["step"])
 
-
-def _log_size(log_file: Path) -> int:
-    """Current byte length of the shared train.log (0 when absent) —
-    recorded before a segment launches so its restore point is read from
-    ITS appended region only."""
-    try:
-        return log_file.stat().st_size
-    except OSError:
-        return 0
-
-
-def _segment_resumed_step(log_file: Path, offset: int) -> int | None:
-    """The segment's launch-time restore point: the FIRST "resumed from"
-    line appended past ``offset``. First, not last — a mid-segment spike
-    rollback logs the same line for its restore, and mistaking that for
-    the auto-resume selection would fail the torn-selection invariant on
-    a correct run."""
-    try:
-        with log_file.open("rb") as fh:
-            fh.seek(offset)
-            text = fh.read().decode("utf-8", errors="replace")
-    except OSError:
-        return None
-    match = _RESUMED_RE.search(text)
-    if match is None:
-        return None
-    return int(match.group(2))
-
-
-def _trees_bitwise_equal(a: Any, b: Any, path: str = "") -> str | None:
-    """None when the (nested dict / array) trees match bitwise; otherwise
-    a human-readable path to the first mismatch."""
-    import numpy as np
-
-    if isinstance(a, dict) or isinstance(b, dict):
-        if not (isinstance(a, dict) and isinstance(b, dict)):
-            return f"{path}: node/leaf structure differs"
-        if sorted(a) != sorted(b):
-            return f"{path}: keys differ ({sorted(a)} vs {sorted(b)})"
-        for key in a:
-            sub = _trees_bitwise_equal(a[key], b[key], f"{path}/{key}")
-            if sub is not None:
-                return sub
-        return None
-    aa, bb = np.asarray(a), np.asarray(b)
-    if aa.dtype != bb.dtype or aa.shape != bb.shape:
-        return f"{path}: dtype/shape differ ({aa.dtype}{aa.shape} vs {bb.dtype}{bb.shape})"
-    if not np.array_equal(aa, bb, equal_nan=True):
-        return f"{path}: values differ"
-    return None
-
-
-def _run_segment(
-    cfg_path: Path, run_id: str, *, timeout_sec: float, label: str
-) -> subprocess.CompletedProcess:
-    cmd = [
-        sys.executable,
-        "-m",
-        "llmtrain_tpu",
-        "train",
-        "--config",
-        str(cfg_path),
-        "--run-id",
-        run_id,
-        "--auto-resume",
-        "--json",
-    ]
-    logger.info("chaos: launching %s segment (%s)", label, cfg_path.name)
-    try:
-        return subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_sec
-        )
-    except subprocess.TimeoutExpired as exc:
-        raise ChaosInvariantError(
-            f"{label} segment exceeded {timeout_sec:.0f}s — a resumed run "
-            "must make progress, not wedge"
-        ) from exc
-
-
-def _summary_of(proc: subprocess.CompletedProcess, label: str) -> dict[str, Any]:
-    for line in reversed((proc.stdout or "").splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except ValueError:
-                continue
-    raise ChaosInvariantError(
-        f"{label} segment (exit {proc.returncode}) printed no summary JSON; "
-        f"stderr tail: {(proc.stderr or '')[-2000:]}"
+def _summary_of(proc, label: str) -> dict[str, Any]:
+    return _harness_summary_of(
+        proc.stdout or "",
+        returncode=proc.returncode,
+        stderr=proc.stderr or "",
+        label=label,
+        error_cls=ChaosInvariantError,
     )
 
 
 def _next_save_boundary(last_step: int, save_every: int, max_steps: int) -> int | None:
-    boundary = ((last_step // save_every) + 1) * save_every
-    return boundary if boundary <= max_steps else None
+    return next_save_boundary(last_step, save_every, max_steps)
 
 
 def run_chaos(
@@ -248,9 +161,7 @@ def run_chaos(
     # Interval means are only comparable when every resume point (a save
     # boundary) is also a log boundary: pick the largest log cadence that
     # divides the save cadence.
-    log_every = cfg.trainer.log_every_steps
-    if save % log_every != 0:
-        log_every = save
+    log_every = aligned_log_every(save, cfg.trainer.log_every_steps)
     work = Path(work_dir) if work_dir is not None else Path(cfg.output.root_dir) / (
         f"chaos_{cfg.run.name}_s{seed}"
     )

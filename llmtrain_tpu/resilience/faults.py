@@ -11,8 +11,10 @@ drills on real clusters) exercise the ACTUAL recovery paths end to end:
 * ``spike_loss_at_step`` — one-shot host-side scaling of the observed loss,
   driving the real spike detector → checkpoint rollback. One-shot by
   design: the replayed step after the rollback must not re-spike.
-* ``sigterm_at_step`` — ``os.kill(os.getpid(), SIGTERM)``, driving the real
-  preemption handler, durable save, and clean exit.
+* ``sigterm_at_step`` / ``preempt_at_step`` — ``os.kill(os.getpid(),
+  SIGTERM)``, driving the real preemption handler, durable save, and clean
+  exit. ``preempt_at_step`` is the preemption-named twin the fleet storm
+  schedule uses (fleet/chaos.py); they share one one-shot delivery slot.
 * ``kill_at_step`` / ``kill_during_checkpoint`` — ``SIGKILL``, i.e. a real
   crash with zero cleanup; the during-checkpoint variant dies between a
   save's staged files and its manifest publish, driving the atomic-commit
@@ -153,12 +155,18 @@ class FaultPlan:
         — through the real OS signal path so the trainer's preemption
         handler (and nothing else) turns it into a durable save. Exact
         equality, not >=: a resumed run starting past the step must not
-        re-fire the injection."""
+        re-fire the injection. ``preempt_at_step`` is the same delivery
+        with the preemption-shaped name (the schema forbids setting both);
+        the telemetry instant is tagged with whichever knob fired."""
+        kind = "sigterm"
         at = self._cfg.sigterm_at_step
+        if at is None:
+            at = self._cfg.preempt_at_step
+            kind = "preempt"
         if at is None or self._sigterm_fired or step != at:
             return
         self._sigterm_fired = True
-        self._notify("sigterm", step)
+        self._notify(kind, step)
         logger.warning("fault injection: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
 

@@ -789,6 +789,11 @@ class Trainer:
 
         base_run_key = jax.random.key(cfg.run.seed)
         run_key = self._active_run_key(base_run_key)
+        # Per-step host throttle (trainer.extra.step_delay_sec): an
+        # emulation/testing knob that stretches wall-clock without touching
+        # the math — fleet preemption drills use it so externally delivered
+        # evictions reliably land while a tiny smoke model is mid-run.
+        step_delay = float(cfg.trainer.extra.get("step_delay_sec", 0.0) or 0.0)
         self._train_seqlen = self._probe_seqlen(train_ds)
         tokens_per_step = accum * self._global_micro * self._train_seqlen
         profiler = _StepProfiler(
@@ -971,6 +976,8 @@ class Trainer:
                     # stranded at this step and the watchdog must end the
                     # process (tests/test_watchdog.py, end to end).
                     self._faults.maybe_hang(step)
+                    if step_delay > 0.0:
+                        time.sleep(step_delay)
 
                     step_loss_dev = metrics["loss"]
                     nonfinite_dev = metrics.get("nonfinite_count")

@@ -322,3 +322,58 @@ class TestZeroConfig:
             RunConfig.model_validate(
                 {**MINIMAL, "trainer": {**MINIMAL["trainer"], "zero": zero}}
             )
+
+
+class TestFleetConfig:
+    """fleet: section (llmtrain_tpu/fleet/, docs/robustness.md "Fleet:
+    many tenants, shared capacity")."""
+
+    def test_defaults_are_an_empty_fleet(self):
+        cfg = RunConfig.model_validate(MINIMAL)
+        assert cfg.fleet.pool_devices == 2
+        assert cfg.fleet.tenants == []
+        assert cfg.fleet.preempt_grace_sec == 20.0
+
+    def test_tenants_with_quotas_and_overrides(self):
+        cfg = RunConfig.model_validate(
+            {
+                **MINIMAL,
+                "fleet": {
+                    "pool_devices": 4,
+                    "tenants": [
+                        {
+                            "name": "a",
+                            "priority": 2,
+                            "min_devices": 1,
+                            "max_devices": 4,
+                            "overrides": {"trainer": {"lr": 0.001}},
+                        },
+                        {"name": "b"},
+                    ],
+                },
+            }
+        )
+        assert cfg.fleet.tenants[0].max_devices == 4
+        assert cfg.fleet.tenants[0].overrides["trainer"]["lr"] == 0.001
+        assert cfg.fleet.tenants[1].min_devices == 1
+
+    @pytest.mark.parametrize(
+        "fleet",
+        [
+            # duplicate tenant names
+            {"tenants": [{"name": "x"}, {"name": "x"}]},
+            # quota below the floor
+            {"tenants": [{"name": "x", "min_devices": 3, "max_devices": 2}]},
+            # minimum can never fit the pool
+            {"pool_devices": 2, "tenants": [{"name": "x", "min_devices": 4,
+                                             "max_devices": 4}]},
+            # tenant names become run ids / directory names
+            {"tenants": [{"name": "../escape"}]},
+            {"tenants": [{"name": ""}]},
+            # unknown keys stay forbidden
+            {"bogus": 1},
+        ],
+    )
+    def test_rejections(self, fleet):
+        with pytest.raises(Exception):
+            RunConfig.model_validate({**MINIMAL, "fleet": fleet})

@@ -1,0 +1,669 @@
+"""Multi-tenant fleet supervisor tests (docs/robustness.md "Fleet: many
+tenants, shared capacity").
+
+Tier-1 keeps to pure units — the scheduling policy and the tenant state
+machine are deliberately pure functions/tables, the escalation-ladder
+test's child process never imports jax — so the additions cost
+milliseconds against the suite's kill budget. Everything that runs a
+real Trainer fit (the preemption-storm acceptance drill, the
+twice-evicted resume-count fairness pin, the elastic-resize exercise,
+the CLI round-trip) is ``@pytest.mark.slow`` under ``make verify-fleet``.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from llmtrain_tpu.config import RunConfig
+from llmtrain_tpu.fleet.policy import (
+    TenantDemand,
+    candidate_world_sizes,
+    plan_allocations,
+    priority_order,
+    within_bounds,
+)
+from llmtrain_tpu.fleet.tenant import (
+    BACKOFF,
+    COMPLETED,
+    PREEMPTING,
+    QUEUED,
+    RUNNING,
+    SUSPENDED,
+    InvalidTransitionError,
+    TenantStateMachine,
+)
+
+_FLEET_PRESET = Path(__file__).resolve().parents[1] / "configs" / "presets" / (
+    "gpt_fleet_smoke.yaml"
+)
+
+
+# --------------------------------------------------------------------------
+# scheduling policy (pure, table-driven)
+# --------------------------------------------------------------------------
+
+
+class TestSchedulingPolicy:
+    def test_candidate_sizes_are_divisors_within_bounds(self):
+        assert candidate_world_sizes(8, 1, 4) == (1, 2, 4)
+        assert candidate_world_sizes(6, 2, 6) == (2, 3, 6)
+        assert candidate_world_sizes(2, 1, 2) == (1, 2)
+
+    def test_infeasible_window_is_a_config_error(self):
+        with pytest.raises(ValueError, match="divides the"):
+            candidate_world_sizes(4, 3, 3)
+
+    @pytest.mark.parametrize(
+        "pool,demands,expected,suspended",
+        [
+            # Everyone fits at minimum; no slack to grow.
+            (
+                3,
+                [("a", 1, (1, 2)), ("b", 0, (1,)), ("c", 0, (1,))],
+                {"a": 1, "b": 1, "c": 1},
+                (),
+            ),
+            # Slack grows the highest-priority tenant first.
+            (
+                4,
+                [("a", 1, (1, 2)), ("b", 0, (1, 2)), ("c", 0, (1,))],
+                {"a": 2, "b": 1, "c": 1},
+                (),
+            ),
+            # Round-robin growth: spare devices spread by priority, one
+            # feasibility step per turn.
+            (
+                6,
+                [("a", 1, (1, 2, 4)), ("b", 0, (1, 2))],
+                {"a": 4, "b": 2},
+                (),
+            ),
+            # Shrink-before-suspend: the pool no longer fits every
+            # minimum; the LOWEST priority tenant suspends, nobody
+            # crashes, nobody exceeds a quota.
+            (
+                2,
+                [("a", 2, (1, 2)), ("b", 1, (1,)), ("c", 0, (1,))],
+                {"a": 1, "b": 1, "c": 0},
+                ("c",),
+            ),
+            # Priority ties break by name — deterministic, not dict-order.
+            (
+                1,
+                [("zeta", 0, (1,)), ("alpha", 0, (1,))],
+                {"alpha": 1, "zeta": 0},
+                ("zeta",),
+            ),
+            # Capacity zero suspends the whole fleet (drain), no errors.
+            (
+                0,
+                [("a", 1, (1,)), ("b", 0, (1,))],
+                {"a": 0, "b": 0},
+                ("a", "b"),
+            ),
+            # Feasibility gaps are respected: with sizes (1, 4) and one
+            # spare device the tenant stays at 1 — 2 and 3 would break
+            # the elastic divisor contract.
+            (
+                3,
+                [("a", 1, (1, 4)), ("b", 0, (1,))],
+                {"a": 1, "b": 1},
+                (),
+            ),
+        ],
+    )
+    def test_allocation_table(self, pool, demands, expected, suspended):
+        plan = plan_allocations(
+            pool,
+            [TenantDemand(n, p, sizes) for n, p, sizes in demands],
+        )
+        assert plan.allocations == expected
+        assert plan.suspended == suspended
+        assert sum(plan.allocations.values()) <= pool
+
+    def test_non_runnable_tenants_hold_no_devices(self):
+        plan = plan_allocations(
+            2,
+            [
+                TenantDemand("done", 5, (1, 2), runnable=False),
+                TenantDemand("live", 0, (1, 2)),
+            ],
+        )
+        assert plan.allocations == {"done": 0, "live": 2}
+
+    def test_priority_order_is_deterministic(self):
+        demands = [TenantDemand(n, 0, (1,)) for n in ("b", "a", "c")]
+        assert [d.name for d in priority_order(demands)] == ["a", "b", "c"]
+
+    def test_within_bounds(self):
+        d = TenantDemand("a", 0, (1, 2, 4))
+        assert within_bounds(0, d) and within_bounds(2, d)
+        assert not within_bounds(3, d) and not within_bounds(8, d)
+
+
+# --------------------------------------------------------------------------
+# tenant state machine
+# --------------------------------------------------------------------------
+
+
+class TestTenantStateMachine:
+    def test_happy_path_with_eviction_cycle(self):
+        sm = TenantStateMachine("t")
+        for to in (RUNNING, PREEMPTING, BACKOFF, RUNNING, PREEMPTING,
+                   SUSPENDED, RUNNING, COMPLETED):
+            sm.transition(to, "test")
+        assert sm.state == COMPLETED and sm.terminal
+        assert [s for s, _ in sm.history][0] == QUEUED
+
+    @pytest.mark.parametrize(
+        "path,bad",
+        [
+            ((), PREEMPTING),            # queued cannot preempt
+            ((), COMPLETED),             # queued cannot complete
+            ((RUNNING, COMPLETED), RUNNING),   # terminal is terminal
+            ((RUNNING, PREEMPTING), RUNNING),  # must exit first
+            ((RUNNING, BACKOFF), PREEMPTING),  # nothing to preempt
+        ],
+    )
+    def test_illegal_transitions_raise(self, path, bad):
+        sm = TenantStateMachine("t")
+        for to in path:
+            sm.transition(to, "setup")
+        with pytest.raises(InvalidTransitionError):
+            sm.transition(bad, "illegal")
+
+    def test_unknown_state_raises(self):
+        with pytest.raises(InvalidTransitionError):
+            TenantStateMachine("t").transition("zombie")
+
+
+# --------------------------------------------------------------------------
+# supervisor units (no training subprocesses)
+# --------------------------------------------------------------------------
+
+
+def _fleet_cfg(**fleet_overrides):
+    raw = yaml.safe_load(_FLEET_PRESET.read_text())
+    raw.setdefault("fleet", {}).update(fleet_overrides)
+    return RunConfig.model_validate(raw), raw
+
+
+def _make_supervisor(tmp_path, **fleet_overrides):
+    from llmtrain_tpu.fleet.supervisor import FleetSupervisor
+
+    cfg, raw = _fleet_cfg(**fleet_overrides)
+    return FleetSupervisor(cfg, raw, work_dir=tmp_path / "fleet", seed=0)
+
+
+class TestSupervisorUnits:
+    def test_child_env_replaces_forced_device_count(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_force_host_platform_device_count=8 --xla_foo=1",
+        )
+        sup = _make_supervisor(tmp_path)
+        env = sup._child_env(2)
+        assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+        assert "device_count=8" not in env["XLA_FLAGS"]
+        assert "--xla_foo=1" in env["XLA_FLAGS"]  # unrelated flags survive
+        assert env["JAX_PLATFORMS"] == "cpu"
+
+    def test_tenant_base_config_pins_cadence_and_overrides(self, tmp_path):
+        sup = _make_supervisor(tmp_path)
+        base_b = sup.tenants["tenant-b"].base_config
+        assert base_b["trainer"]["lr"] == 0.001  # tenant override applied
+        assert base_b["model"]["extra"]["lora"]["rank"] == 4
+        assert base_b["model"]["extra"]["tokenizer"] == "byte"  # base kept
+        assert base_b["mlflow"]["enabled"] is False
+        assert base_b["resilience"]["watchdog"]["enabled"] is True
+        assert base_b["trainer"]["save_every_steps"] % base_b["trainer"][
+            "log_every_steps"
+        ] == 0
+        assert base_b["logging"]["log_to_file"] is True
+        assert "fleet" not in base_b  # tenants do not recurse
+
+    def test_production_derive_keeps_cadence_eval_and_tracker(self, tmp_path):
+        """Drill semantics (pinned cadence, eval pushed to the end,
+        trackers off) apply only under drill=True or explicit cadence
+        overrides — a plain production fleet run must respect each
+        tenant's own config (telemetry.prometheus stays off either way:
+        the FLEET owns the /metrics port)."""
+        from llmtrain_tpu.fleet.supervisor import FleetSupervisor
+
+        raw = yaml.safe_load(_FLEET_PRESET.read_text())
+        raw["trainer"]["max_steps"] = 120
+        raw["trainer"]["save_every_steps"] = 100
+        raw["trainer"]["eval_every_steps"] = 10
+        raw["mlflow"] = {"enabled": True}
+        cfg = RunConfig.model_validate(raw)
+        prod = FleetSupervisor(cfg, raw, work_dir=tmp_path / "prod", seed=0)
+        base = prod.tenants["tenant-a"].base_config
+        assert base["trainer"]["save_every_steps"] == 100
+        assert base["trainer"]["eval_every_steps"] == 10
+        assert base["mlflow"]["enabled"] is True
+        assert base["telemetry"]["prometheus"] is False
+        drill = FleetSupervisor(
+            cfg, raw, work_dir=tmp_path / "drill", seed=0, drill=True
+        )
+        dbase = drill.tenants["tenant-a"].base_config
+        assert dbase["trainer"]["save_every_steps"] == 40  # clamped to steps//3
+        assert dbase["trainer"]["eval_every_steps"] == 120  # pushed to the end
+        assert dbase["mlflow"]["enabled"] is False
+
+    def test_segment_config_scales_micro_batch_inversely(self, tmp_path):
+        sup = _make_supervisor(tmp_path)
+        t = sup.tenants["tenant-a"]
+        path = sup._write_segment_cfg(t, 0, 2, {"kill_at_step": 5})
+        seg = yaml.safe_load(path.read_text())
+        assert seg["trainer"]["micro_batch_size"] * 2 == t.global_micro
+        assert seg["resilience"]["faults"] == {"kill_at_step": 5}
+
+    def test_launch_outside_bounds_is_an_invariant_error(self, tmp_path):
+        from llmtrain_tpu.fleet.supervisor import FleetInvariantError
+
+        sup = _make_supervisor(tmp_path)
+        with pytest.raises(FleetInvariantError, match="bounds"):
+            sup._launch(sup.tenants["tenant-b"], 3)
+
+    def test_escalation_ladder_sigkills_a_term_ignoring_tenant(self, tmp_path):
+        """Rung 2 for real: the 'tenant' traps SIGTERM and refuses to die;
+        past the grace deadline the supervisor SIGKILLs it. The child is a
+        bare python -c (no jax) so this stays tier-1 cheap."""
+        sup = _make_supervisor(tmp_path, preempt_grace_sec=0.3)
+        t = sup.tenants["tenant-a"]
+        t.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import signal, time; "
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                "print('up', flush=True); time.sleep(60)",
+            ],
+            stdout=subprocess.PIPE,
+        )
+        assert t.proc.stdout.readline().strip() == b"up"  # handler installed
+        t.sm.transition(RUNNING, "test")
+        sup._preempt(t, reason="test evict")
+        assert t.sm.state == PREEMPTING
+        deadline = time.monotonic() + 10.0
+        while t.proc.poll() is None and time.monotonic() < deadline:
+            sup._escalate_overdue(time.monotonic())
+            time.sleep(0.05)
+        assert t.proc.poll() == -signal.SIGKILL
+        assert t.counts["escalations"] == 1
+
+    def test_backoff_delays_are_seeded_and_bounded(self, tmp_path):
+        sup_a = _make_supervisor(tmp_path / "a")
+        sup_b = _make_supervisor(tmp_path / "b")
+        da = [sup_a._backoff_delay(sup_a.tenants["tenant-a"]) for _ in range(4)]
+        db = [sup_b._backoff_delay(sup_b.tenants["tenant-a"]) for _ in range(4)]
+        assert da == db  # same seed -> same full-jitter schedule
+        assert all(0.0 <= d <= sup_a._fleet.respawn_backoff_max_sec for d in da)
+        # Different tenants draw different (decorrelated) streams.
+        assert da != [
+            sup_a._backoff_delay(sup_a.tenants["tenant-b"]) for _ in range(4)
+        ]
+
+    def test_render_fleet_report_md(self, tmp_path):
+        from llmtrain_tpu.fleet.supervisor import render_fleet_report_md
+
+        md = render_fleet_report_md(
+            {
+                "pool_devices": 2,
+                "capacity_changes": 2,
+                "wall_time_sec": 1.0,
+                "seed": 0,
+                "totals": {
+                    "completed": 1,
+                    "failed": 0,
+                    "evictions": 3,
+                    "escalations": 1,
+                    "respawns": 3,
+                    "resizes": 1,
+                    "suspensions": 1,
+                },
+                "tenants": {
+                    "a": {
+                        "state": "completed",
+                        "priority": 1,
+                        "min_devices": 1,
+                        "max_devices": 2,
+                        "segments": 4,
+                        "evictions": {"total": 3},
+                        "respawns": 3,
+                        "resume_count": 2,
+                        "final_step": 12,
+                        "final_loss": 3.25,
+                    }
+                },
+            }
+        )
+        assert "| a | completed |" in md and "| 3 | 3 | 2 | 12 | 3.25 |" in md
+
+
+# --------------------------------------------------------------------------
+# preempt_at_step fault + partial-interval comparison rule
+# --------------------------------------------------------------------------
+
+
+class TestPreemptFault:
+    def test_preempt_at_step_delivers_real_sigterm_once(self):
+        from llmtrain_tpu.config.schemas import FaultInjectionConfig
+        from llmtrain_tpu.resilience.faults import FaultPlan
+
+        hits: list[int] = []
+        old = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+        try:
+            plan = FaultPlan(FaultInjectionConfig(preempt_at_step=3))
+            fired: list[tuple[str, int]] = []
+            plan.observer = lambda kind, step: fired.append((kind, step))
+            plan.maybe_sigterm(2)
+            assert hits == []  # exact step only, never >=
+            plan.maybe_sigterm(3)
+            assert hits == [signal.SIGTERM]
+            plan.maybe_sigterm(3)
+            plan.maybe_sigterm(4)
+            assert hits == [signal.SIGTERM]  # one-shot
+            assert fired == [("preempt", 3)]  # telemetry names the knob
+        finally:
+            signal.signal(signal.SIGTERM, old)
+
+    def test_preempt_and_sigterm_are_mutually_exclusive(self):
+        from llmtrain_tpu.config.schemas import FaultInjectionConfig
+
+        with pytest.raises(Exception, match="exactly one"):
+            FaultInjectionConfig(preempt_at_step=3, sigterm_at_step=4)
+
+
+class TestPartialIntervalRule:
+    @pytest.mark.parametrize(
+        "resumed,log_every,expected",
+        [
+            (None, 3, None),   # fresh run: every interval is full
+            (6, 3, None),      # aligned resume: every interval is full
+            (7, 3, 9),         # mid-interval: only the next boundary skips
+            (8, 3, 9),
+            (4, 5, 5),
+            (0, 3, None),
+        ],
+    )
+    def test_partial_interval_step(self, resumed, log_every, expected):
+        from llmtrain_tpu.fleet.chaos import partial_interval_step
+
+        assert partial_interval_step(resumed, log_every) == expected
+
+
+# --------------------------------------------------------------------------
+# shared drill harness (the chaos.py extraction satellite)
+# --------------------------------------------------------------------------
+
+
+class TestSharedHarness:
+    def test_chaos_module_reexports_the_shared_helpers(self):
+        """`llmtrain chaos` keeps its contract: the historical private
+        names still resolve (tests and docs pin them) and now come from
+        the shared harness the fleet drill imports."""
+        from llmtrain_tpu.resilience import chaos, harness
+
+        assert chaos._trees_bitwise_equal is harness.trees_bitwise_equal
+        assert chaos._newest_committed_step is harness.newest_committed_step
+        assert chaos._segment_resumed_step is harness.segment_resumed_step
+        assert issubclass(chaos.ChaosInvariantError, harness.DrillInvariantError)
+
+    def test_derive_segment_config_merges_overrides_deep(self):
+        from llmtrain_tpu.resilience.harness import derive_segment_config
+
+        derived = derive_segment_config(
+            {
+                "trainer": {"lr": 0.1, "max_steps": 99},
+                "model": {"extra": {"tokenizer": "byte"}},
+                "mlflow": {"enabled": True},
+            },
+            root_dir="/tmp/x",
+            max_steps=10,
+            save_every=5,
+            log_every=5,
+            faults={"kill_at_step": 7},
+            overrides={"trainer": {"lr": 0.2}, "model": {"extra": {"lora": {"rank": 2}}}},
+        )
+        assert derived["trainer"]["lr"] == 0.2
+        assert derived["trainer"]["max_steps"] == 10  # cadence pin wins
+        assert derived["model"]["extra"] == {
+            "tokenizer": "byte",
+            "lora": {"rank": 2},
+        }
+        assert derived["mlflow"]["enabled"] is False
+        assert derived["resilience"]["faults"] == {"kill_at_step": 7}
+
+    @pytest.mark.parametrize(
+        "save,log,expected", [(6, 3, 3), (6, 4, 6), (5, 5, 5), (4, 8, 4)]
+    )
+    def test_aligned_log_every(self, save, log, expected):
+        from llmtrain_tpu.resilience.harness import aligned_log_every
+
+        assert aligned_log_every(save, log) == expected
+
+
+# --------------------------------------------------------------------------
+# the drills (slow: real train subprocesses; `make verify-fleet`)
+# --------------------------------------------------------------------------
+
+
+def _three_tenant_storm_cfg(tmp_path: Path) -> Path:
+    """The acceptance shape: >= 3 tenants on a shared pool, all FIXED world
+    size so every tenant is held to bitwise parity (docs/robustness.md —
+    resizing reorders float reductions and is exercised separately)."""
+    raw = yaml.safe_load(_FLEET_PRESET.read_text())
+    raw["fleet"] = {
+        "pool_devices": 3,
+        "preempt_grace_sec": 20.0,
+        "tenants": [
+            {"name": "alpha", "priority": 2, "min_devices": 1, "max_devices": 1},
+            {
+                "name": "bravo",
+                "priority": 1,
+                "min_devices": 1,
+                "max_devices": 1,
+                "overrides": {"trainer": {"lr": 0.001}},
+            },
+            {
+                "name": "charlie",
+                "priority": 0,
+                "min_devices": 1,
+                "max_devices": 1,
+                "overrides": {
+                    "model": {"extra": {"lora": {"rank": 4, "alpha": 8}}}
+                },
+            },
+        ],
+    }
+    path = tmp_path / "storm3.yaml"
+    path.write_text(yaml.safe_dump(raw, sort_keys=False), encoding="utf-8")
+    return path
+
+
+@pytest.mark.slow
+class TestFleetStormDrill:
+    def test_three_tenant_storm_is_bitwise_recoverable(self, tmp_path):
+        """THE acceptance drill: seeded capacity drop + random evictions +
+        one mid-checkpoint kill across 3 tenants; every tenant's loss
+        trajectory and final param/opt tree must come out bitwise-equal to
+        its uninterrupted reference, resume/eviction counts land in
+        fleet_report.json, and no tenant ever ran outside its
+        [min_devices, quota] bounds (run_fleet_storm raises
+        FleetInvariantError on any violation)."""
+        from llmtrain_tpu.fleet.chaos import run_fleet_storm
+
+        result = run_fleet_storm(
+            _three_tenant_storm_cfg(tmp_path),
+            seed=1,
+            work_dir=tmp_path / "storm",
+            timeout_sec=600.0,
+        )
+        assert result["bitwise_match"] is True
+        assert len(result["tenants"]) == 3
+        assert result["total_evictions"] >= 3
+        assert result["capacity_changes"] >= 2  # drop AND restore happened
+        assert result["total_suspensions"] >= 1  # the drop bit somebody
+        assert result["mid_checkpoint_kill_tenant"]
+        for name, r in result["tenants"].items():
+            assert r["parity"] == "bitwise", name
+            assert r["evictions"]["total"] >= 1, name
+            assert r["resume_count"] >= 1, name
+            assert r["trajectory_points_compared"] >= 1, name
+        report = json.loads(
+            Path(result["fleet_report_json"]).read_text()
+        )
+        for name, v in report["tenants"].items():
+            assert v["state"] == "completed"
+            # Bounds invariant over the whole allocation history.
+            assert all(a == 1 for a in v["allocations"]), (name, v["allocations"])
+
+    def test_twice_evicted_tenant_accumulates_resume_count(self, tmp_path):
+        """The resume-count fairness pin: the supervisor's respawns reuse
+        the tenant's --auto-resume run dir, so a twice-evicted tenant
+        reports resilience.resume_count == 2 in its OWN report.json (each
+        graceful eviction's preemption save persists the incremented
+        counter for the next segment to inherit)."""
+        from llmtrain_tpu.fleet.supervisor import FleetSupervisor
+
+        raw = yaml.safe_load(_FLEET_PRESET.read_text())
+        raw["fleet"] = {
+            "pool_devices": 1,
+            "preempt_grace_sec": 20.0,
+            "tenants": [
+                {"name": "solo", "priority": 0, "min_devices": 1, "max_devices": 1}
+            ],
+        }
+        cfg = RunConfig.model_validate(raw)
+        sup = FleetSupervisor(
+            cfg,
+            raw,
+            work_dir=tmp_path / "fair",
+            seed=3,
+            extra_tenant_overrides={
+                "trainer": {"extra": {"step_delay_sec": 0.2}}
+            },
+        )
+        state = {"evicted": 0, "gate": 0}
+
+        def controller(s: FleetSupervisor) -> None:
+            t = s.tenants["solo"]
+            if (
+                state["evicted"] < 2
+                and t.sm.state == "running"
+                and t.segments
+                and time.monotonic() - t.segments[-1]["started_at"] >= 2.5
+                and s.newest_commit("solo") > state["gate"]
+                and s.request_eviction("solo", "graceful")
+            ):
+                state["evicted"] += 1
+                state["gate"] = s.newest_commit("solo")
+
+        report = sup.run(timeout_sec=300.0, on_tick=controller)
+        view = report["tenants"]["solo"]
+        assert view["state"] == "completed"
+        assert state["evicted"] == 2
+        assert view["evictions"]["graceful"] == 2
+        assert view["resume_count"] == 2
+        run_report = json.loads(
+            (sup.work_dir / "runs" / "solo" / "report.json").read_text()
+        )
+        assert run_report["resilience"]["resume_count"] == 2
+
+    def test_capacity_growth_triggers_elastic_resize(self, tmp_path):
+        """Grow/shrink through topology-change resume: a short-lived
+        neighbor completes, the freed device grows tenant-a 1 -> 2 via
+        preempt + respawn, and the resumed run carries the SAME trajectory
+        through the elastic re-shard (supervisor invariants stay on; the
+        parity bar for resized tenants is the elastic contract's, not
+        bitwise — docs/robustness.md)."""
+        from llmtrain_tpu.fleet.supervisor import FleetSupervisor
+
+        raw = yaml.safe_load(_FLEET_PRESET.read_text())
+        raw["trainer"]["max_steps"] = 18
+        raw["fleet"] = {
+            "pool_devices": 2,
+            "preempt_grace_sec": 20.0,
+            "tenants": [
+                {"name": "grower", "priority": 1, "min_devices": 1,
+                 "max_devices": 2},
+                {
+                    "name": "shortlived",
+                    "priority": 0,
+                    "min_devices": 1,
+                    "max_devices": 1,
+                    "overrides": {"trainer": {"max_steps": 6}},
+                },
+            ],
+        }
+        cfg = RunConfig.model_validate(raw)
+        sup = FleetSupervisor(
+            cfg,
+            raw,
+            work_dir=tmp_path / "resize",
+            seed=5,
+            extra_tenant_overrides={
+                "trainer": {"extra": {"step_delay_sec": 0.25}}
+            },
+        )
+        report = sup.run(timeout_sec=300.0)
+        grower = report["tenants"]["grower"]
+        assert grower["state"] == "completed"
+        assert grower["resizes"] >= 1
+        assert 2 in grower["allocations"]  # actually ran on the grown slice
+        assert grower["final_step"] == 18
+        assert report["tenants"]["shortlived"]["state"] == "completed"
+
+    def test_fleet_cli_round_trip(self, tmp_path):
+        """`llmtrain fleet` end to end over the shipped preset: exit 0,
+        every tenant completed, fleet_report.json + .md + the Prometheus
+        textfile written."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "llmtrain_tpu",
+                "fleet",
+                "--config",
+                str(_FLEET_PRESET),
+                "--work-dir",
+                str(tmp_path / "cli"),
+                "--max-steps",
+                "6",
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["totals"]["completed"] == 2
+        work = tmp_path / "cli"
+        assert (work / "fleet_report.json").is_file()
+        assert (work / "fleet_report.md").is_file()
+        prom = (work / "fleet_metrics.prom").read_text()
+        assert "llmtrain_fleet_pool_devices" in prom
+        assert "llmtrain_fleet_tenants_completed" in prom
+
+    def test_cli_rejects_fleetless_config(self):
+        from llmtrain_tpu import cli
+        from llmtrain_tpu.resilience.exit_codes import EXIT_CONFIG_ERROR
+
+        rc = cli.main(
+            [
+                "fleet",
+                "--config",
+                str(_FLEET_PRESET.parent / "gpt_smoke.yaml"),
+            ]
+        )
+        assert rc == EXIT_CONFIG_ERROR
