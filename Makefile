@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -71,6 +71,15 @@ verify-fleet:
 # failing-tracker degrade-to-warning regression.
 verify-telemetry:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -m "not slow"
+
+# Cost-attribution + roofline suite (docs/observability.md "Attribution and
+# rooflines"): XLA cost-table extraction, HLO top-ops parsing, roofline
+# classification, MFU reconciliation, serve-latency percentile gauges, and
+# the perf_gate regression rules (self-test included). The slow e2e pieces
+# (fit-path attribution, `llmtrain profile` CLI) ride `make test-all`.
+verify-profile:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py -q -m "not slow"
+	python tools/perf_gate.py --self-test
 
 # Continuous-batching serving suite (docs/serving.md): paged-KV pool
 # invariants, batched-vs-generate() bitwise parity (greedy, per-request

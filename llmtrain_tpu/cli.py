@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 from typing import Any
 
@@ -552,6 +553,42 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-segment wall-clock budget",
     )
     chaos.add_argument("--json", action="store_true", help="emit the result as JSON")
+
+    profile = sub.add_parser(
+        "profile",
+        help="N-step cost probe: XLA cost_analysis + roofline attribution "
+        "of the jitted train step (and the paged serving buckets with "
+        "--serve) written as profile_report.json "
+        "(telemetry/profiling.py, docs/observability.md)",
+    )
+    profile.add_argument("--config", required=True, help="path to the YAML run config")
+    profile.add_argument(
+        "--steps",
+        type=int,
+        default=3,
+        help="probe training steps to run for measured step time (default 3)",
+    )
+    profile.add_argument(
+        "--serve",
+        action="store_true",
+        help="also AOT-profile the paged prefill/decode programs at their "
+        "largest shape buckets (abstract shapes; no checkpoint needed)",
+    )
+    profile.add_argument(
+        "--top-k",
+        type=int,
+        default=10,
+        help="HLO op-category rows in each executable's top-ops table",
+    )
+    profile.add_argument(
+        "--output",
+        default=None,
+        help="report path (default {output.root_dir}/profile_{run.name}/"
+        "profile_report.json)",
+    )
+    profile.add_argument(
+        "--json", action="store_true", help="print the full report JSON to stdout"
+    )
 
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
@@ -1260,9 +1297,20 @@ def _build_serving_backend(
     """
     from .serving import ContinuousBatchingScheduler, PagedDecodeEngine
     from .telemetry.registry import MetricsRegistry
+    from .telemetry.timeline import EventTimeline
 
     scfg = cfg.serving
     registry = MetricsRegistry(None)
+    # Serving timeline: request-id-tagged queue-wait/prefill/decode spans
+    # (scheduler.py). Memory-only here; serve-bench exports the Perfetto
+    # trace next to its report.
+    timeline = None
+    if cfg.telemetry.enabled and cfg.telemetry.timeline:
+        timeline = EventTimeline(
+            None,
+            max_events=cfg.telemetry.max_events,
+            xprof_annotations=cfg.telemetry.xprof_annotations,
+        )
     policy = "speculative" if args.draft_config is not None else scfg.policy
     if policy == "speculative":
         if args.draft_config is None or args.draft_from is None:
@@ -1302,6 +1350,7 @@ def _build_serving_backend(
             draft_model=draft_model,
             draft_params=draft_params,
             gamma=args.gamma if args.gamma is not None else scfg.speculative_gamma,
+            timeline=timeline,
         )
     else:
         engine = PagedDecodeEngine(
@@ -1322,7 +1371,9 @@ def _build_serving_backend(
             engine.prompt_buckets,
             engine.batch_buckets,
         )
-        scheduler = ContinuousBatchingScheduler(engine, registry=registry)
+        scheduler = ContinuousBatchingScheduler(
+            engine, registry=registry, timeline=timeline
+        )
     return scheduler, registry
 
 
@@ -1630,20 +1681,25 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
         from .telemetry.timeline import EventTimeline
 
         out_dir = Path(args.out or (Path(cfg.output.root_dir) / "serve_bench"))
+        # The scheduler's request-id-tagged timeline (queue_wait → prefill
+        # → decode spans) feeds the report AND a Perfetto-loadable trace.
+        timeline = getattr(scheduler, "timeline", None) or EventTimeline(None)
         report = build_report(
             run_id="serve-bench",
             run_name=cfg.run.name,
             registry=registry,
-            timeline=EventTimeline(None),
+            timeline=timeline,
             memory=None,
             wall_time_sec=block["throughput"]["wall_sec"],
             serving=block,
         )
         json_path, md_path = write_reports(out_dir, report)
+        trace_path = timeline.export_perfetto(out_dir / "trace.json")
         summary = {
             "serving": block,
             "report_json": str(json_path) if json_path else None,
             "report_md": str(md_path) if md_path else None,
+            "trace_json": str(trace_path) if trace_path else None,
             "ok": not failures,
         }
         if failures:
@@ -2189,6 +2245,237 @@ def _handle_fleet(args: argparse.Namespace) -> int:
         return exit_code_for_exception(exc)
 
 
+def _handle_profile(args: argparse.Namespace) -> int:
+    """N-step cost probe → ``profile_report.json`` (docs/observability.md).
+
+    Runs ``--steps`` real training steps on the config (run-dir-less, so
+    no checkpoints/reports are written), then AOT-lowers AND -compiles the
+    jitted train step to mine XLA's cost_analysis, the per-op HLO table,
+    compile wall-times and the compiled memory footprint — the probe-run
+    signal ``llmtrain tune`` (ROADMAP item 3) will sweep over. ``--serve``
+    additionally profiles the paged prefill/decode programs at their
+    largest shape buckets against abstract parameters (no checkpoint
+    needed; nothing executes).
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    if args.steps < 1:
+        _emit_error("--steps must be >= 1")
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    initialize_registries()
+    try:
+        get_model_adapter(cfg.model.name)
+        get_data_module(cfg.data.name)
+    except RegistryError as exc:
+        _emit_error(str(exc))
+        return EXIT_CONFIG_ERROR
+
+    # Probe config: N steps, every boundary logged, no endpoint bind, no
+    # competing report/attribution work (the profile builds its own).
+    # Config models are frozen — rebuild through validation.
+    dump = cfg.model_dump()
+    dump["trainer"]["max_steps"] = args.steps
+    dump["trainer"]["log_every_steps"] = 1
+    dump["telemetry"]["prometheus"] = False
+    dump["telemetry"]["report"] = False
+    dump["telemetry"]["perf_attribution"] = False
+    probe_cfg = type(cfg).model_validate(dump)
+
+    import jax
+
+    from .telemetry import profiling
+    from .training import Trainer
+    from .utils.hw import transformer_flops_per_token
+
+    try:
+        trainer = Trainer(probe_cfg, run_dir=None, tracker=None)
+        t0 = time.perf_counter()
+        result = trainer.fit()
+        probe_wall = time.perf_counter() - t0
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        logger.exception("profile probe run failed: %s", exc)
+        _emit_error(f"profile probe run failed: {exc}")
+        return exit_code_for_exception(exc)
+
+    peaks = profiling.resolve_peaks(None, cfg.telemetry.device_peaks)
+    latest = {k: v[0] for k, v in trainer._telemetry.metrics.latest().items()}
+    step_time_sec = latest.get("train/step_time_sec") or 0.0
+    run_key = jax.random.key(cfg.run.seed)
+
+    executables: list[dict[str, Any]] = []
+    if trainer._batch_struct is not None:
+        train_prof = profiling.aot_profile(
+            trainer._jit_train_step,
+            (trainer._state, trainer._batch_struct, run_key),
+            name="train_step",
+            peaks=peaks,
+            collective_bytes=profiling.gradient_collective_bytes(
+                {a: s for a, s in trainer._mesh.shape.items()},
+                float(trainer._trainable_count) * 4.0,
+            ),
+            top_k=args.top_k,
+            n_chips=int(trainer._mesh.devices.size),
+        )
+        if train_prof is not None:
+            executables.append(train_prof)
+
+    if args.serve:
+        executables += _profile_serving_buckets(
+            cfg, peaks=peaks, top_k=args.top_k, logger=logger
+        )
+
+    if not executables:
+        _emit_error("no executable could be profiled (see logs)")
+        return EXIT_TRAIN_FAILURE
+
+    palm = transformer_flops_per_token(
+        n_params=trainer._param_count,
+        n_layers=cfg.model.n_layers,
+        seq_len=trainer._train_seqlen,
+        d_model=cfg.model.d_model,
+        n_trainable_params=trainer._trainable_count,
+    )
+    attribution = profiling.build_perf_attribution(
+        executables=executables,
+        peaks=peaks,
+        n_chips=int(trainer._mesh.devices.size),
+        step_time_ms=step_time_sec * 1e3 if step_time_sec > 0 else None,
+        tokens_per_step=float(trainer._tokens_per_step) or None,
+        palm_flops_per_token=palm,
+        measured_mfu=latest.get("train/mfu"),
+        span_totals=trainer._telemetry.timeline.span_totals(),
+        steps=args.steps,
+    )
+
+    # HBM footprint, two views side by side: the memory monitor's live
+    # accounting during the probe vs the compiled executable's static
+    # buffer analysis — disagreement localizes fragmentation/runtime
+    # overhead vs model-inherent footprint.
+    memory_block: dict[str, Any] = {}
+    if trainer._telemetry.memory is not None:
+        memory_block["monitor_peaks"] = dict(trainer._telemetry.memory.peaks())
+        memory_block["monitor_source"] = trainer._telemetry.memory.source
+    primary_mem = (executables[0].get("memory") or {}) if executables else {}
+    if primary_mem:
+        memory_block["compiled_train_step"] = primary_mem
+
+    report = {
+        "schema": "llmtrain-profile-report/1",
+        "config": str(args.config),
+        "run_name": cfg.run.name,
+        "device_kind": peaks.get("device_kind", "unknown"),
+        "n_devices": int(trainer._mesh.devices.size),
+        "peaks": {k: peaks[k] for k in ("peak_flops", "hbm_bytes_per_sec", "ici_bytes_per_sec")},
+        "probe": {
+            "steps": args.steps,
+            "wall_time_sec": round(probe_wall, 3),
+            "step_time_ms": round(step_time_sec * 1e3, 3),
+            "tokens_per_sec": latest.get("train/tokens_per_sec"),
+            "mfu_measured": latest.get("train/mfu"),
+            "final_loss": result.final_loss,
+        },
+        "executables": executables,
+        "perf_attribution": attribution,
+        "memory": memory_block,
+    }
+
+    if args.output is not None:
+        out_path = Path(args.output)
+    else:
+        out_path = (
+            Path(cfg.output.root_dir)
+            / f"profile_{cfg.run.name}"
+            / "profile_report.json"
+        )
+    try:
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(
+            json.dumps(report, indent=2, sort_keys=False), encoding="utf-8"
+        )
+    except (OSError, TypeError, ValueError) as exc:
+        _emit_error(f"writing {out_path} failed: {exc}")
+        return EXIT_TRAIN_FAILURE
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        lines = [f"profile report: {out_path}"]
+        for exe in executables:
+            roof = exe.get("roofline") or {}
+            lines.append(
+                f"  {exe['name']}: {exe.get('flops', 0.0):.3g} flops, "
+                f"{exe.get('bytes_accessed', 0.0):.3g} bytes, "
+                f"compile {exe.get('compile_time_s', 0.0):.2f}s → "
+                f"{roof.get('class', '?')}-bound"
+            )
+            for row in profiling.render_top_ops_markdown(exe.get("top_ops") or []):
+                lines.append("    " + row)
+        mfu_block = attribution.get("mfu") or {}
+        if mfu_block:
+            lines.append(
+                f"  MFU analytical {mfu_block.get('analytical')} vs measured "
+                f"{mfu_block.get('measured')} (ratio "
+                f"{mfu_block.get('ratio_analytical_over_measured')}, "
+                f"reconciled: {mfu_block.get('reconciled')})"
+            )
+        print("\n".join(lines))
+    return EXIT_OK
+
+
+def _profile_serving_buckets(
+    cfg, *, peaks: dict[str, float], top_k: int, logger
+) -> list[dict[str, Any]]:
+    """AOT profiles of the paged prefill/decode programs, checkpoint-free.
+
+    The engine's :meth:`cost_profile` only reads parameter SHAPES, so an
+    ``eval_shape`` of ``model.init`` stands in for real weights — zero
+    init work, nothing executes. Failures degrade to an empty list (the
+    train-step profile stands on its own).
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from .serving import PagedDecodeEngine
+
+        adapter, _, model = _build_decode_stack(cfg, logger, label="profile: ")
+        if not hasattr(model, "for_paged_decoding"):
+            logger.warning(
+                "model %s has no paged-decoding support; skipping serve profiles",
+                cfg.model.name,
+            )
+            return []
+        variables = jax.eval_shape(
+            lambda: model.init(
+                jax.random.key(0),
+                jnp.zeros((1, int(model.block_size)), jnp.int32),
+                deterministic=True,
+            )
+        )
+        scfg = cfg.serving
+        engine = PagedDecodeEngine(
+            model,
+            variables["params"],
+            block_tokens=scfg.block_tokens,
+            num_blocks=scfg.num_blocks or None,
+            max_batch_slots=scfg.max_batch_slots,
+            prompt_buckets=scfg.prompt_buckets or None,
+            batch_buckets=scfg.batch_buckets or None,
+        )
+        return engine.cost_profile(peaks=peaks, top_k=top_k)
+    except Exception as exc:  # noqa: BLE001 — serve profiles are additive
+        logger.warning("serving bucket profile failed: %s", exc)
+        return []
+
+
 def _handle_train(args: argparse.Namespace) -> int:
     try:
         cfg, _, resolved = load_and_validate_config(args.config)
@@ -2422,6 +2709,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_import_checkpoint(args)
     if args.command == "average-checkpoints":
         return _handle_average_checkpoints(args)
+    if args.command == "profile":
+        return _handle_profile(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

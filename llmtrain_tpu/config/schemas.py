@@ -427,6 +427,16 @@ class TelemetryConfig(BaseModel):
     prometheus_textfile: bool = True
     # End-of-run report.json/report.md in the run dir.
     report: bool = True
+    # Cost-attribution block (telemetry/profiling.py): XLA cost_analysis
+    # totals from the jitted train step, roofline class, MFU
+    # reconciliation — a `perf_attribution` block in report.json plus
+    # perf/* gauges. Costs one extra trace+lower of the step function at
+    # end of fit (no XLA compile, nothing executes).
+    perf_attribution: bool = True
+    # Roofline peak overrides merged over the built-in DEVICE_PEAKS row
+    # for the detected device kind. Keys: peak_flops, hbm_bytes_per_sec,
+    # ici_bytes_per_sec (values in FLOP/s and bytes/s).
+    device_peaks: dict[str, float] = Field(default_factory=dict)
 
     model_config = _STRICT
 

@@ -64,7 +64,11 @@ from ..resilience.harness import (
     train_segment_command,
 )
 from ..resilience.watchdog import heartbeat_age_seconds
-from ..telemetry.prometheus import render_prometheus, write_textfile
+from ..telemetry.prometheus import (
+    federate_prometheus,
+    render_prometheus,
+    write_textfile,
+)
 from ..telemetry.registry import MetricsRegistry
 from ..utils.logging import get_logger
 from . import tenant as ts
@@ -725,12 +729,27 @@ class FleetSupervisor:
     def _render_metrics(self) -> str:
         """One rendering of the fleet's Prometheus view — the /metrics
         endpoint, the textfile snapshot, and the final flush all serve
-        exactly this, so the three transports cannot diverge."""
-        return render_prometheus(
+        exactly this, so the three transports cannot diverge.
+
+        The fleet's own gauges come first; below them, every tenant's
+        textfile snapshot (``{run_dir}/telemetry/metrics.prom``, written
+        by the tenant's Telemetry flush) is federated in with a
+        ``tenant="<name>"`` label, so one scrape of the supervisor covers
+        the whole fleet without per-tenant service discovery."""
+        own = render_prometheus(
             self.metrics.latest(),
             self.metrics.counters(),
             info={"run_name": self._cfg.run.name, "mode": "fleet"},
         )
+        sources: dict[str, str] = {}
+        for t in self.tenants.values():
+            prom = t.run_dir / "telemetry" / "metrics.prom"
+            try:
+                sources[t.name] = prom.read_text(encoding="utf-8")
+            except OSError:
+                continue  # tenant not started yet / already cleaned up
+        federated = federate_prometheus(sources)
+        return own + federated if federated else own
 
     def _publish_metrics(self) -> None:
         states = Counter(t.sm.state for t in self.tenants.values())

@@ -408,6 +408,72 @@ class PagedDecodeEngine:
 
     # --------------------------------------------------------- accounting
 
+    def cost_profile(
+        self,
+        *,
+        peaks: dict[str, float] | None = None,
+        full: bool = True,
+        top_k: int = 10,
+    ) -> list[dict[str, Any]]:
+        """AOT cost profiles of the prefill/decode programs at their
+        LARGEST buckets (worst-case per-step cost; smaller buckets are
+        strictly cheaper). Nothing executes and nothing is donated —
+        profiling works against abstract shapes, so the live cache and
+        in-flight KV stay untouched. ``full=False`` skips the XLA compile
+        (cost totals only). Failed profiles are dropped, not raised.
+        """
+        from ..telemetry import profiling
+
+        if peaks is None:
+            peaks = profiling.resolve_peaks()
+        sds = jax.ShapeDtypeStruct
+        param_structs = jax.tree.map(
+            lambda x: sds(jnp.shape(x), x.dtype), self.params
+        )
+        cache_structs = jax.tree.map(
+            lambda s: sds(s.shape, s.dtype), self._cache_struct
+        )
+        mb = self.max_blocks_per_seq
+        tb = self.prompt_buckets[-1]
+        bb = self.batch_buckets[-1]
+        prefill_args = (
+            param_structs,
+            cache_structs,
+            sds((1, tb), jnp.int32),   # prompt
+            sds((1,), jnp.int32),      # true_len
+            sds((1, mb), jnp.int32),   # block_tables
+            sds((1,), jnp.uint32),     # seeds
+            sds((1,), jnp.float32),    # temps
+            sds((1,), jnp.int32),      # top_ks
+            sds((1,), jnp.float32),    # top_ps
+        )
+        decode_args = (
+            param_structs,
+            cache_structs,
+            sds((bb,), jnp.int32),     # tokens
+            sds((bb,), jnp.int32),     # positions
+            sds((bb, mb), jnp.int32),  # block_tables
+            sds((bb,), jnp.uint32),    # seeds
+            sds((bb,), jnp.int32),     # emit_idx
+            sds((bb,), jnp.float32),   # temps
+            sds((bb,), jnp.int32),     # top_ks
+            sds((bb,), jnp.float32),   # top_ps
+        )
+        profiles: list[dict[str, Any]] = []
+        for name, jitted, args in (
+            (f"prefill_T{tb}", self._prefill_jit, prefill_args),
+            (f"decode_B{bb}", self._decode_jit, decode_args),
+        ):
+            if full:
+                prof = profiling.aot_profile(
+                    jitted, args, name=name, peaks=peaks, top_k=top_k
+                )
+            else:
+                prof = profiling.lower_cost_profile(jitted, args, name=name)
+            if prof is not None:
+                profiles.append(prof)
+        return profiles
+
     def compile_stats(self) -> dict[str, Any]:
         """Bucket usage + compiled-program counts (the bounded-compile
         contract: programs <= prompt_buckets + batch_buckets, asserted by

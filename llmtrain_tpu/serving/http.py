@@ -42,7 +42,10 @@ class ServerStats:
     (regression-tested by hammering :meth:`record` from many threads).
     """
 
-    _RESERVOIR = 512  # newest latencies kept for the healthz summary
+    _RESERVOIR = 512  # newest samples kept for percentile estimation
+
+    # (metric stem, reservoir attr) pairs exported as p50/p95/p99 gauges.
+    _QUANTILES = ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -51,15 +54,34 @@ class ServerStats:
         self._tokens_out = 0
         self._latency_sum_ms = 0.0
         self._latencies_ms: list[float] = []
+        self._ttft_ms: list[float] = []
+        self._per_token_ms: list[float] = []
 
-    def record(self, *, latency_ms: float, tokens: int) -> None:
+    @staticmethod
+    def _push(reservoir: list[float], value: float) -> None:
+        reservoir.append(value)
+        if len(reservoir) > ServerStats._RESERVOIR:
+            del reservoir[: -ServerStats._RESERVOIR]
+
+    def record(
+        self, *, latency_ms: float, tokens: int, ttft_ms: float | None = None
+    ) -> None:
         with self._lock:
             self._requests += 1
             self._tokens_out += tokens
             self._latency_sum_ms += latency_ms
-            self._latencies_ms.append(latency_ms)
-            if len(self._latencies_ms) > self._RESERVOIR:
-                del self._latencies_ms[: -self._RESERVOIR]
+            self._push(self._latencies_ms, latency_ms)
+            if ttft_ms is not None:
+                self._push(self._ttft_ms, ttft_ms)
+                # Per-token decode latency: time AFTER the first token over
+                # the remaining tokens — the steady-state decode rate an SLO
+                # cares about, not diluted by prefill.
+                if tokens > 1:
+                    self._push(
+                        self._per_token_ms, (latency_ms - ttft_ms) / (tokens - 1)
+                    )
+            elif tokens > 0:
+                self._push(self._per_token_ms, latency_ms / tokens)
 
     def record_error(self) -> None:
         with self._lock:
@@ -70,17 +92,48 @@ class ServerStats:
         with self._lock:
             return self._requests
 
+    @staticmethod
+    def _percentile(sorted_vals: list[float], q: float) -> float | None:
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1, round(q * (len(sorted_vals) - 1)))
+        return sorted_vals[idx]
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             n = self._requests
             lat = sorted(self._latencies_ms)
+            ttft = sorted(self._ttft_ms)
             return {
                 "requests_served": n,
                 "errors": self._errors,
                 "tokens_out": self._tokens_out,
                 "mean_latency_ms": round(self._latency_sum_ms / n, 3) if n else None,
                 "p50_latency_ms": round(lat[len(lat) // 2], 3) if lat else None,
+                "p95_latency_ms": (
+                    round(self._percentile(lat, 0.95), 3) if lat else None
+                ),
+                "p50_ttft_ms": round(ttft[len(ttft) // 2], 3) if ttft else None,
             }
+
+    def prometheus_gauges(self) -> dict[str, float]:
+        """Percentile gauges merged into GET /metrics on every scrape
+        (``llmtrain_serve_ttft_ms_p50`` etc.) — live SLO latency from the
+        reservoir, not a post-run summary. Empty reservoirs export
+        nothing: an absent series beats a misleading 0."""
+        with self._lock:
+            series = {
+                "serve/latency_ms": sorted(self._latencies_ms),
+                "serve/ttft_ms": sorted(self._ttft_ms),
+                "serve/per_token_ms": sorted(self._per_token_ms),
+            }
+        gauges: dict[str, float] = {}
+        for stem, vals in series.items():
+            for q, tag in self._QUANTILES:
+                value = self._percentile(vals, q)
+                if value is not None:
+                    gauges[f"{stem}_{tag}"] = value
+        return gauges
 
 
 @dataclass
@@ -249,7 +302,11 @@ def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]
         if eos is not None and eos in completion:
             completion = completion[: completion.index(eos) + 1]
     latency_ms = (time.monotonic() - t0) * 1000.0
-    state.stats.record(latency_ms=latency_ms, tokens=len(completion))
+    state.stats.record(
+        latency_ms=latency_ms,
+        tokens=len(completion),
+        ttft_ms=extra.get("ttft_ms"),
+    )
     if state.registry is not None and state.scheduler is None:
         # The scheduler publishes its own serve/* metrics; the legacy
         # path still counts requests for the /metrics endpoint.
@@ -290,8 +347,13 @@ def _handle_metrics(state: ServerState) -> tuple[int, str]:
         return 404, "no metrics registry attached\n"
     from ..telemetry.prometheus import render_prometheus
 
+    gauges = dict(state.registry.latest())
+    # Live SLO percentiles from the stats reservoir — computed at scrape
+    # time so /metrics always reflects the newest requests.
+    for name, value in state.stats.prometheus_gauges().items():
+        gauges[name] = (value, None)
     return 200, render_prometheus(
-        state.registry.latest(),
+        gauges,
         state.registry.counters(),
         {"component": "serve", "checkpoint": state.checkpoint},
     )

@@ -33,6 +33,7 @@ import itertools
 import threading
 import time
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -61,6 +62,9 @@ class ServeRequest:
     request_id: int = field(default_factory=lambda: next(_REQ_IDS))
     # Measurements (scheduler-thread writes, reader waits on `done`).
     submitted_t: float = 0.0
+    # perf_counter twin of submitted_t: EventTimeline spans are
+    # perf_counter-relative, so the queue-wait span needs this clock.
+    submitted_pc: float = 0.0
     first_token_t: float | None = None
     finished_t: float | None = None
     token_times: list[float] = field(default_factory=list)
@@ -113,6 +117,7 @@ class ContinuousBatchingScheduler:
         draft_model: Any | None = None,
         draft_params: Any | None = None,
         gamma: int = 4,
+        timeline: Any | None = None,  # telemetry EventTimeline
     ) -> None:
         if policy not in ("paged", "speculative"):
             raise ValueError(
@@ -132,6 +137,10 @@ class ContinuousBatchingScheduler:
         self.engine = engine
         self.policy = policy
         self.registry = registry
+        # Serving timeline: queue-wait/prefill/decode spans tagged with
+        # request ids, so one request's life is followable in Perfetto
+        # (docs/observability.md). None = no tracing overhead.
+        self.timeline = timeline
         self.max_batch_slots = int(
             max_batch_slots
             or (engine.max_batch_slots if engine is not None else 1)
@@ -160,6 +169,7 @@ class ContinuousBatchingScheduler:
     def submit(self, req: ServeRequest) -> ServeRequest:
         """Thread-safe enqueue; returns immediately (wait on ``req.done``)."""
         req.submitted_t = time.monotonic()
+        req.submitted_pc = time.perf_counter()
         with self._wake:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
@@ -168,6 +178,26 @@ class ContinuousBatchingScheduler:
         return req
 
     # ------------------------------------------------------------- backend
+
+    def _span(self, name: str, **args: Any):
+        """Timeline span tagged for Perfetto, no-op without a timeline."""
+        if self.timeline is None:
+            return nullcontext()
+        return self.timeline.span(name, cat="serve", **args)
+
+    def _record_queue_wait(self, req: ServeRequest) -> None:
+        """Queue-wait span from the submit stamp to now — with the
+        request_id tag it abuts the same request's prefill span, so one
+        request's queue-wait → prefill → decode path reads as a track."""
+        if self.timeline is None or req.submitted_pc <= 0.0:
+            return
+        self.timeline.record(
+            "serve/queue_wait",
+            t0=req.submitted_pc,
+            t1=time.perf_counter(),
+            cat="serve",
+            request_id=req.request_id,
+        )
 
     def step(self) -> bool:
         """One scheduler iteration: join, advance, evict. Returns whether
@@ -215,15 +245,19 @@ class ContinuousBatchingScheduler:
                 self._queue.popleft()
             tp = int(req.prompt_ids.shape[0])
             engine.pool.grow(table, tp)
+            self._record_queue_wait(req)
             try:
-                tok = engine.prefill(
-                    req.prompt_ids,
-                    table.padded(engine.max_blocks_per_seq),
-                    seed=req.seed,
-                    temperature=req.temperature,
-                    top_k=req.top_k,
-                    top_p=req.top_p,
-                )
+                with self._span(
+                    "serve/prefill", request_id=req.request_id, prompt_tokens=tp
+                ):
+                    tok = engine.prefill(
+                        req.prompt_ids,
+                        table.padded(engine.max_blocks_per_seq),
+                        seed=req.seed,
+                        temperature=req.temperature,
+                        top_k=req.top_k,
+                        top_p=req.top_p,
+                    )
             except Exception as exc:  # noqa: BLE001 — fail THIS request only
                 engine.pool.release(table)
                 self._fail(req, exc)
@@ -283,7 +317,12 @@ class ContinuousBatchingScheduler:
                     }
                 )
             try:
-                toks = engine.decode(rows)
+                with self._span(
+                    "serve/decode",
+                    request_ids=[r.req.request_id for r in self._active],
+                    batch=len(rows),
+                ):
+                    toks = engine.decode(rows)
             except Exception as exc:  # noqa: BLE001 — contain: a decode
                 # failure must not kill the scheduler thread (every later
                 # waiter would time out against a dead loop). The batch's
@@ -324,21 +363,25 @@ class ContinuousBatchingScheduler:
         self.peak_occupancy = max(self.peak_occupancy, 1)
         self._occupancy_samples += 1
         self._occupancy_total += 1
+        self._record_queue_wait(req)
         try:
-            out = speculative_generate(
-                self._model,
-                self._params,
-                self._draft_model,
-                self._draft_params,
-                req.prompt_ids[None, :],
-                max_new_tokens=req.max_new_tokens,
-                gamma=self._gamma,
-                temperature=req.temperature,
-                top_k=req.top_k,
-                top_p=req.top_p,
-                eos_token_id=req.eos_token_id,
-                rng=jax.random.key(req.seed),
-            )
+            with self._span(
+                "serve/speculative_decode", request_id=req.request_id
+            ):
+                out = speculative_generate(
+                    self._model,
+                    self._params,
+                    self._draft_model,
+                    self._draft_params,
+                    req.prompt_ids[None, :],
+                    max_new_tokens=req.max_new_tokens,
+                    gamma=self._gamma,
+                    temperature=req.temperature,
+                    top_k=req.top_k,
+                    top_p=req.top_p,
+                    eos_token_id=req.eos_token_id,
+                    rng=jax.random.key(req.seed),
+                )
         except Exception as exc:  # noqa: BLE001 — fail THIS request only
             self._fail(req, exc)
             self._publish_metrics()

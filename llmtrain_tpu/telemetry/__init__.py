@@ -198,9 +198,17 @@ class Telemetry:
             write_textfile(self._dir / "metrics.prom", self._render_prometheus())
 
     def finalize(
-        self, train_result: dict[str, Any] | None = None, *, run_id: str | None = None
+        self,
+        train_result: dict[str, Any] | None = None,
+        *,
+        run_id: str | None = None,
+        perf_attribution: dict[str, Any] | None = None,
     ) -> dict[str, Any] | None:
         """End-of-run: final flush, Perfetto export, report.json/report.md.
+
+        ``perf_attribution`` is the cost-attribution block built by the
+        caller (trainer via telemetry/profiling.py) — passed through to
+        the report untouched.
 
         Returns the report dict (None when telemetry/reporting is off).
         Idempotent — a second call (e.g. an unwind path after the normal
@@ -223,6 +231,7 @@ class Telemetry:
                 memory=self.memory,
                 wall_time_sec=wall,
                 train_result=train_result,
+                perf_attribution=perf_attribution,
             )
             if self._writes_files:
                 write_reports(self._run_dir, report)

@@ -98,6 +98,63 @@ def render_prometheus(
     return "\n".join(lines) + "\n"
 
 
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{.*\})?\s+(?P<value>\S+)\s*$"
+)
+_TYPE_RE = re.compile(r"^#\s*TYPE\s+(?P<name>\S+)\s+(?P<type>\S+)\s*$")
+
+
+def federate_prometheus(sources: dict[str, str]) -> str:
+    """Merge per-tenant exposition texts into ONE scrape payload.
+
+    The fleet supervisor runs tenants as subprocesses whose own
+    ``/metrics`` ports are ephemeral (or textfile-only); a cluster
+    scraper should not have to discover N moving targets. This re-emits
+    every tenant series with a ``tenant="<name>"`` label injected (merged
+    in front of any existing labels) and additionally rolls counters up
+    into an unlabeled fleet-wide sum, so ``llmtrain_*_total`` without a
+    selector reads as "the whole fleet".
+
+    ``sources`` maps tenant name → that tenant's exposition text (e.g.
+    the content of its ``telemetry/metrics.prom`` textfile). Unparseable
+    lines are dropped, not propagated — one corrupt tenant file must not
+    poison the fleet scrape.
+    """
+    types: dict[str, str] = {}
+    series: dict[str, list[str]] = {}
+    counter_sums: dict[str, float] = {}
+    for tenant in sorted(sources):
+        tenant_label = f'tenant="{_escape_label(tenant)}"'
+        for line in sources[tenant].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                m = _TYPE_RE.match(line)
+                if m:
+                    types.setdefault(m.group("name"), m.group("type"))
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name, labels, value = m.group("name"), m.group("labels"), m.group("value")
+            inner = (labels or "{}")[1:-1].strip()
+            merged = tenant_label + ("," + inner if inner else "")
+            series.setdefault(name, []).append(f"{name}{{{merged}}} {value}")
+            if types.get(name) == "counter":
+                try:
+                    counter_sums[name] = counter_sums.get(name, 0.0) + float(value)
+                except ValueError:
+                    pass
+    lines: list[str] = []
+    for name in sorted(series):
+        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+        lines.extend(series[name])
+        if name in counter_sums:
+            lines.append(f"{name} {_fmt_value(counter_sums[name])}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def write_textfile(path: str | Path, text: str) -> bool:
     """Atomic write (tmp + rename) of the textfile-collector snapshot; a
     scraper must never read a half-written file. Never raises."""
@@ -178,6 +235,7 @@ class PrometheusEndpoint:
 
 __all__ = [
     "PrometheusEndpoint",
+    "federate_prometheus",
     "prometheus_name",
     "render_prometheus",
     "write_textfile",

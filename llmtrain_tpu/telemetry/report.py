@@ -55,6 +55,7 @@ def build_report(
     wall_time_sec: float,
     train_result: dict[str, Any] | None = None,
     serving: dict[str, Any] | None = None,
+    perf_attribution: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Aggregate the telemetry state into the report dict."""
     latest = registry.latest()
@@ -159,6 +160,12 @@ def build_report(
         # TTFT/per-token percentiles, throughput, occupancy, KV-pool and
         # compile accounting — docs/serving.md documents the schema.
         report["serving"] = serving
+    if perf_attribution is not None:
+        # Cost-attribution block (telemetry/profiling.py): XLA-counted
+        # flops/bytes per executable, roofline class, MFU reconciliation,
+        # step-time split — docs/observability.md "Attribution and
+        # rooflines" documents the schema.
+        report["perf_attribution"] = perf_attribution
     if train_result is not None:
         report["train_result"] = train_result
     return report
@@ -337,6 +344,51 @@ def render_markdown(report: dict[str, Any]) -> str:
                 f"{_fmt(par.get('checked', 0) - par.get('mismatched', 0))}/"
                 f"{_fmt(par.get('checked'))} bitwise-identical"
             )
+    perf = report.get("perf_attribution") or {}
+    if perf:
+        lines += ["", "## Performance attribution", ""]
+        peaks = perf.get("peaks") or {}
+        lines.append(
+            f"- device: {perf.get('device_kind', '?')} × {perf.get('n_chips', 1)} "
+            f"(peak {_fmt(peaks.get('peak_flops'))} FLOP/s, "
+            f"HBM {_fmt_bytes(peaks.get('hbm_bytes_per_sec'))}/s, "
+            f"ICI {_fmt_bytes(peaks.get('ici_bytes_per_sec'))}/s)"
+        )
+        mfu_block = perf.get("mfu") or {}
+        if mfu_block:
+            line = (
+                f"- MFU: analytical {_fmt(mfu_block.get('analytical'))} vs "
+                f"measured {_fmt(mfu_block.get('measured'))}"
+            )
+            if "ratio_analytical_over_measured" in mfu_block:
+                line += (
+                    f" (flop-model ratio {_fmt(mfu_block['ratio_analytical_over_measured'])}"
+                    f", reconciled: {mfu_block.get('reconciled')})"
+                )
+            lines.append(line)
+        split = perf.get("step_time_split_ms") or {}
+        if split:
+            lines.append(
+                f"- step time {_fmt(split.get('step'))} ms = compute "
+                f"{_fmt(split.get('analytical_compute'))} + collective "
+                f"{_fmt(split.get('analytical_collective'))} + host "
+                f"{_fmt(split.get('measured_host'))} + unattributed "
+                f"{_fmt(split.get('unattributed_gap'))}"
+            )
+        for exe in perf.get("executables") or []:
+            roof = exe.get("roofline") or {}
+            lines.append(
+                f"- `{exe.get('name', '?')}`: {_fmt(exe.get('flops'))} flops, "
+                f"{_fmt_bytes(exe.get('bytes_accessed'))} accessed, "
+                f"intensity {_fmt(roof.get('arithmetic_intensity'))} "
+                f"(ridge {_fmt(roof.get('ridge_intensity'))}) → "
+                f"**{roof.get('class', '?')}-bound**"
+            )
+            rows = exe.get("top_ops") or []
+            if rows:
+                from .profiling import render_top_ops_markdown
+
+                lines += [""] + render_top_ops_markdown(rows) + [""]
     result = report.get("train_result")
     if result:
         lines += ["", "## Result", ""]

@@ -69,7 +69,7 @@ from ..resilience.elastic import (
 from ..telemetry import Telemetry
 from ..tracking.base import Tracker
 from ..utils.hw import mfu as compute_mfu
-from ..utils.hw import peak_flops_per_chip
+from ..utils.hw import peak_flops_per_chip, transformer_flops_per_token
 from ..utils.logging import get_logger
 from .checkpoint import CheckpointManager, resolve_resume_path
 from .optimizer import build_optimizer, lr_schedule
@@ -284,6 +284,11 @@ class Trainer:
             self._train_step_fn = step_with_host_opt
         else:
             self._train_step_fn = step_fn
+        # The raw jitted step (not the host-roundtrip wrapper): the cost
+        # attribution hook lowers THIS to read XLA's cost_analysis —
+        # lowering only traces, so the donation annotation never consumes
+        # a live buffer (telemetry/profiling.py).
+        self._jit_train_step = step_fn
         self._eval_step_fn = jax.jit(
             make_eval_step(self._adapter, self._model),
             out_shardings=replicated(self._mesh),
@@ -312,6 +317,10 @@ class Trainer:
             )
         self._peak_flops = peak_flops_per_chip()
         self._train_seqlen = cfg.model.block_size  # refined from data in fit()
+        # Cost-attribution inputs captured during fit (telemetry/profiling.py).
+        self._batch_struct: Any | None = None
+        self._train_batch_keys: tuple[str, ...] = ()
+        self._tokens_per_step = 0
 
     # ------------------------------------------------------------------ setup
 
@@ -796,6 +805,10 @@ class Trainer:
         step_delay = float(cfg.trainer.extra.get("step_delay_sec", 0.0) or 0.0)
         self._train_seqlen = self._probe_seqlen(train_ds)
         tokens_per_step = accum * self._global_micro * self._train_seqlen
+        # Cost-attribution inputs (telemetry/profiling.py): the hook at
+        # end of fit lowers the jitted step against these abstract shapes.
+        self._train_batch_keys = self._dataset_spec(train_ds)[0]
+        self._tokens_per_step = tokens_per_step
         profiler = _StepProfiler(
             cfg,
             self._run_dir,
@@ -938,6 +951,18 @@ class Trainer:
                     else:
                         batch = self._global_batch(sampler, train_ds, step)
                     t_dispatch = time.perf_counter()
+                    if self._batch_struct is None:
+                        # Abstract shapes of the real global batch, captured
+                        # once: the cost-attribution hook re-lowers the
+                        # jitted step against exactly these at end of fit.
+                        self._batch_struct = jax.tree.map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape,
+                                x.dtype,
+                                sharding=getattr(x, "sharding", None),
+                            ),
+                            batch,
+                        )
                     with self._telemetry.step_annotation(step):
                         self._state, metrics = self._train_step_fn(
                             self._state, batch, run_key
@@ -1224,9 +1249,13 @@ class Trainer:
         # hang reports) as tracker artifacts. Best-effort by construction;
         # the guard here is only against surprises in the result dict.
         try:
+            perf_attribution = self._build_perf_attribution(
+                run_key, steps=max(0, final_step - start_step + 1)
+            )
             self._telemetry.finalize(
                 train_result=asdict(result),
                 run_id=self._run_dir.name if self._run_dir is not None else None,
+                perf_attribution=perf_attribution,
             )
             self._telemetry.register_artifacts()
         except Exception as exc:  # noqa: BLE001 — reporting must not fail the run
@@ -1235,6 +1264,74 @@ class Trainer:
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]
+
+    def _build_perf_attribution(
+        self, run_key: jax.Array, *, steps: int
+    ) -> dict[str, Any] | None:
+        """Cost-attribution block for report.json (telemetry/profiling.py).
+
+        Re-lowers the raw jitted step (trace only — NO XLA compile, nothing
+        executes, donated buffers stay live) against the batch shapes the
+        fit actually dispatched, reads XLA's cost_analysis, and classifies
+        the step on the device roofline. Publishes the ``perf/*`` gauges
+        as a side effect. Returns None when gated off, when no step ran,
+        or on any backend failure — attribution is optional, the run is
+        not.
+        """
+        tcfg = self._cfg.telemetry
+        if not (tcfg.enabled and tcfg.report and tcfg.perf_attribution):
+            return None
+        # Attribution exists for the report; without a run dir no
+        # report.json is written, so the extra trace+lower buys nothing.
+        if self._run_dir is None:
+            return None
+        if self._batch_struct is None or steps <= 0:
+            return None
+        try:
+            from ..telemetry import profiling
+
+            cost = profiling.lower_cost_profile(
+                self._jit_train_step,
+                (self._state, self._batch_struct, run_key),
+                name="train_step",
+                n_chips=int(self._mesh.devices.size),
+            )
+            if cost is None:
+                return None
+            peaks = profiling.resolve_peaks(None, tcfg.device_peaks)
+            # Gradient-sync estimate: ring all-reduce of the trainable
+            # grads (f32 accumulation) over the combined data-parallel
+            # degree. An estimate, labeled as such in the docs — XLA's
+            # cost_analysis does not expose collective bytes at this tier.
+            collective = profiling.gradient_collective_bytes(
+                mesh_axis_sizes(self._mesh), float(self._trainable_count) * 4.0
+            )
+            latest = {k: v[0] for k, v in self._telemetry.metrics.latest().items()}
+            step_time_sec = latest.get("train/step_time_sec") or 0.0
+            palm = transformer_flops_per_token(
+                n_params=self._param_count,
+                n_layers=self._cfg.model.n_layers,
+                seq_len=self._train_seqlen,
+                d_model=self._cfg.model.d_model,
+                n_trainable_params=self._trainable_count,
+            )
+            block = profiling.build_perf_attribution(
+                executables=[cost],
+                peaks=peaks,
+                n_chips=int(self._mesh.devices.size),
+                step_time_ms=step_time_sec * 1e3 if step_time_sec > 0 else None,
+                tokens_per_step=float(self._tokens_per_step) or None,
+                palm_flops_per_token=palm,
+                measured_mfu=latest.get("train/mfu"),
+                collective_bytes=collective,
+                span_totals=self._telemetry.timeline.span_totals(),
+                steps=steps,
+            )
+            self._telemetry.metrics.publish(profiling.attribution_gauges(block))
+            return block
+        except Exception as exc:  # noqa: BLE001 — attribution must not fail the run
+            logger.warning("perf attribution skipped: %s", exc)
+            return None
 
     def _close_eval_pool(self) -> None:
         """Release the shared eval-data executor (idle at call time: every

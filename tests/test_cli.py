@@ -37,6 +37,10 @@ CFG = {
     "mlflow": {"enabled": False},
     "logging": {"level": "INFO", "json_output": True, "log_to_file": True},
     "output": {"root_dir": "runs"},
+    # These tests pin CLI behavior; the end-of-fit cost-attribution lower
+    # has its own e2e (test_profiling.py) and would add ~0.8s of cold
+    # trace per train subprocess here.
+    "telemetry": {"perf_attribution": False},
 }
 
 
@@ -424,6 +428,9 @@ class TestGenerate:
         assert proc.returncode == 2
         assert "logprobs" in proc.stderr
 
+    @pytest.mark.slow  # ~14s: CLI speculative parity stays tier-1 via
+    # test_speculative_generate_matches_plain_greedy; this adds only the
+    # prompts-file/length-group dimension on top of the same path.
     def test_speculative_prompts_file_matches_plain(self, workdir):
         """The per-row speculative loop over a prompts file (different
         prompt lengths → separate length groups) matches the plain

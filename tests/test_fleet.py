@@ -13,6 +13,7 @@ the CLI round-trip) is ``@pytest.mark.slow`` under ``make verify-fleet``.
 from __future__ import annotations
 
 import json
+import re
 import signal
 import subprocess
 import sys
@@ -347,6 +348,37 @@ class TestSupervisorUnits:
             }
         )
         assert "| a | completed |" in md and "| 3 | 3 | 2 | 12 | 3.25 |" in md
+
+    def test_render_metrics_federates_tenant_textfiles(self, tmp_path):
+        """One scrape of the supervisor covers the fleet: each tenant's
+        metrics.prom snapshot is re-emitted with a tenant label, counters
+        additionally roll up into an unlabeled fleet-wide sum, and the
+        fleet's own gauges still lead the exposition."""
+        sup = _make_supervisor(tmp_path)
+        for name, loss, commits in (("tenant-a", 2.5, 3), ("tenant-b", 1.5, 4)):
+            prom = sup.tenants[name].run_dir / "telemetry" / "metrics.prom"
+            prom.parent.mkdir(parents=True, exist_ok=True)
+            prom.write_text(
+                "# TYPE llmtrain_train_loss gauge\n"
+                f"llmtrain_train_loss {loss}\n"
+                "# TYPE llmtrain_ckpt_commits_total counter\n"
+                f"llmtrain_ckpt_commits_total {commits}\n",
+                encoding="utf-8",
+            )
+        text = sup._render_metrics()
+        # Fleet's own identity gauge is untouched by federation.
+        assert 'mode="fleet"' in text
+        # Per-tenant series carry the tenant label.
+        assert 'llmtrain_train_loss{tenant="tenant-a"} 2.5' in text
+        assert 'llmtrain_train_loss{tenant="tenant-b"} 1.5' in text
+        assert 'llmtrain_ckpt_commits_total{tenant="tenant-a"} 3' in text
+        # Counters also sum into one unlabeled fleet-wide series.
+        assert re.search(
+            r"^llmtrain_ckpt_commits_total 7(\.0)?$", text, re.MULTILINE
+        )
+        # A missing textfile (tenant never started) is skipped, not fatal.
+        (sup.tenants["tenant-a"].run_dir / "telemetry" / "metrics.prom").unlink()
+        assert 'tenant="tenant-b"' in sup._render_metrics()
 
 
 # --------------------------------------------------------------------------

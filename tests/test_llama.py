@@ -683,6 +683,9 @@ class TestHFInterop:
         with pytest.raises(ValueError, match="llama"):
             llama_params_to_hf_state_dict(gp)
 
+    @pytest.mark.slow  # ~15s: CLI subprocess round-trip. The HF
+    # state-dict conversion itself stays tier-1 via the in-process
+    # parity/round-trip tests in this class.
     def test_cli_export_import_roundtrip(self, tmp_path):
         """llama checkpoints export as HF state dicts and re-import to a
         resumable step-0 checkpoint through the real CLI."""

@@ -126,6 +126,9 @@ class TestGreedyExactness:
 
 
 class TestMoETarget:
+    @pytest.mark.slow  # ~11s: niche MoE-target x speculative combo; the
+    # exactness contract stays tier-1 on the dense target, and MoE
+    # routing correctness lives in test_moe.py.
     def test_llama_moe_target_matches_plain(self):
         """Mixtral-class target (SwiGLU experts, top-2 routing, GQA,
         window): expert routing re-evaluates per decode step, and the

@@ -311,6 +311,9 @@ def test_gqa_export_raises_clear_error():
 
 
 class TestImportCLI:
+    @pytest.mark.slow  # ~20s: four CLI subprocesses end to end. The
+    # export/import conversion math stays tier-1 (round-trip units above
+    # and TestExportCLI's train->export run).
     def test_full_migration_loop(self, tmp_path):
         """train -> export-checkpoint -> import-checkpoint -> eval: the
         re-imported checkpoint evaluates to the original's exact val loss,

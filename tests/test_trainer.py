@@ -420,6 +420,9 @@ class TestProfiler:
         Trainer(cfg, run_dir, NullTracker(), None).fit()
         assert not (run_dir / "logs" / "profile").exists()
 
+    @pytest.mark.slow  # ~10s: edge case of the window lifecycle; the
+    # main trace-writing contract stays tier-1 via
+    # test_profile_window_writes_trace.
     def test_profile_window_past_max_steps_still_closes(self, tmp_path):
         """Window extends past the end of training: close() must stop the trace."""
         run_dir = tmp_path / "run"

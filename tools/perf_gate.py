@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Performance regression gate over consecutive BENCH_*.json files.
+
+The driver appends one ``BENCH_rNN.json`` per round; each embeds the
+bench result under ``parsed`` (plus the raw child ``tail``).  This gate
+compares the latest two rounds scenario-by-scenario and exits nonzero
+when a comparable scenario regressed beyond the noise bound.
+
+Comparability rules (the whole point — a gate that fires on noise or on
+apples-vs-oranges gets deleted within a week):
+
+* Scenarios are matched by ``detail.model`` + ``detail.attention`` +
+  ``detail.batch``.  BENCH rounds that ran different model scales (the
+  common case when the bench's own degradation ladder picked different
+  rungs) simply have no common scenario and the gate passes with a note.
+* Degraded lines never gate.  A line is degraded when it carries a
+  top-level ``degraded``/``fallback`` flag (bench.py contract) or a
+  ``detail.fallback`` string (older rounds): the number was produced on
+  a fallback rung, so comparing it against a healthy run is noise.
+* When both lines embed the cost attribution block
+  (``detail.telemetry.attribution``, docs/observability.md) and the
+  analytical flops differ by >1%, the model genuinely changed between
+  rounds even though the scenario label matched — skipped, not gated.
+* Within a comparable pair, regression means
+  ``new.value < old.value * (1 - noise)`` (default noise 0.20: CPU
+  fallback hosts are shared and wobble; TPU rounds can pass a tighter
+  ``--noise``).
+
+Usage::
+
+    python tools/perf_gate.py BENCH_r04.json BENCH_r05.json
+    python tools/perf_gate.py            # auto: latest two BENCH_*.json
+    python tools/perf_gate.py --self-test
+
+Exit codes: 0 pass (or nothing comparable), 1 regression, 2 usage/IO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+DEFAULT_NOISE = 0.20
+_FLOPS_DRIFT = 0.01
+
+
+def load_results(path: str) -> list[dict[str, Any]]:
+    """Bench lines out of one BENCH_*.json: the ``parsed`` wrapper, a raw
+    result object, or a list of results."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return [doc["parsed"]]
+    if isinstance(doc, dict) and "metric" in doc:
+        return [doc]
+    return []
+
+
+def scenario_key(result: dict[str, Any]) -> str:
+    detail = result.get("detail") or {}
+    return "{model}|{attention}|batch={batch}".format(
+        model=detail.get("model", "?"),
+        attention=detail.get("attention", "?"),
+        batch=detail.get("batch", "?"),
+    )
+
+
+def is_degraded(result: dict[str, Any]) -> bool:
+    if result.get("degraded") or result.get("fallback"):
+        return True
+    detail = result.get("detail") or {}
+    return bool(detail.get("fallback"))
+
+
+def _attribution_flops(result: dict[str, Any]) -> float | None:
+    attr = ((result.get("detail") or {}).get("telemetry") or {}).get("attribution")
+    if isinstance(attr, dict) and "flops" in attr:
+        try:
+            return float(attr["flops"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def compare(
+    old: list[dict[str, Any]],
+    new: list[dict[str, Any]],
+    *,
+    noise: float = DEFAULT_NOISE,
+) -> dict[str, Any]:
+    """Pure comparison core (unit-tested; the CLI is a thin shell)."""
+    old_by_key = {scenario_key(r): r for r in old if not is_degraded(r)}
+    regressions: list[dict[str, Any]] = []
+    compared: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for result in new:
+        key = scenario_key(result)
+        if is_degraded(result):
+            skipped.append(f"{key}: new line degraded ({result.get('fallback') or 'detail.fallback'})")
+            continue
+        prev = old_by_key.get(key)
+        if prev is None:
+            skipped.append(f"{key}: no matching non-degraded scenario in old round")
+            continue
+        if result.get("metric") != prev.get("metric"):
+            skipped.append(f"{key}: metric changed {prev.get('metric')} -> {result.get('metric')}")
+            continue
+        f_old, f_new = _attribution_flops(prev), _attribution_flops(result)
+        if f_old and f_new and abs(f_new - f_old) / max(f_old, 1.0) > _FLOPS_DRIFT:
+            skipped.append(
+                f"{key}: analytical flops drifted {f_old:.3g} -> {f_new:.3g}; "
+                "workload changed, not comparable"
+            )
+            continue
+        old_v = float(prev.get("value", 0.0))
+        new_v = float(result.get("value", 0.0))
+        entry = {
+            "scenario": key,
+            "metric": result.get("metric"),
+            "old": old_v,
+            "new": new_v,
+            "ratio": new_v / old_v if old_v else float("inf"),
+        }
+        compared.append(entry)
+        if old_v > 0 and new_v < old_v * (1.0 - noise):
+            regressions.append(entry)
+    return {"compared": compared, "regressions": regressions, "skipped": skipped}
+
+
+def _latest_pair(root: str) -> tuple[str, str] | None:
+    def round_no(path: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")), key=round_no)
+    if len(files) < 2:
+        return None
+    return files[-2], files[-1]
+
+
+def _self_test() -> int:
+    """Synthetic inject: a 50% drop must gate, a 2% wobble must not, and
+    degraded / flops-drifted lines must be skipped."""
+    base = {
+        "metric": "tokens_per_sec_per_chip",
+        "value": 1000.0,
+        "detail": {
+            "model": "gpt L2 d128 T128",
+            "attention": "dense",
+            "batch": 4,
+            "telemetry": {"attribution": {"flops": 1.0e9}},
+        },
+    }
+
+    def variant(**kw: Any) -> dict[str, Any]:
+        out = json.loads(json.dumps(base))
+        out.update({k: v for k, v in kw.items() if k != "flops"})
+        if "flops" in kw:
+            out["detail"]["telemetry"]["attribution"]["flops"] = kw["flops"]
+        return out
+
+    verdict = compare([base], [variant(value=500.0)])
+    assert verdict["regressions"], "50% drop must gate"
+    verdict = compare([base], [variant(value=980.0)])
+    assert not verdict["regressions"] and verdict["compared"], "2% wobble must pass"
+    verdict = compare([base], [variant(value=500.0, degraded=True, fallback="oom")])
+    assert not verdict["regressions"] and verdict["skipped"], "degraded must skip"
+    verdict = compare([base], [variant(value=500.0, flops=2.0e9)])
+    assert not verdict["regressions"] and verdict["skipped"], "flops drift must skip"
+    print("perf_gate self-test: OK")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("old", nargs="?", help="older BENCH_*.json")
+    parser.add_argument("new", nargs="?", help="newer BENCH_*.json")
+    parser.add_argument("--noise", type=float, default=DEFAULT_NOISE)
+    parser.add_argument("--root", default=".", help="dir for auto-discovery")
+    parser.add_argument("--self-test", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.self_test:
+        return _self_test()
+
+    if args.old and args.new:
+        pair = (args.old, args.new)
+    else:
+        pair = _latest_pair(args.root)
+        if pair is None:
+            print("perf_gate: fewer than two BENCH_r*.json rounds; nothing to gate")
+            return 0
+    try:
+        old, new = load_results(pair[0]), load_results(pair[1])
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"perf_gate: cannot read bench rounds: {exc}", file=sys.stderr)
+        return 2
+
+    verdict = compare(old, new, noise=args.noise)
+    print(f"perf_gate: {pair[0]} -> {pair[1]} (noise bound {args.noise:.0%})")
+    for entry in verdict["compared"]:
+        flag = "REGRESSION" if entry in verdict["regressions"] else "ok"
+        print(
+            f"  [{flag}] {entry['scenario']}: {entry['old']:.1f} -> "
+            f"{entry['new']:.1f} ({entry['ratio']:.2%} of old)"
+        )
+    for note in verdict["skipped"]:
+        print(f"  [skip] {note}")
+    if not verdict["compared"] and not verdict["skipped"]:
+        print("  no bench lines found")
+    if verdict["regressions"]:
+        print(f"perf_gate: FAIL ({len(verdict['regressions'])} regression(s))")
+        return 1
+    print("perf_gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
