@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -79,6 +79,18 @@ verify-telemetry:
 # (fit-path attribution, `llmtrain profile` CLI) ride `make test-all`.
 verify-profile:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py -q -m "not slow"
+	python tools/perf_gate.py --self-test
+
+# Quantized-training suite (docs/perf.md "Quantized training"):
+# per-channel scale/STE-vjp units, QuantDense-vs-Dense drop-in parity,
+# knob validation + fp8 capability fallback, chunked-CE auto-select, the
+# perf_gate matrix rules — PLUS the @pytest.mark.slow fits plain
+# `make test` skips: int8-vs-f32 N-step loss-parity on a tiny GPT,
+# grad-finiteness under the non-finite guard, and the checkpoint/elastic
+# -resume round-trip with matmul_precision int8. Ends with the gate's
+# own self-test (new-key/removed-key/degraded-parity matrix cases).
+verify-quant:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quant_train.py -q
 	python tools/perf_gate.py --self-test
 
 # Continuous-batching serving suite (docs/serving.md): paged-KV pool

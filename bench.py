@@ -33,12 +33,20 @@ _MFU_TARGET = 0.30
 _CHILD_ENV = "LLMTRAIN_BENCH_CHILD"
 _PROBE_ENV = "LLMTRAIN_BENCH_PROBE"
 _ZERO_ENV = "LLMTRAIN_BENCH_ZERO_CHILD"
+_MATRIX_ENV = "LLMTRAIN_BENCH_MATRIX_CHILD"
+_MATRIX_SPEC_ENV = "LLMTRAIN_BENCH_MATRIX_SPEC"
 # stderr sentinels: the child prints one right before starting an OPTIONAL
-# phase (auto-sweep / ZeRO scenario), so a parent-side timeout after it is
-# "optional phase cut short", not a failure of the main measurement.
+# phase (auto-sweep / ZeRO scenario / matrix), so a parent-side timeout
+# after it is "optional phase cut short", not a failure of the main
+# measurement.
 _SWEEP_MARKER = "[bench] starting auto-sweep"
 _ZERO_MARKER = "[bench] starting zero scenario"
-_OPTIONAL_MARKERS = (_SWEEP_MARKER, _ZERO_MARKER)
+_MATRIX_MARKER = "[bench] starting matrix scenario"
+_OPTIONAL_MARKERS = (_SWEEP_MARKER, _ZERO_MARKER, _MATRIX_MARKER)
+# Loss-parity bands for the quantized matrix scenarios (docs/perf.md
+# "Quantized training"): N quantized steps must track the f32 trajectory
+# within these relative tolerances or the scenario line fails as degraded.
+_MATRIX_RTOL = {"int8": 0.05, "int8_act": 0.05, "fp8": 0.10}
 
 
 # --------------------------------------------------------------------------
@@ -419,26 +427,60 @@ def _child_main() -> None:
     # risk the chip number. The updated line (detail.zero attached)
     # REPLACES the banked one via last-JSON-wins; a failed/skipped
     # scenario leaves the banked line standing.
+    # Optional-scenario bookkeeping (satellite of the matrix work): every
+    # scenario skipped for BUDGET (not failure) lands in the top-level
+    # ``skipped`` list, so tools/perf_gate.py can tell "scenario removed
+    # from the bench" (warn) from "scenario skipped this round" (note).
+    skipped: list[dict] = []
     zero_info = None
-    if (
-        not on_tpu
-        and not explicit
-        and not fallback_child
-        and os.environ.get("LLMTRAIN_BENCH_ZERO", "1") != "0"
-    ):
+    scenarios_on = not on_tpu and not explicit and not fallback_child
+    if scenarios_on and os.environ.get("LLMTRAIN_BENCH_ZERO", "1") != "0":
         zero_budget = min(deadline - (time.perf_counter() - t0) - 60.0, 300.0)
         if zero_budget > 60.0:
             print(_ZERO_MARKER, file=sys.stderr, flush=True)
             zero_info = _zero_scenario(zero_budget)
             if zero_info is not None:
                 result["detail"]["zero"] = zero_info
+                result["skipped"] = skipped
                 print(json.dumps(result), flush=True)
         else:
+            skipped.append({"scenario": "zero", "reason": "deadline budget exhausted"})
             print(
                 "zero scenario skipped: not enough of the deadline budget left",
                 file=sys.stderr,
                 flush=True,
             )
+
+    # Scenario MATRIX (dense/MoE/LoRA x context x loss_impl x
+    # matmul_precision): each scenario runs in its own CPU subprocess —
+    # exactly the _zero_scenario pattern — and lands as a keyed line under
+    # the top-level ``matrix`` dict. Reprinted after EVERY scenario
+    # (last-JSON-wins), so a scenario hanging past the watchdog cannot
+    # lose the ones already measured. CPU children only, same rationale
+    # as the zero scenario.
+    matrix_lines: dict[str, dict] = {}
+    if scenarios_on and os.environ.get("LLMTRAIN_BENCH_MATRIX", "1") != "0":
+        for spec in _matrix_scenarios():
+            remaining = deadline - (time.perf_counter() - t0)
+            if remaining < 90.0:
+                skipped.append(
+                    {"scenario": spec["key"], "reason": "deadline budget exhausted"}
+                )
+                continue
+            print(f"{_MATRIX_MARKER}: {spec['key']}", file=sys.stderr, flush=True)
+            line = _matrix_scenario(spec, min(remaining - 45.0, 180.0))
+            if line is None:
+                skipped.append({"scenario": spec["key"], "reason": "scenario child failed"})
+                continue
+            matrix_lines[spec["key"]] = line
+            result["matrix"] = matrix_lines
+            result["skipped"] = skipped
+            print(json.dumps(result), flush=True)
+        if matrix_lines or skipped:
+            # Final reprint: skips recorded after the last successful
+            # scenario (tail budget exhaustion) must land on stdout too.
+            result["skipped"] = skipped
+            print(json.dumps(result), flush=True)
 
     force_sweep = os.environ.get("LLMTRAIN_BENCH_SWEEP") == "1"  # CPU testing
     # The sweep only makes sense when the main measurement ran the config
@@ -496,6 +538,10 @@ def _child_main() -> None:
                 # The sweep line supersedes the banked one (last JSON
                 # wins); carry the zero scenario forward so it survives.
                 best["detail"]["zero"] = zero_info
+            if matrix_lines:
+                best["matrix"] = matrix_lines
+            if skipped or "skipped" in result:
+                best["skipped"] = skipped
             print(json.dumps(best), flush=True)
 
 
@@ -625,6 +671,251 @@ def _zero_main() -> None:
         "loss_bitwise_identical": off["final_loss"] == on["final_loss"],
     }
     print(json.dumps({"zero_scenario": out}), flush=True)
+
+
+def _matrix_scenarios() -> list[dict]:
+    """The bench scenario matrix: dense/MoE/LoRA x short/long context x
+    loss_impl x matmul_precision, sampled (a full cross product would be
+    36 lines and blow every budget; these 7 cover each axis against the
+    dense/short/dense_ce/f32 baseline). Shapes are tiny on purpose — the
+    matrix measures RELATIVE deltas (quantization, chunked CE, MoE
+    routing, LoRA) per round; tools/perf_gate.py gates each key against
+    the same key last round, never across keys."""
+    base = {"model": "gpt", "seq": 64, "batch": 8, "steps": 3, "extra": {}}
+
+    def spec(key: str, **kw) -> dict:
+        out = {**base, "key": key, **kw}
+        out["extra"] = {**kw.get("extra", {})}
+        prec = out["extra"].get("matmul_precision", "f32")
+        out["parity_rtol"] = _MATRIX_RTOL.get(prec)
+        return out
+
+    return [
+        spec("dense|short|dense_ce|f32", extra={"loss_impl": "dense"}),
+        spec("dense|short|chunked_ce|f32", extra={"loss_impl": "chunked_ce"}),
+        spec(
+            "dense|short|dense_ce|int8",
+            extra={"loss_impl": "dense", "matmul_precision": "int8"},
+        ),
+        spec(
+            "dense|short|dense_ce|fp8",
+            extra={"loss_impl": "dense", "matmul_precision": "fp8"},
+        ),
+        spec("dense|long|chunked_ce|f32", seq=256, extra={"loss_impl": "chunked_ce"}),
+        spec(
+            "moe|short|dense_ce|f32",
+            model="gpt_moe",
+            extra={"loss_impl": "dense", "n_experts": 2},
+        ),
+        spec(
+            "lora|short|dense_ce|f32",
+            extra={"loss_impl": "dense", "lora": {"rank": 4, "alpha": 8}},
+        ),
+    ]
+
+
+def _matrix_scenario(spec: dict, timeout_sec: float) -> dict | None:
+    """Run ONE matrix scenario in a CPU subprocess (same pattern as
+    _zero_scenario: the main child's backend state must not leak into the
+    measurement, and a scenario crash/hang must not sink the banked main
+    line). Returns the scenario line dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop(_CHILD_ENV, None)
+    env[_MATRIX_ENV] = "1"
+    env[_MATRIX_SPEC_ENV] = json.dumps(spec)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_sec,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"matrix scenario {spec['key']} timed out after {timeout_sec:.0f}s; skipping",
+            file=sys.stderr,
+        )
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and "matrix_scenario" in parsed:
+                return parsed["matrix_scenario"]
+    tail = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else "no stderr"
+    print(
+        f"matrix scenario {spec['key']} child failed rc={proc.returncode} ({tail[:200]})",
+        file=sys.stderr,
+    )
+    return None
+
+
+def _matrix_main() -> None:
+    """Matrix scenario child: ONE cell of the scenario matrix measured on
+    the real jitted train step at a tiny CPU shape, with the PR 10 cost
+    attribution embedded. Prints one ``{"matrix_scenario": ...}`` JSON
+    line (no "metric" key — it must never shadow the headline line in the
+    parent's last-JSON-wins parse).
+
+    Quantized cells additionally run the SAME steps at f32 from the same
+    init and gate the loss trajectory: max per-step relative deviation
+    beyond the documented rtol (docs/perf.md "Quantized training") marks
+    the line ``degraded`` so tools/perf_gate.py skips it instead of
+    comparing a numerically-broken run."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.models.lora import build_adapter
+    from llmtrain_tpu.registry import initialize_registries
+    from llmtrain_tpu.training.optimizer import build_optimizer
+    from llmtrain_tpu.training.train_step import create_train_state, make_train_step
+
+    initialize_registries()
+    spec = json.loads(os.environ[_MATRIX_SPEC_ENV])
+    seq, batch, steps = spec["seq"], spec["batch"], spec["steps"]
+    depth, d_model, n_heads, d_ff, vocab = 2, 128, 4, 256, 512
+
+    def measure(extra: dict) -> dict:
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "bench-matrix", "device": "cpu"},
+                "model": {
+                    "name": spec["model"],
+                    "block_size": seq,
+                    "d_model": d_model,
+                    "n_layers": depth,
+                    "n_heads": n_heads,
+                    "d_ff": d_ff,
+                    "dropout": 0.0,
+                    "vocab_size": vocab,
+                    "extra": {**extra, "assume_packed": True},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "micro_batch_size": batch,
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 0,
+                },
+            }
+        )
+        adapter = build_adapter(cfg)
+        model = adapter.build_model(cfg)
+        tx = build_optimizer(cfg.trainer)
+        wrap = getattr(adapter, "wrap_optimizer", None)
+        if wrap is not None:
+            tx = wrap(tx)
+        rng = jax.random.key(0)
+        params = adapter.init_params(model, cfg, rng)
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        state = create_train_state(params, tx)
+        step_fn = jax.jit(
+            make_train_step(adapter, model, tx, grad_accum_steps=1, use_dropout=False),
+            donate_argnums=(0,),
+        )
+        tokens = np.random.default_rng(0).integers(
+            0, vocab, size=(1, batch, seq), dtype=np.int32
+        )
+        batch_dict = {
+            "input_ids": jnp.asarray(tokens),
+            "labels": jnp.asarray(tokens),
+            "attention_mask": jnp.ones_like(jnp.asarray(tokens)),
+        }
+        # Phase A — parity trajectory (includes the compile): per-step
+        # losses from the SAME init, so the quantized cell can be checked
+        # against its f32 twin step-by-step.
+        losses = []
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_dict, rng)
+            losses.append(float(jax.device_get(metrics["loss"])))
+        # Phase B — timing on the warm compile, no per-step sync.
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_dict, rng)
+        jax.device_get(metrics["loss"])
+        elapsed = time.perf_counter() - start
+
+        from llmtrain_tpu.utils.hw import peak_memory_bytes
+
+        attribution = None
+        try:
+            from llmtrain_tpu.telemetry import profiling
+
+            prof = profiling.lower_cost_profile(
+                step_fn, (state, batch_dict, rng), name="matrix_step"
+            )
+            if prof is not None:
+                peaks = profiling.resolve_peaks()
+                roof = profiling.classify_roofline(
+                    flops=prof["flops"],
+                    bytes_accessed=prof["bytes_accessed"],
+                    peaks=peaks,
+                )
+                attribution = {**prof, "roofline": roof}
+        except Exception as exc:  # noqa: BLE001
+            attribution = {"error": str(exc)}
+        return {
+            "tokens_per_sec": round(batch * seq * steps / elapsed, 1),
+            "step_time_ms": round(elapsed / steps * 1e3, 2),
+            "hbm_peak_bytes": int(peak_memory_bytes()),
+            "losses": [round(x, 6) for x in losses],
+            "params": n_params,
+            "effective_precision": getattr(model, "matmul_precision", "f32"),
+            "attribution": attribution,
+        }
+
+    requested = spec["extra"].get("matmul_precision", "f32")
+    measured = measure(spec["extra"])
+    line = {
+        "key": spec["key"],
+        "model": f"{spec['model']} L{depth} d{d_model} T{seq}",
+        "batch": batch,
+        "steps": steps,
+        "loss_impl": spec["extra"].get("loss_impl", "dense"),
+        "matmul_precision": requested,
+        **measured,
+    }
+    rtol = spec.get("parity_rtol")
+    if rtol is not None and measured["effective_precision"] != "f32":
+        # Loss-parity gate: f32 twin from the same init.
+        f32_extra = {**spec["extra"], "matmul_precision": "f32"}
+        ref = measure(f32_extra)
+        diffs = [
+            abs(q - f) / max(abs(f), 1e-6)
+            for q, f in zip(measured["losses"], ref["losses"])
+        ]
+        max_rel = max(diffs) if diffs else 0.0
+        ok = max_rel <= rtol
+        line["parity"] = {
+            "rtol": rtol,
+            "max_rel_diff": round(max_rel, 6),
+            "ok": ok,
+            "f32_losses": ref["losses"],
+            "f32_tokens_per_sec": ref["tokens_per_sec"],
+        }
+        if not ok:
+            line["degraded"] = True
+            line["fallback"] = (
+                f"loss parity vs f32 failed: max rel diff {max_rel:.4f} > rtol {rtol}"
+            )
+    elif rtol is not None:
+        # Backend can't run the requested low-precision dot; the clean f32
+        # fallback ran instead. Documented behavior, not a degradation —
+        # but the key must not pretend it measured the quantized path.
+        line["parity"] = {
+            "rtol": rtol,
+            "ok": True,
+            "note": f"{requested} unsupported on this backend; f32 fallback measured",
+        }
+    print(json.dumps({"matrix_scenario": line}), flush=True)
 
 
 def _measure_with_ladder(run, att: str, batch: int, loss_impl: str, attempts: int) -> dict:
@@ -850,7 +1141,9 @@ def _run(
 
 
 if __name__ == "__main__":
-    if os.environ.get(_ZERO_ENV) == "1":
+    if os.environ.get(_MATRIX_ENV) == "1":
+        _matrix_main()
+    elif os.environ.get(_ZERO_ENV) == "1":
         _zero_main()
     elif os.environ.get(_PROBE_ENV) == "1":
         _probe_main()

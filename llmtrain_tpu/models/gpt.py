@@ -25,6 +25,7 @@ TPU-first divergences (the point of the rebuild):
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any
 
@@ -56,6 +57,25 @@ REMAT_POLICIES = {
 def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
     """Residual-projection init, std 0.02/sqrt(2*n_layers) (reference :151-165)."""
     return nn.initializers.normal(stddev=0.02 / math.sqrt(2 * n_layers))
+
+
+logger = logging.getLogger(__name__)
+
+_CE_AUTO_LOGGED = False
+
+
+def _log_ce_auto_select(vocab_size: int, ce_auto_vocab: int) -> None:
+    """One-time (per process) log naming the chunked_ce auto-selection."""
+    global _CE_AUTO_LOGGED
+    if not _CE_AUTO_LOGGED:
+        _CE_AUTO_LOGGED = True
+        logger.info(
+            "loss_impl auto-selected: chunked_ce (vocab_size %d >= "
+            "model.extra.ce_auto_vocab %d and loss_impl unset; pass "
+            "loss_impl: dense to override)",
+            vocab_size,
+            ce_auto_vocab,
+        )
 
 
 class CausalSelfAttention(nn.Module):
@@ -125,6 +145,11 @@ class CausalSelfAttention(nn.Module):
     paged: bool = False
     paged_num_blocks: int = 0
     paged_block_tokens: int = 0
+    # Quantized training matmuls (ops/quant.py, model.extra.matmul_precision):
+    # "int8"/"int8_act"/"fp8" route every projection through
+    # quant_dot_general — straight-through gradients, f32 master weights,
+    # unchanged param tree. "f32" keeps the stock flax path bit-identical.
+    matmul_precision: str = "f32"
 
     @nn.compact
     def __call__(
@@ -144,6 +169,11 @@ class CausalSelfAttention(nn.Module):
                 f"sliding_window is not supported with attention="
                 f"{self.attention!r}; use 'flash' or 'dense'"
             )
+        # None under "f32": the stock flax dot path, bit-identical to a
+        # build without the knob (ops/quant.quant_dot_general contract).
+        from ..ops.quant import quant_dot_general
+
+        quant_dg = quant_dot_general(self.matmul_precision)
 
         if kv_heads == self.n_heads:
             qkv = nn.DenseGeneral(
@@ -156,6 +186,7 @@ class CausalSelfAttention(nn.Module):
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), ("qkv", "heads", "kv")
                 ),
+                dot_general=quant_dg,
                 name="qkv_proj",
             )(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -175,6 +206,7 @@ class CausalSelfAttention(nn.Module):
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), ("heads", "kv")
                 ),
+                dot_general=quant_dg,
                 name="q_proj",
             )(x)
             kv = nn.DenseGeneral(
@@ -187,6 +219,7 @@ class CausalSelfAttention(nn.Module):
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), ("qkv", "heads", "kv")
                 ),
+                dot_general=quant_dg,
                 name="kv_proj",
             )(x)
             k, v = kv[:, :, 0], kv[:, :, 1]
@@ -293,6 +326,7 @@ class CausalSelfAttention(nn.Module):
                 _scaled_init(self.n_layers), ("heads", "kv", "embed")
             ),
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+            dot_general=quant_dg,
             name="out_proj",
         )(out)
         out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
@@ -652,6 +686,8 @@ class TransformerBlock(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     router_top_k: int = 1
+    # Quantized training matmuls (ops/quant.py): see CausalSelfAttention.
+    matmul_precision: str = "f32"
 
     @nn.compact
     def __call__(
@@ -687,6 +723,7 @@ class TransformerBlock(nn.Module):
             paged=self.paged,
             paged_num_blocks=self.paged_num_blocks,
             paged_block_tokens=self.paged_block_tokens,
+            matmul_precision=self.matmul_precision,
             name="attn",
         )(
             h,
@@ -710,15 +747,20 @@ class TransformerBlock(nn.Module):
                 router_top_k=self.router_top_k,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                matmul_precision=self.matmul_precision,
                 name="moe_mlp",
             )(h)
         else:
+            from ..ops.quant import quant_dot_general
+
+            quant_dg = quant_dot_general(self.matmul_precision)
             h = nn.Dense(
                 self.d_ff,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_DENSE_INIT, ("embed", "mlp")),
                 bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("mlp",)),
+                dot_general=quant_dg,
                 name="mlp_fc",
             )(h)
             h = nn.with_logical_constraint(h, ("batch", "length", "act_mlp"))
@@ -729,6 +771,7 @@ class TransformerBlock(nn.Module):
                 param_dtype=self.param_dtype,
                 kernel_init=nn.with_logical_partitioning(_scaled_init(self.n_layers), ("mlp", "embed")),
                 bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+                dot_general=quant_dg,
                 name="mlp_proj",
             )(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
@@ -794,6 +837,15 @@ class GPT(nn.Module):
     paged: bool = False
     paged_num_blocks: int = 0
     paged_block_tokens: int = 0
+    # Quantized training matmuls (model.extra.matmul_precision, ops/quant.py):
+    # "int8" quantizes projection weights per-channel with straight-through
+    # gradients; "int8_act" also fake-quantizes activations; "fp8" runs
+    # float8_e4m3fn matmuls where the backend supports them (the adapter
+    # capability-resolves fp8 -> f32 with a warning otherwise). Embeddings
+    # and the lm_head stay in the compute dtype — they are the
+    # quality-sensitive ends of the stack and a rounding error of the
+    # matmul byte budget. Param tree and checkpoints are unchanged.
+    matmul_precision: str = "f32"
 
     def for_paged_decoding(
         self, *, num_blocks: int, block_tokens: int
@@ -953,6 +1005,7 @@ class GPT(nn.Module):
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
                 router_top_k=self.router_top_k,
+                matmul_precision=self.matmul_precision,
                 name=f"block_{layer}",
             )
             if paged:
@@ -1005,7 +1058,7 @@ class GPTAdapter(ModelAdapter):
     known_extra_keys = frozenset(
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
          "assume_packed", "remat_policy", "sliding_window",
-         "kv_cache_dtype"}
+         "kv_cache_dtype", "matmul_precision", "ce_auto_vocab"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -1016,12 +1069,23 @@ class GPTAdapter(ModelAdapter):
             if not isinstance(tokenizer_vocab_size, int) or tokenizer_vocab_size <= 0:
                 raise ValueError("GPT tokenizer must expose a positive integer n_vocab.")
             vocab_size = tokenizer_vocab_size
-        loss_impl = cfg.model.extra.get("loss_impl", "dense")
-        if loss_impl not in ("dense", "chunked_ce"):
-            raise ValueError(
-                f"model.extra.loss_impl {loss_impl!r} unknown; "
-                "expected 'dense' or 'chunked_ce'"
-            )
+        ce_auto_vocab = self._positive_extra(cfg, "ce_auto_vocab", 32768)
+        if "loss_impl" in cfg.model.extra:
+            loss_impl = cfg.model.extra["loss_impl"]
+            if loss_impl not in ("dense", "chunked_ce"):
+                raise ValueError(
+                    f"model.extra.loss_impl {loss_impl!r} unknown; "
+                    "expected 'dense' or 'chunked_ce'"
+                )
+        elif vocab_size >= ce_auto_vocab:
+            # Auto-select the streamed CE at large vocab: the [B,T,V]
+            # logits tensor is the top memory-bound op in the 50k-vocab
+            # roofline table (docs/perf.md), and chunked_ce never builds
+            # it. Explicit `loss_impl: dense` always wins above.
+            loss_impl = "chunked_ce"
+            _log_ce_auto_select(vocab_size, ce_auto_vocab)
+        else:
+            loss_impl = "dense"
         ce_chunk = self._positive_extra(cfg, "ce_chunk", 8192)
         z_loss = float(cfg.model.extra.get("z_loss", 0.0))
         if z_loss < 0.0:
@@ -1064,6 +1128,14 @@ class GPTAdapter(ModelAdapter):
                 "model.extra.sliding_window is not supported with "
                 f"attention={cfg.model.attention!r}; use 'flash' or 'dense'"
             )
+        # Validated like loss_impl (unknown raises at config time) then
+        # capability-resolved: fp8 on a backend without float8 matmuls
+        # degrades to f32 with a one-time warning (ops/quant.py).
+        from ..ops.quant import resolve_matmul_precision
+
+        matmul_precision = resolve_matmul_precision(
+            str(cfg.model.extra.get("matmul_precision", "f32"))
+        )
         return GPT(
             vocab_size=vocab_size,
             block_size=cfg.model.block_size,
@@ -1085,6 +1157,7 @@ class GPTAdapter(ModelAdapter):
             remat_policy=remat_policy,
             sliding_window=sliding_window,
             kv_cache_dtype=kv_cache_dtype,
+            matmul_precision=matmul_precision,
         )
 
     def build_tokenizer(self, cfg: RunConfig) -> Any | None:

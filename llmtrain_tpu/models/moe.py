@@ -44,6 +44,26 @@ def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
     return nn.initializers.normal(stddev=0.02 / math.sqrt(2 * n_layers))
 
 
+def _expert_matmul(x: jax.Array, w: jax.Array, mode: str, spec: str) -> jax.Array:
+    """Expert-batched matmul, optionally quantized (ops/quant.py).
+
+    ``x`` (E, B, C, d_in) against stacked expert kernels ``w``
+    (E, d_in, d_out) -> (E, B, C, d_out). ``mode`` "f32" keeps the
+    original einsum (bit-identical to the pre-quantization build); the
+    quantized modes route through ``quant_dot_general`` with the same
+    contraction expressed as dot_general dimension numbers (batch dim E,
+    contracting dim d_in) — per-(expert, output-unit) int8 scales,
+    straight-through gradients to the f32 master weights. Only the
+    expert kernels quantize: router and dispatch/combine one-hots are
+    routing decisions, not matmul bandwidth, and stay f32.
+    """
+    if mode == "f32":
+        return jnp.einsum(spec, x, w)
+    from ..ops.quant import quant_dot_general
+
+    return quant_dot_general(mode)(x, w, (((3,), (1,)), ((0,), (0,))))
+
+
 class MoEMLP(nn.Module):
     """Drop-in replacement for the dense MLP inside a transformer block."""
 
@@ -60,6 +80,8 @@ class MoEMLP(nn.Module):
     # "swiglu" (Mixtral/llama family: silu(x·wg) * (x·wu) → wo, bias-free
     # — the same block shape as models/llama.py's dense SwiGLU).
     mlp_type: str = "gelu"
+    # Quantized expert matmuls (ops/quant.py): see _expert_matmul.
+    matmul_precision: str = "f32"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -165,13 +187,21 @@ class MoEMLP(nn.Module):
                 (n_exp, self.d_ff, d_model),
                 self.param_dtype,
             )
-            gate = jnp.einsum("ebcd,edf->ebcf", expert_in, wg.astype(self.dtype))
-            up = jnp.einsum("ebcd,edf->ebcf", expert_in, wu.astype(self.dtype))
+            gate = _expert_matmul(
+                expert_in, wg.astype(self.dtype), self.matmul_precision,
+                "ebcd,edf->ebcf",
+            )
+            up = _expert_matmul(
+                expert_in, wu.astype(self.dtype), self.matmul_precision,
+                "ebcd,edf->ebcf",
+            )
             h = nn.silu(gate) * up
             h = nn.with_logical_constraint(
                 h, ("act_expert", "act_expert_group", None, "act_mlp")
             )
-            expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+            expert_out = _expert_matmul(
+                h, wo.astype(self.dtype), self.matmul_precision, "ebcf,efd->ebcd"
+            )
         elif self.mlp_type == "gelu":
             wi = self.param(
                 "wi",
@@ -200,11 +230,16 @@ class MoEMLP(nn.Module):
                 self.param_dtype,
             )
 
-            h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(self.dtype))
+            h = _expert_matmul(
+                expert_in, wi.astype(self.dtype), self.matmul_precision,
+                "ebcd,edf->ebcf",
+            )
             h = h + bi.astype(self.dtype)[:, None, None, :]
             h = nn.with_logical_constraint(h, ("act_expert", "act_expert_group", None, "act_mlp"))
             h = nn.gelu(h, approximate=False)
-            expert_out = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+            expert_out = _expert_matmul(
+                h, wo.astype(self.dtype), self.matmul_precision, "ebcf,efd->ebcd"
+            )
             expert_out = expert_out + bo.astype(self.dtype)[:, None, None, :]
         else:
             raise ValueError(

@@ -203,6 +203,7 @@ class Telemetry:
         *,
         run_id: str | None = None,
         perf_attribution: dict[str, Any] | None = None,
+        precision: dict[str, Any] | None = None,
     ) -> dict[str, Any] | None:
         """End-of-run: final flush, Perfetto export, report.json/report.md.
 
@@ -232,6 +233,7 @@ class Telemetry:
                 wall_time_sec=wall,
                 train_result=train_result,
                 perf_attribution=perf_attribution,
+                precision=precision,
             )
             if self._writes_files:
                 write_reports(self._run_dir, report)

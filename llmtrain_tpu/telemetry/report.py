@@ -56,6 +56,7 @@ def build_report(
     train_result: dict[str, Any] | None = None,
     serving: dict[str, Any] | None = None,
     perf_attribution: dict[str, Any] | None = None,
+    precision: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Aggregate the telemetry state into the report dict."""
     latest = registry.latest()
@@ -166,6 +167,13 @@ def build_report(
         # step-time split — docs/observability.md "Attribution and
         # rooflines" documents the schema.
         report["perf_attribution"] = perf_attribution
+    if precision is not None:
+        # Numerics provenance (docs/perf.md "Quantized matmul training"):
+        # the EFFECTIVE dtypes/paths the run compiled with — compute and
+        # param dtype, loss_impl (incl. the large-vocab auto-selection),
+        # and the capability-resolved matmul_precision — so a throughput
+        # number in this report can never be quoted without its numerics.
+        report["precision"] = precision
     if train_result is not None:
         report["train_result"] = train_result
     return report

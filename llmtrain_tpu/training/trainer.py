@@ -1256,11 +1256,23 @@ class Trainer:
                 train_result=asdict(result),
                 run_id=self._run_dir.name if self._run_dir is not None else None,
                 perf_attribution=perf_attribution,
+                precision=self._precision_block(),
             )
             self._telemetry.register_artifacts()
         except Exception as exc:  # noqa: BLE001 — reporting must not fail the run
             logger.warning("telemetry finalize failed: %s", exc)
         return result
+
+    def _precision_block(self) -> dict[str, Any]:
+        """Numerics provenance for report.json: the EFFECTIVE values the
+        model compiled with (post auto-selection / capability fallback),
+        read off the built module — not the raw config keys."""
+        return {
+            "dtype": str(self._cfg.model.dtype),
+            "param_dtype": str(self._cfg.model.param_dtype),
+            "loss_impl": getattr(self._model, "loss_impl", "dense"),
+            "matmul_precision": getattr(self._model, "matmul_precision", "f32"),
+        }
 
     def _probe_seqlen(self, dataset) -> int:
         return self._dataset_spec(dataset)[1]

@@ -26,6 +26,20 @@ apples-vs-oranges gets deleted within a week):
   fallback hosts are shared and wobble; TPU rounds can pass a tighter
   ``--noise``).
 
+Matrix scenarios (the top-level ``matrix`` dict bench.py emits — one
+keyed line per dense/MoE/LoRA x context x loss_impl x matmul_precision
+cell) gate key-by-key with their own rules:
+
+* A key present only in the NEW round is informational — new scenarios
+  never gate (there is nothing to regress against).
+* A key present only in the OLD round warns ("scenario removed"), unless
+  the new round's top-level ``skipped`` list names it — then it was
+  skipped for budget this round, a note, not a warning.
+* A matrix line flagged ``degraded`` (e.g. the quantized loss-parity
+  gate failed, bench.py ``parity``) is skipped, never compared.
+* Comparable pairs gate on ``tokens_per_sec`` with the same noise bound
+  and the same >1% analytical-flops drift skip as the headline lines.
+
 Usage::
 
     python tools/perf_gate.py BENCH_r04.json BENCH_r05.json
@@ -134,6 +148,107 @@ def compare(
     return {"compared": compared, "regressions": regressions, "skipped": skipped}
 
 
+def matrix_lines(results: list[dict[str, Any]]) -> dict[str, dict[str, Any]]:
+    """Keyed matrix scenario lines across a round's bench lines (the
+    last line carrying a ``matrix`` dict wins, matching bench.py's
+    last-JSON-wins carry-forward)."""
+    lines: dict[str, dict[str, Any]] = {}
+    for result in results:
+        mat = result.get("matrix")
+        if isinstance(mat, dict):
+            lines = {k: v for k, v in mat.items() if isinstance(v, dict)}
+    return lines
+
+
+def skipped_scenarios(results: list[dict[str, Any]]) -> set[str]:
+    """Scenario names the round reports as skipped-for-budget (bench.py
+    top-level ``skipped`` list) — distinguishes "absent because removed"
+    from "absent because this round ran out of budget"."""
+    names: set[str] = set()
+    for result in results:
+        for entry in result.get("skipped") or []:
+            if isinstance(entry, dict) and "scenario" in entry:
+                names.add(str(entry["scenario"]))
+    return names
+
+
+def _matrix_flops(line: dict[str, Any]) -> float | None:
+    attr = line.get("attribution")
+    if isinstance(attr, dict) and "flops" in attr:
+        try:
+            return float(attr["flops"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def compare_matrix(
+    old: list[dict[str, Any]],
+    new: list[dict[str, Any]],
+    *,
+    noise: float = DEFAULT_NOISE,
+) -> dict[str, Any]:
+    """Key-by-key matrix gate (pure, unit-tested via --self-test).
+
+    Returns {"compared", "regressions", "skipped", "notes"}; only
+    ``regressions`` affects the exit code — new keys and removed keys
+    land in ``notes`` (informational / warning) by design."""
+    old_mat, new_mat = matrix_lines(old), matrix_lines(new)
+    new_skipped = skipped_scenarios(new)
+    regressions: list[dict[str, Any]] = []
+    compared: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    notes: list[str] = []
+    for key, line in new_mat.items():
+        if is_degraded(line):
+            skipped.append(
+                f"matrix:{key}: line degraded ({line.get('fallback') or 'flagged'})"
+            )
+            continue
+        prev = old_mat.get(key)
+        if prev is None:
+            notes.append(f"matrix:{key}: new scenario (informational, never gates)")
+            continue
+        if is_degraded(prev):
+            skipped.append(f"matrix:{key}: old line degraded; nothing to gate against")
+            continue
+        f_old, f_new = _matrix_flops(prev), _matrix_flops(line)
+        if f_old and f_new and abs(f_new - f_old) / max(f_old, 1.0) > _FLOPS_DRIFT:
+            skipped.append(
+                f"matrix:{key}: analytical flops drifted {f_old:.3g} -> {f_new:.3g}; "
+                "workload changed, not comparable"
+            )
+            continue
+        old_v = float(prev.get("tokens_per_sec", 0.0))
+        new_v = float(line.get("tokens_per_sec", 0.0))
+        entry = {
+            "scenario": f"matrix:{key}",
+            "metric": "tokens_per_sec",
+            "old": old_v,
+            "new": new_v,
+            "ratio": new_v / old_v if old_v else float("inf"),
+        }
+        compared.append(entry)
+        if old_v > 0 and new_v < old_v * (1.0 - noise):
+            regressions.append(entry)
+    for key in old_mat:
+        if key in new_mat:
+            continue
+        if key in new_skipped:
+            notes.append(f"matrix:{key}: skipped for budget this round (not removed)")
+        else:
+            notes.append(
+                f"matrix:{key}: WARNING scenario removed (present last round, "
+                "absent and not in the new round's skipped list)"
+            )
+    return {
+        "compared": compared,
+        "regressions": regressions,
+        "skipped": skipped,
+        "notes": notes,
+    }
+
+
 def _latest_pair(root: str) -> tuple[str, str] | None:
     def round_no(path: str) -> int:
         m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -174,6 +289,61 @@ def _self_test() -> int:
     assert not verdict["regressions"] and verdict["skipped"], "degraded must skip"
     verdict = compare([base], [variant(value=500.0, flops=2.0e9)])
     assert not verdict["regressions"] and verdict["skipped"], "flops drift must skip"
+
+    # --- matrix gate (compare_matrix) ---------------------------------
+    def mline(tps: float, flops: float = 5.0e8, **kw: Any) -> dict[str, Any]:
+        out = {"tokens_per_sec": tps, "attribution": {"flops": flops}}
+        out.update(kw)
+        return out
+
+    def round_(mat: dict[str, Any], skipped: list[dict] | None = None) -> list[dict]:
+        line = json.loads(json.dumps(base))
+        line["matrix"] = mat
+        line["skipped"] = skipped or []
+        return [line]
+
+    old_round = round_({"dense|short|dense_ce|f32": mline(1000.0)})
+    # A genuine matrix regression gates.
+    verdict = compare_matrix(old_round, round_({"dense|short|dense_ce|f32": mline(400.0)}))
+    assert verdict["regressions"], "60% matrix drop must gate"
+    # New key NEVER gates, however bad its number looks.
+    verdict = compare_matrix(
+        old_round,
+        round_(
+            {
+                "dense|short|dense_ce|f32": mline(1000.0),
+                "dense|short|dense_ce|int8": mline(1.0),
+            }
+        ),
+    )
+    assert not verdict["regressions"], "new matrix key must never gate"
+    assert any("new scenario" in n for n in verdict["notes"]), "new key must note"
+    # Removed key warns ...
+    verdict = compare_matrix(old_round, round_({}))
+    assert not verdict["regressions"], "removed key must not gate"
+    assert any("WARNING scenario removed" in n for n in verdict["notes"]), "removed key must warn"
+    # ... unless the new round's skipped list names it (budget skip).
+    verdict = compare_matrix(
+        old_round,
+        round_({}, skipped=[{"scenario": "dense|short|dense_ce|f32", "reason": "budget"}]),
+    )
+    assert not any("WARNING" in n for n in verdict["notes"]), "budget skip must not warn"
+    assert any("skipped for budget" in n for n in verdict["notes"]), "budget skip must note"
+    # A degraded line (failed loss-parity gate) is skipped, never compared.
+    verdict = compare_matrix(
+        old_round,
+        round_(
+            {
+                "dense|short|dense_ce|f32": mline(
+                    400.0,
+                    degraded=True,
+                    fallback="loss parity vs f32 failed: max rel diff 0.2 > rtol 0.05",
+                    parity={"rtol": 0.05, "max_rel_diff": 0.2, "ok": False},
+                )
+            }
+        ),
+    )
+    assert not verdict["regressions"] and verdict["skipped"], "degraded parity line must skip"
     print("perf_gate self-test: OK")
     return 0
 
@@ -204,19 +374,26 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     verdict = compare(old, new, noise=args.noise)
+    matrix_verdict = compare_matrix(old, new, noise=args.noise)
+    regressions = verdict["regressions"] + matrix_verdict["regressions"]
     print(f"perf_gate: {pair[0]} -> {pair[1]} (noise bound {args.noise:.0%})")
-    for entry in verdict["compared"]:
-        flag = "REGRESSION" if entry in verdict["regressions"] else "ok"
+    for entry in verdict["compared"] + matrix_verdict["compared"]:
+        flag = "REGRESSION" if entry in regressions else "ok"
         print(
             f"  [{flag}] {entry['scenario']}: {entry['old']:.1f} -> "
             f"{entry['new']:.1f} ({entry['ratio']:.2%} of old)"
         )
-    for note in verdict["skipped"]:
+    for note in verdict["skipped"] + matrix_verdict["skipped"]:
         print(f"  [skip] {note}")
-    if not verdict["compared"] and not verdict["skipped"]:
+    for note in matrix_verdict["notes"]:
+        print(f"  [note] {note}")
+    if not any(
+        (verdict["compared"], verdict["skipped"], matrix_verdict["compared"],
+         matrix_verdict["skipped"], matrix_verdict["notes"])
+    ):
         print("  no bench lines found")
-    if verdict["regressions"]:
-        print(f"perf_gate: FAIL ({len(verdict['regressions'])} regression(s))")
+    if regressions:
+        print(f"perf_gate: FAIL ({len(regressions)} regression(s))")
         return 1
     print("perf_gate: PASS")
     return 0
