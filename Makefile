@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant verify-goodput train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -91,6 +91,18 @@ verify-profile:
 # own self-test (new-key/removed-key/degraded-parity matrix cases).
 verify-quant:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant_train.py -q
+	python tools/perf_gate.py --self-test
+
+# Goodput-ledger suite (docs/observability.md "Goodput"): synthetic-
+# timeline taxonomy tables (exact second splits), the ledger-balances
+# invariant through the real Telemetry facade + `llmtrain goodput` CLI,
+# suspension-window carving — PLUS the @pytest.mark.slow drills plain
+# `make test` skips: a mid-interval SIGKILL leaving a torn timeline that
+# still balances, the 3-cycle chaos drill with recomputed_sec > 0 and
+# post-mortem CLI reproducibility, and the fleet-storm goodput floor.
+# Ends with the perf gate's own self-test (goodput regression cases).
+verify-goodput:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_goodput.py -q
 	python tools/perf_gate.py --self-test
 
 # Continuous-batching serving suite (docs/serving.md): paged-KV pool

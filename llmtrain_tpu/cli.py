@@ -590,6 +590,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="print the full report JSON to stdout"
     )
 
+    goodput = sub.add_parser(
+        "goodput",
+        help="render the wall-clock goodput ledger for any past run from "
+        "its durable artifacts alone — no rerun, no live process "
+        "(telemetry/goodput.py, docs/observability.md 'Goodput')",
+    )
+    goodput.add_argument(
+        "--run-dir",
+        required=True,
+        help="run directory holding telemetry/timeline.jsonl (+ optional "
+        "checkpoints/ and heartbeat)",
+    )
+    goodput.add_argument(
+        "--json", action="store_true", help="emit the ledger as JSON"
+    )
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -2136,6 +2152,47 @@ def _handle_chaos(args: argparse.Namespace) -> int:
             f"uninterrupted reference (final_loss="
             f"{result['final_loss']}); artifacts in {result['work_dir']}"
         )
+        if result.get("goodput"):
+            gp = result["goodput"]
+            print(
+                f"goodput: {gp['goodput_frac']:.4f} of {gp['wall_clock_sec']}s "
+                f"wall-clock across {gp['num_segments']} segment(s) "
+                f"(recomputed {gp['categories']['recomputed']}s, "
+                f"restart_overhead {gp['categories']['restart_overhead']}s) — "
+                "full ledger via `llmtrain goodput --run-dir "
+                f"{result['work_dir']}/runs/chaos`"
+            )
+    return EXIT_OK
+
+
+def _handle_goodput(args: argparse.Namespace) -> int:
+    """Post-hoc goodput ledger for any past run directory.
+
+    Pure artifact read (timeline.jsonl + manifests + heartbeat mtime):
+    works with every process of the run dead, which is the point. Exit 0
+    with the ledger; exit 1 when the run dir has no segment-delimited
+    timeline (pre-ledger run or telemetry disabled)."""
+    from pathlib import Path
+
+    from .telemetry.goodput import compute_goodput, render_goodput_md
+
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        _emit_error(f"run dir not found: {run_dir}")
+        return EXIT_CONFIG_ERROR
+    ledger = compute_goodput(run_dir)
+    if ledger is None:
+        _emit_error(
+            f"no goodput ledger for {run_dir}: telemetry/timeline.jsonl is "
+            "missing or carries no segment headers (run predates the "
+            "ledger, or telemetry.timeline was disabled)"
+        )
+        return EXIT_TRAIN_FAILURE
+    if args.json:
+        print(json.dumps(ledger))
+    else:
+        print(f"# Goodput — {run_dir}\n")
+        print(render_goodput_md(ledger), end="")
     return EXIT_OK
 
 
@@ -2711,6 +2768,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_average_checkpoints(args)
     if args.command == "profile":
         return _handle_profile(args)
+    if args.command == "goodput":
+        return _handle_goodput(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

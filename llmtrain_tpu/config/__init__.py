@@ -7,6 +7,7 @@ from .loader import (
     resolve_config_path,
 )
 from .schemas import (
+    ChaosConfig,
     DataConfig,
     DistributedConfig,
     FaultInjectionConfig,
@@ -24,6 +25,7 @@ from .schemas import (
 )
 
 __all__ = [
+    "ChaosConfig",
     "ConfigLoadError",
     "DataConfig",
     "DistributedConfig",

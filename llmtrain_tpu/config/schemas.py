@@ -356,6 +356,21 @@ class WatchdogConfig(BaseModel):
     model_config = _STRICT
 
 
+class ChaosConfig(BaseModel):
+    """Chaos/storm drill gates (resilience/chaos.py, fleet/chaos.py).
+
+    ``min_goodput_frac`` is the configurable goodput floor asserted by the
+    single-run chaos drill and per tenant by the fleet storm: the
+    productive_train share of total wall-clock (telemetry/goodput.py)
+    must not fall below it after all kill/resume cycles. 0.0 (default)
+    checks only that the ledger exists and balances.
+    """
+
+    min_goodput_frac: float = Field(0.0, ge=0.0, le=1.0)
+
+    model_config = _STRICT
+
+
 class ResilienceConfig(BaseModel):
     """Fault-tolerance knobs (llmtrain_tpu/resilience/).
 
@@ -387,6 +402,8 @@ class ResilienceConfig(BaseModel):
     # Hang watchdog + heartbeat + straggler telemetry.
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     faults: FaultInjectionConfig = Field(default_factory=FaultInjectionConfig)
+    # Chaos-drill gates (goodput floor) — resilience/chaos.py.
+    chaos: ChaosConfig = Field(default_factory=ChaosConfig)
 
     model_config = _STRICT
 

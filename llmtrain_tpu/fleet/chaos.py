@@ -501,6 +501,28 @@ def run_fleet_storm(
                     )
                     if diff is not None:
                         failures.append(diff)
+        # Goodput floor: the supervisor already attributed each tenant's
+        # wall clock (suspension windows included) in _tenant_report; a
+        # storm that recovers correctness but burns the clock on restart
+        # churn fails here, not in a dashboard three days later.
+        ledger = view.get("goodput")
+        floor = cfg.resilience.chaos.min_goodput_frac
+        if ledger is None:
+            failures.append(f"{name}: no goodput ledger in fleet report")
+        else:
+            wall = float(ledger["wall_clock_sec"])
+            attributed = sum(float(v) for v in ledger["categories"].values())
+            if abs(attributed - wall) > 0.01 * wall + 0.05:
+                failures.append(
+                    f"{name}: goodput ledger does not balance: "
+                    f"{attributed:.3f}s attributed vs {wall:.3f}s wall"
+                )
+            if floor > 0.0 and float(ledger["goodput_frac"]) < floor:
+                failures.append(
+                    f"{name}: goodput_frac {ledger['goodput_frac']:.4f} "
+                    f"below floor {floor} "
+                    f"(categories: {ledger['categories']})"
+                )
         tenant_results[name] = {
             "evictions": view["evictions"],
             "respawns": view["respawns"],
@@ -512,6 +534,7 @@ def run_fleet_storm(
             "trajectory_points_compared": overlap,
             "skipped_partial_points": skipped,
             "final_loss": view["final_loss"],
+            "goodput": ledger,
         }
 
     if drop_to < cfg.fleet.pool_devices and fleet_report["capacity_changes"] < 2:
@@ -539,6 +562,7 @@ def run_fleet_storm(
         "total_evictions": fleet_report["totals"]["evictions"],
         "total_respawns": fleet_report["totals"]["respawns"],
         "total_suspensions": fleet_report["totals"]["suspensions"],
+        "fleet_goodput_frac": fleet_report["totals"].get("goodput_frac"),
         "bitwise_match": all(
             r["parity"] == "bitwise" for r in tenant_results.values()
         ),

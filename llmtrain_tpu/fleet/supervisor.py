@@ -130,6 +130,13 @@ class _Tenant:
         self.segments: list[dict[str, Any]] = []
         self.counts: Counter = Counter()
         self.exit_codes: list[int] = []
+        # Allocation-0 windows in WALL-CLOCK time (time.time(), not
+        # monotonic — the goodput ledger intersects them with timeline
+        # segment boundaries, which are unix stamps). The state machine's
+        # history records transitions without timestamps on purpose, so
+        # the supervisor tracks the windows itself.
+        self.suspension_windows: list[tuple[float, float]] = []
+        self.suspended_since: float | None = None
         self.next_spawn_at = 0.0
         self.kill_deadline: float | None = None
         self.hard_evict_requested = False
@@ -169,6 +176,20 @@ class _Tenant:
     def heartbeat_age(self) -> float | None:
         hb = self.run_dir / "heartbeat"
         return heartbeat_age_seconds(hb) if hb.exists() else None
+
+    def close_suspension(self) -> None:
+        """Close the open allocation-0 window (tenant relaunching, or the
+        report is being finalized)."""
+        if self.suspended_since is not None:
+            self.suspension_windows.append((self.suspended_since, time.time()))
+            self.suspended_since = None
+
+    def all_suspension_windows(self) -> list[tuple[float, float]]:
+        """Closed windows plus the still-open one, if any, up to now."""
+        out = list(self.suspension_windows)
+        if self.suspended_since is not None:
+            out.append((self.suspended_since, time.time()))
+        return out
 
     def evictions_total(self) -> int:
         return (
@@ -690,6 +711,7 @@ class FleetSupervisor:
                 if target == 0:
                     t.counts["suspensions"] += 1
                     self.metrics.inc("fleet/suspensions")
+                    t.suspended_since = time.time()
                     t.sm.transition(ts.SUSPENDED, "no capacity granted")
                 elif now >= t.next_spawn_at and self._fits(t, target):
                     self._launch(t, target)
@@ -702,6 +724,7 @@ class FleetSupervisor:
                     and now >= t.next_spawn_at
                     and self._fits(t, target)
                 ):
+                    t.close_suspension()
                     self._launch(t, target)
             elif state == ts.QUEUED:
                 if target > 0 and self._fits(t, target):
@@ -877,7 +900,21 @@ class FleetSupervisor:
             except (OSError, ValueError):
                 pass
         hb = t.heartbeat_age()
+        # Per-tenant goodput ledger over the tenant's OWN durable run-dir
+        # artifacts, with the supervisor's wall-clock allocation-0 windows
+        # carved out of restart_overhead as `suspended` — the PR-8
+        # eviction/respawn/suspension COUNTS become seconds here.
+        goodput: dict[str, Any] | None = None
+        try:
+            from ..telemetry.goodput import compute_goodput
+
+            goodput = compute_goodput(
+                t.run_dir, suspensions=t.all_suspension_windows()
+            )
+        except Exception as exc:  # noqa: BLE001 — reporting must not fail the fleet
+            logger.warning("fleet: goodput ledger for %s failed: %s", t.name, exc)
         return {
+            "goodput": goodput,
             "state": t.sm.state,
             "priority": t.cfg.priority,
             "min_devices": t.cfg.min_devices,
@@ -944,6 +981,37 @@ class FleetSupervisor:
                 ),
             },
         }
+        # Fleet-wide goodput: second-weighted across tenants (sum of
+        # productive seconds over sum of wall seconds), not a mean of
+        # per-tenant fractions — a tiny tenant must not swing the fleet.
+        ledgers = [
+            v["goodput"] for v in tenants.values() if v.get("goodput")
+        ]
+        goodput_totals: dict[str, float] = {}
+        for ledger in ledgers:
+            for cat, sec in ledger["categories"].items():
+                goodput_totals[cat] = round(
+                    goodput_totals.get(cat, 0.0) + float(sec), 3
+                )
+        fleet_wall = sum(float(x["wall_clock_sec"]) for x in ledgers)
+        fleet_frac = (
+            goodput_totals.get("productive_train", 0.0) / fleet_wall
+            if fleet_wall > 0
+            else 0.0
+        )
+        report["totals"]["goodput_sec"] = goodput_totals
+        report["totals"]["goodput_wall_clock_sec"] = round(fleet_wall, 3)
+        report["totals"]["goodput_frac"] = round(fleet_frac, 6)
+        self.metrics.publish(
+            {
+                "fleet/goodput_frac": fleet_frac,
+                "fleet/goodput_wall_clock_sec": fleet_wall,
+                **{
+                    f"fleet/goodput_{cat}_sec": sec
+                    for cat, sec in goodput_totals.items()
+                },
+            }
+        )
         # Final metrics snapshot, unthrottled: the textfile a collector
         # reads after the run must reflect the terminal state.
         write_textfile(
@@ -974,18 +1042,31 @@ def render_fleet_report_md(report: dict[str, Any]) -> str:
         f"respawns: {report['totals']['respawns']}, "
         f"resizes: {report['totals']['resizes']}, "
         f"suspensions: {report['totals']['suspensions']}",
+    ]
+    if "goodput_frac" in report["totals"]:
+        lines.append(
+            f"- fleet goodput: {report['totals']['goodput_frac']:.1%} of "
+            f"{report['totals']['goodput_wall_clock_sec']}s tenant "
+            "wall-clock (second-weighted)"
+        )
+    lines += [
         "",
         "| tenant | state | prio | devices | segs | evict | respawn | "
-        "resume_count | final_step | final_loss |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "resume_count | final_step | final_loss | goodput |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for name in sorted(report["tenants"]):
         v = report["tenants"][name]
+        ledger = v.get("goodput") or {}
+        goodput = (
+            f"{ledger['goodput_frac']:.1%}" if ledger else "n/a"
+        )
         lines.append(
             f"| {name} | {v['state']} | {v['priority']} | "
             f"[{v['min_devices']},{v['max_devices']}] | {v['segments']} | "
             f"{v['evictions']['total']} | {v['respawns']} | "
-            f"{v['resume_count']} | {v['final_step']} | {v['final_loss']} |"
+            f"{v['resume_count']} | {v['final_step']} | {v['final_loss']} | "
+            f"{goodput} |"
         )
     return "\n".join(lines) + "\n"
 

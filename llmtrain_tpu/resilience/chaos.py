@@ -365,6 +365,32 @@ def run_chaos(
             + "; ".join(mismatches)
         )
 
+    # ------------------------------------------------------------- goodput
+    # The wall-clock cost of all those kills, attributed post-hoc from the
+    # chaos run dir's durable artifacts (telemetry/goodput.py). Two gates:
+    # the ledger must BALANCE (categories sum to total wall-clock within
+    # 1% — an unbalanced ledger means segments went missing), and
+    # productive share must clear the configured floor.
+    from ..telemetry.goodput import compute_goodput
+
+    goodput = compute_goodput(chaos_dir)
+    if goodput is not None:
+        wall = goodput["wall_clock_sec"]
+        attributed = sum(goodput["categories"].values())
+        if wall > 0 and abs(attributed - wall) > 0.01 * wall + 0.05:
+            raise ChaosInvariantError(
+                f"goodput ledger does not balance: {attributed:.2f}s "
+                f"attributed vs {wall:.2f}s wall-clock — segment "
+                "artifacts are missing or mis-ordered"
+            )
+        floor = cfg.resilience.chaos.min_goodput_frac
+        if goodput["goodput_frac"] < floor:
+            raise ChaosInvariantError(
+                f"goodput_frac {goodput['goodput_frac']:.4f} below the "
+                f"configured floor resilience.chaos.min_goodput_frac="
+                f"{floor:.4f} (ledger: {goodput['categories']})"
+            )
+
     kill_cycles = [r for r in cycle_records if not r.get("completed")]
     return {
         "seed": seed,
@@ -381,6 +407,7 @@ def run_chaos(
         "final_loss": chaos_result.get("final_loss"),
         "reference_final_loss": ref_result.get("final_loss"),
         "bitwise_match": True,
+        "goodput": goodput,
         "work_dir": str(work),
         "wall_time_sec": round(time.perf_counter() - started, 2),
     }

@@ -221,6 +221,7 @@ class HangWatchdog:
         exit_code: int = EXIT_HANG_DETECTED,
         exit_fn: Callable[[int], Any] = os._exit,
         on_hang: Callable[[], Any] | None = None,
+        timeline: Any | None = None,
     ) -> None:
         if stall_timeout_sec <= 0:
             raise ValueError("stall_timeout_sec must be positive")
@@ -239,6 +240,12 @@ class HangWatchdog:
         self._exit_code = exit_code
         self._exit_fn = exit_fn
         self._on_hang = on_hang
+        # Optional EventTimeline flushed as the LAST act before exit_fn:
+        # on_hang already flushes it, but on_hang rides the bounded worker
+        # and can be abandoned wholesale when the drain wedges — this
+        # direct flush is what keeps the hang's badput attributable in
+        # telemetry/goodput.py even then (flush never raises by contract).
+        self._timeline = timeline
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.fired = False
@@ -353,6 +360,11 @@ class HangWatchdog:
                 )
                 sys.stderr.flush()
             except OSError:  # pragma: no cover - stderr gone
+                pass
+        if self._timeline is not None:
+            try:
+                self._timeline.flush()
+            except Exception:  # noqa: BLE001 — the exit-76 guarantee wins
                 pass
         self._exit_fn(self._exit_code)
 

@@ -110,6 +110,11 @@ class Telemetry:
         self._endpoint: PrometheusEndpoint | None = None
         self._started = time.perf_counter()
         self._finalized = False
+        # Last timeline-overflow total surfaced to the registry: flush()
+        # publishes deltas so telemetry/timeline_dropped renders as a
+        # Prometheus counter (llmtrain_telemetry_timeline_dropped_total)
+        # and the report can warn that the goodput ledger may be lossy.
+        self._dropped_reported = 0
 
     # -------------------------------------------------------------- lifecycle
 
@@ -194,6 +199,12 @@ class Telemetry:
         if not self._cfg.enabled:
             return
         self.timeline.flush()
+        dropped = self.timeline.dropped
+        if dropped > self._dropped_reported:
+            self.metrics.inc(
+                "telemetry/timeline_dropped", dropped - self._dropped_reported
+            )
+            self._dropped_reported = dropped
         if self._writes_files and self._cfg.prometheus_textfile:
             write_textfile(self._dir / "metrics.prom", self._render_prometheus())
 
@@ -220,6 +231,24 @@ class Telemetry:
         self._finalized = True
         wall = time.perf_counter() - self._started
         self.flush()
+        # Goodput ledger (telemetry/goodput.py): flush first so the JSONL
+        # carries every event, stamp the clean-exit footer, THEN compute
+        # post-hoc from the durable artifacts — the same numbers a
+        # post-mortem `llmtrain goodput --run-dir` reads with this process
+        # dead. Gauges publish before the final flush below so the
+        # llmtrain_goodput_* family lands in the textfile snapshot.
+        goodput = None
+        if self._writes_files and self._cfg.timeline:
+            self.timeline.end_segment()
+            try:
+                from .goodput import compute_goodput, goodput_gauges
+
+                goodput = compute_goodput(self._run_dir)
+                if goodput is not None:
+                    self.metrics.publish(goodput_gauges(goodput))
+                    self.flush()
+            except Exception as exc:  # noqa: BLE001 — reporting must not fail the run
+                logger.warning("goodput ledger computation failed: %s", exc)
         if self._writes_files and self._cfg.timeline:
             self.timeline.export_perfetto(self._dir / "trace.json")
         report = None
@@ -234,6 +263,7 @@ class Telemetry:
                 train_result=train_result,
                 perf_attribution=perf_attribution,
                 precision=precision,
+                goodput=goodput,
             )
             if self._writes_files:
                 write_reports(self._run_dir, report)

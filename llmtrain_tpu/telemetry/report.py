@@ -57,6 +57,7 @@ def build_report(
     serving: dict[str, Any] | None = None,
     perf_attribution: dict[str, Any] | None = None,
     precision: dict[str, Any] | None = None,
+    goodput: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Aggregate the telemetry state into the report dict."""
     latest = registry.latest()
@@ -174,6 +175,12 @@ def build_report(
         # and the capability-resolved matmul_precision — so a throughput
         # number in this report can never be quoted without its numerics.
         report["precision"] = precision
+    if goodput is not None:
+        # Cross-segment wall-clock attribution (telemetry/goodput.py):
+        # per-segment category table + run totals + goodput_frac, computed
+        # from the durable timeline/manifest artifacts — docs/
+        # observability.md "Goodput" documents the taxonomy.
+        report["goodput"] = goodput
     if train_result is not None:
         report["train_result"] = train_result
     return report
@@ -303,6 +310,19 @@ def render_markdown(report: dict[str, Any]) -> str:
             lines.append(f"- tracker errors (degraded to warnings): {events['tracker_errors']}")
         if events.get("timeline_events_dropped"):
             lines.append(f"- timeline events dropped (cap): {events['timeline_events_dropped']}")
+    goodput = report.get("goodput") or {}
+    if goodput:
+        from .goodput import render_goodput_md
+
+        lines += ["", "## Goodput", ""]
+        if events.get("timeline_events_dropped"):
+            lines.append(
+                "- **warning**: the timeline dropped "
+                f"{events['timeline_events_dropped']} event(s) (retention "
+                "cap) — attribution below may undercount span categories"
+            )
+            lines.append("")
+        lines.append(render_goodput_md(goodput).rstrip("\n"))
     serving = report.get("serving") or {}
     if serving:
         lines += ["", "## Serving", ""]

@@ -34,6 +34,7 @@ dict append, far below the numpy work inside any span).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from contextlib import contextmanager, nullcontext
@@ -104,12 +105,86 @@ class EventTimeline:
         # wall-clock anchor lets post-processing map them to real time.
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
+        # Segment identity (telemetry/goodput.py): the JSONL is opened in
+        # append mode, so successive resume segments of one run share ONE
+        # file — an EAGERLY written header line per process delimits them,
+        # and segment_id = number of headers already on disk gives the
+        # ledger a monotonic ordering with no reliance on file mtimes.
+        # Written at construction (not first flush) so even a segment
+        # SIGKILLed before its first flush leaves its start time behind.
+        self._segment_id = 0
+        self._segment_ended = False
+        if self._enabled and self._jsonl_path is not None:
+            self._segment_id = self._write_segment_header()
 
     # ------------------------------------------------------------- recording
 
     @property
     def origin_unix_time(self) -> float:
         return self._wall0
+
+    @property
+    def segment_id(self) -> int:
+        """This process's 0-based position in the run's segment sequence."""
+        return self._segment_id
+
+    def _write_segment_header(self) -> int:
+        """Append this process's segment-start record; returns its id.
+
+        Best-effort like every other persistence path: an unwritable disk
+        degrades to a memory-only segment (id from whatever was readable),
+        never an exception in the constructor."""
+        marker = '"name": "segment_start"'
+        segment_id = 0
+        try:
+            if self._jsonl_path.is_file():
+                segment_id = self._jsonl_path.read_text(
+                    encoding="utf-8"
+                ).count(marker)
+        except OSError:
+            pass
+        header = {
+            "name": "segment_start",
+            "ph": "seg",
+            "segment_id": segment_id,
+            "start_unix_time": self._wall0,
+            "process_index": self._process_index,
+            "pid": os.getpid(),
+        }
+        try:
+            self._jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+            with self._jsonl_path.open("a", encoding="utf-8") as fh:
+                fh.write(json.dumps(header, sort_keys=True) + "\n")
+        except OSError as exc:
+            logger.warning(
+                "timeline segment header to %s failed (%s); continuing",
+                self._jsonl_path,
+                exc,
+            )
+        return segment_id
+
+    def end_segment(self) -> None:
+        """Append the clean-exit footer (idempotent). Crashed segments
+        never reach this; the goodput ledger then infers the end from the
+        newest event timestamp and the heartbeat mtime instead."""
+        if not self._enabled or self._jsonl_path is None or self._segment_ended:
+            return
+        self._segment_ended = True
+        footer = {
+            "name": "segment_end",
+            "ph": "seg",
+            "segment_id": self._segment_id,
+            "end_unix_time": time.time(),
+        }
+        try:
+            with self._jsonl_path.open("a", encoding="utf-8") as fh:
+                fh.write(json.dumps(footer, sort_keys=True) + "\n")
+        except OSError as exc:
+            logger.warning(
+                "timeline segment footer to %s failed (%s); continuing",
+                self._jsonl_path,
+                exc,
+            )
 
     def _now_us(self) -> int:
         return int((time.perf_counter() - self._t0) * 1e6)

@@ -743,6 +743,11 @@ class Trainer:
                 # bounded wait — never block the watchdog behind a write
                 # wedged on the same dead storage that caused the hang.
                 on_hang=self._on_watchdog_hang,
+                # Direct last-ditch flush on the exit-76 path itself: the
+                # on_hang hook above can be abandoned with the bounded
+                # worker when the checkpoint drain wedges, and the goodput
+                # ledger needs the buffered events to attribute the hang.
+                timeline=self._telemetry.timeline,
             )
         self._straggler = (
             StragglerTracker(
@@ -1044,6 +1049,12 @@ class Trainer:
                             step=step,
                             checkpointed=self._ckpt_mgr is not None,
                         )
+                        # Flush NOW, not at the unwind: the pod's grace
+                        # period can expire (SIGKILL) anywhere between here
+                        # and the finally block, and the preemption instant
+                        # plus the interval's buffered step spans are what
+                        # the goodput ledger attributes the eviction from.
+                        tl.flush()
                         if self._ckpt_mgr is not None and self._is_main:
                             logger.warning(
                                 "SIGTERM received: preemption checkpoint "
@@ -1649,7 +1660,23 @@ class Trainer:
             "prefetch_depth": int(self._cfg.trainer.prefetch_depth),
             "prefetch_generation": int(self._rollback_count),
         }
-        return {"topology": self._current_topology(), "data": data}
+        # Segment provenance for the goodput ledger (telemetry/goodput.py):
+        # which process lifetime committed this step and when it started —
+        # mtime-free ordering for post-hoc recomputed-work derivation. The
+        # id comes from the timeline's durable header count, so manifests
+        # and timeline segments agree by construction.
+        resilience = {
+            "segment_id": int(self._telemetry.timeline.segment_id),
+            "process_start_unix_time": round(
+                self._telemetry.timeline.origin_unix_time, 3
+            ),
+            "saved_unix_time": round(time.time(), 3),
+        }
+        return {
+            "topology": self._current_topology(),
+            "data": data,
+            "resilience": resilience,
+        }
 
     def _save_checkpoint(self, step: int) -> None:
         """Host-gather on every process (collective for multi-host sharded

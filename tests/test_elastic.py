@@ -358,6 +358,11 @@ class TestElasticResume:
         manifest = read_manifest(r2 / "checkpoints" / "step_000006.ckpt")
         assert manifest["topology"]["data_parallel"] == 2
         assert manifest["topology"]["global_micro_batch"] == 4
+        # Goodput-ledger stamps (satellite of the goodput PR): segment
+        # identity + process/save wall-clock times ride every manifest.
+        resil = manifest["resilience"]
+        assert resil["segment_id"] == 0
+        assert 0 < resil["process_start_unix_time"] <= resil["saved_unix_time"]
 
         with _visible_devices(1):
             r1 = tmp_path / "ws1"

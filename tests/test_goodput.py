@@ -1,0 +1,607 @@
+"""Goodput-ledger tests (llmtrain_tpu/telemetry/goodput.py).
+
+Covers the ISSUE-12 contract:
+
+* Synthetic-timeline taxonomy tables — hand-written segment JSONL with
+  known second splits must attribute EXACTLY (compile, data_wait,
+  checkpoint, eval, productive vs recomputed via the last-execution
+  rule, restart_overhead from cross-segment gaps, suspension carving).
+* The ledger-balances invariant: categories sum to the wall clock —
+  through the synthetic tables, the real Telemetry facade end to end
+  (finalize -> report.json goodput block -> `llmtrain goodput` CLI
+  reproducing the same numbers), and a simulated crash (no footer).
+* Durability details: torn tail lines tolerated, legacy no-header
+  timelines return None (never a wrong ledger), heartbeat mtime extends
+  the final crashed segment, timeline_dropped surfaces as a counter.
+* @slow drills (`make verify-goodput`): a REAL mid-interval SIGKILL
+  leaving a torn timeline that still balances; the 3-cycle chaos drill
+  with recomputed_sec > 0 and post-mortem CLI reproducibility; the
+  3-tenant fleet storm with per-tenant ledgers, suspension attribution,
+  and the fleet-wide second-weighted goodput_frac.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from llmtrain_tpu.config.schemas import RunConfig
+from llmtrain_tpu.resilience.exit_codes import (
+    EXIT_CONFIG_ERROR,
+    EXIT_OK,
+    EXIT_TRAIN_FAILURE,
+)
+from llmtrain_tpu.telemetry.goodput import (
+    CATEGORIES,
+    _carve_suspensions,
+    compute_goodput,
+    final_committed_step,
+    goodput_gauges,
+    render_goodput_md,
+)
+
+_PRESETS = Path(__file__).resolve().parents[1] / "configs" / "presets"
+_CHAOS_PRESET = _PRESETS / "gpt_chaos_smoke.yaml"
+_FLEET_PRESET = _PRESETS / "gpt_fleet_smoke.yaml"
+
+# Balance tolerance for ledgers built from 3-decimal-rounded categories:
+# 9 categories x 0.0005 rounding error, plus a little slack.
+_EPS = 0.02
+
+
+# ------------------------------------------------------- synthetic timelines
+
+
+def _header(seg_id: int, start: float) -> dict:
+    return {
+        "name": "segment_start",
+        "ph": "seg",
+        "segment_id": seg_id,
+        "start_unix_time": start,
+        "process_index": 0,
+        "pid": 12345,
+    }
+
+
+def _footer(seg_id: int, end: float) -> dict:
+    return {
+        "name": "segment_end",
+        "ph": "seg",
+        "segment_id": seg_id,
+        "end_unix_time": end,
+    }
+
+
+def _span(name: str, ts: float, dur: float, step: int | None = None) -> dict:
+    event = {
+        "name": name,
+        "cat": "train",
+        "ph": "X",
+        "ts_us": int(ts * 1e6),
+        "dur_us": int(dur * 1e6),
+        "thread": "MainThread",
+    }
+    if step is not None:
+        event["step"] = step
+    return event
+
+
+def _write_timeline(run_dir: Path, events: list[dict], tail: str = "") -> Path:
+    path = run_dir / "telemetry" / "timeline.jsonl"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        "".join(json.dumps(e, sort_keys=True) + "\n" for e in events) + tail,
+        encoding="utf-8",
+    )
+    return path
+
+
+def _assert_balances(ledger: dict, tol: float = _EPS) -> None:
+    attributed = sum(ledger["categories"].values())
+    assert abs(attributed - ledger["wall_clock_sec"]) <= tol, ledger
+
+
+class TestTaxonomyTables:
+    def test_single_clean_segment_exact_split(self, tmp_path):
+        """One clean segment with hand-placed spans: every category lands
+        its exact seconds and the residual is unattributed."""
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("data_wait", 2.0, 0.5, step=1),
+                _span("host_dispatch", 2.5, 1.0, step=1),
+                _span("data_wait", 3.5, 0.25, step=2),
+                _span("host_dispatch", 3.75, 1.0, step=2),
+                _span("interval_sync", 4.75, 0.5),
+                _span("eval", 5.25, 0.75),
+                _span("checkpoint_save", 6.0, 0.5),
+                _span("checkpoint_wait", 6.5, 0.25),
+                _footer(0, 1010.0),
+            ],
+        )
+        ledger = compute_goodput(tmp_path)
+        assert ledger is not None
+        cats = ledger["categories"]
+        # Pre-step window ends at the FIRST data_wait/host_dispatch span —
+        # step 1's batch assembly must not be double-counted as compile.
+        assert cats["compile"] == pytest.approx(2.0, abs=1e-3)
+        assert cats["data_wait"] == pytest.approx(0.75, abs=1e-3)
+        assert cats["checkpoint"] == pytest.approx(0.75, abs=1e-3)
+        assert cats["eval"] == pytest.approx(0.75, abs=1e-3)
+        # All executions survive -> dispatch + full sync share productive.
+        assert cats["productive_train"] == pytest.approx(2.5, abs=1e-3)
+        assert cats["recomputed"] == 0.0
+        assert cats["restart_overhead"] == 0.0
+        assert cats["suspended"] == 0.0
+        assert cats["unattributed"] == pytest.approx(3.25, abs=1e-2)
+        assert ledger["wall_clock_sec"] == pytest.approx(10.0, abs=1e-3)
+        assert ledger["goodput_frac"] == pytest.approx(0.25, abs=1e-3)
+        assert ledger["num_segments"] == 1
+        assert ledger["segments"][0]["clean_end"] is True
+        _assert_balances(ledger)
+
+    def test_two_segments_recomputed_and_restart_overhead(self, tmp_path):
+        """Crash + resume-from-older-commit: the re-run step is recomputed
+        (last-execution rule), the death->first-dispatch window is
+        restart_overhead, and the run still sums to the wall clock."""
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("host_dispatch", 1.0, 1.0, step=1),
+                _span("host_dispatch", 2.0, 1.0, step=2),
+                _span("host_dispatch", 3.0, 1.0, step=3),
+                # no footer: SIGKILLed; inferred end = 1004.0
+                _header(1, 1010.0),
+                _span("host_dispatch", 2.0, 1.0, step=3),  # replay of step 3
+                _span("host_dispatch", 3.0, 1.0, step=4),
+                _footer(1, 1015.0),
+            ],
+        )
+        ledger = compute_goodput(tmp_path)
+        assert ledger is not None
+        cats = ledger["categories"]
+        assert cats["compile"] == pytest.approx(1.0, abs=1e-3)
+        # Step 3's segment-0 execution was superseded by segment 1's.
+        assert cats["recomputed"] == pytest.approx(1.0, abs=1e-3)
+        assert cats["productive_train"] == pytest.approx(4.0, abs=1e-3)
+        # Gap (1004 -> 1010) + segment 1's pre-dispatch warmup (2.0).
+        assert cats["restart_overhead"] == pytest.approx(8.0, abs=1e-3)
+        assert cats["suspended"] == 0.0
+        assert ledger["wall_clock_sec"] == pytest.approx(15.0, abs=1e-3)
+        assert ledger["num_segments"] == 2
+        seg0, seg1 = ledger["segments"]
+        assert seg0["clean_end"] is False and seg1["clean_end"] is True
+        assert seg0["last_step"] == 3 and seg1["first_step"] == 3
+        _assert_balances(ledger)
+
+    def test_suspension_windows_carve_restart_overhead(self, tmp_path):
+        """Fleet allocation-0 windows overlapping the cross-segment gap
+        move seconds from restart_overhead to suspended — and ONLY the
+        overlap with the gap counts."""
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("host_dispatch", 1.0, 1.0, step=1),
+                _header(1, 1010.0),  # gap: 1002 -> 1010
+                _span("host_dispatch", 2.0, 1.0, step=2),
+                _footer(1, 1014.0),
+            ],
+        )
+        # 3s inside the gap + 100s far outside it (must clamp to 0).
+        ledger = compute_goodput(
+            tmp_path, suspensions=[(1005.0, 1008.0), (1100.0, 1200.0)]
+        )
+        assert ledger is not None
+        cats = ledger["categories"]
+        assert cats["suspended"] == pytest.approx(3.0, abs=1e-3)
+        # gap 8.0 - suspended 3.0 + segment-1 pre-step 2.0
+        assert cats["restart_overhead"] == pytest.approx(7.0, abs=1e-3)
+        assert ledger["source"]["suspension_windows"] == 2
+        _assert_balances(ledger)
+
+    def test_heartbeat_mtime_extends_final_crashed_segment(self, tmp_path):
+        """The beacon often outlives the last flushed event on a SIGKILL:
+        that stranded wall-clock is real and must land in the ledger."""
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("host_dispatch", 1.0, 1.0, step=1),
+            ],
+        )
+        hb = tmp_path / "heartbeat"
+        hb.write_text("beacon", encoding="utf-8")
+        os.utime(hb, (1008.0, 1008.0))
+        ledger = compute_goodput(tmp_path)
+        assert ledger is not None
+        assert ledger["wall_clock_sec"] == pytest.approx(8.0, abs=1e-3)
+        assert ledger["source"]["heartbeat_used"] is True
+        _assert_balances(ledger)
+
+    def test_torn_tail_line_is_tolerated(self, tmp_path):
+        """A SIGKILL mid-write tears the last JSONL line; the ledger must
+        parse everything before it."""
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("host_dispatch", 1.0, 1.0, step=1),
+                _footer(0, 1003.0),
+            ],
+            tail='{"name": "host_disp',
+        )
+        ledger = compute_goodput(tmp_path)
+        assert ledger is not None
+        assert ledger["wall_clock_sec"] == pytest.approx(3.0, abs=1e-3)
+        _assert_balances(ledger)
+
+    def test_legacy_timeline_without_headers_returns_none(self, tmp_path):
+        """Pre-ledger runs: unavailable beats wrong."""
+        _write_timeline(tmp_path, [_span("host_dispatch", 1.0, 1.0, step=1)])
+        assert compute_goodput(tmp_path) is None
+
+    def test_missing_timeline_returns_none(self, tmp_path):
+        assert compute_goodput(tmp_path) is None
+
+    def test_carve_suspensions_clamps_to_gap(self):
+        assert _carve_suspensions(10.0, 20.0, [(12.0, 15.0)]) == 3.0
+        assert _carve_suspensions(10.0, 20.0, [(0.0, 100.0)]) == 10.0
+        assert _carve_suspensions(10.0, 20.0, [(30.0, 40.0)]) == 0.0
+        assert _carve_suspensions(10.0, 20.0, []) == 0.0
+
+    def test_final_committed_step_reads_manifests(self, tmp_path):
+        ckpt = tmp_path / "checkpoints"
+        ckpt.mkdir()
+        (ckpt / "step_000006.manifest.json").write_text("{}")
+        (ckpt / "step_000012.manifest.json").write_text("{}")
+        (ckpt / "step_000012.ckpt").write_text("")
+        assert final_committed_step(ckpt) == 12
+        assert final_committed_step(tmp_path / "nope") is None
+
+    def test_gauges_and_markdown_render(self, tmp_path):
+        _write_timeline(
+            tmp_path,
+            [
+                _header(0, 1000.0),
+                _span("host_dispatch", 1.0, 2.0, step=1),
+                _footer(0, 1004.0),
+            ],
+        )
+        ledger = compute_goodput(tmp_path)
+        gauges = goodput_gauges(ledger)
+        assert gauges["goodput/frac"] == ledger["goodput_frac"]
+        assert gauges["goodput/wall_clock_sec"] == pytest.approx(4.0, abs=1e-3)
+        for cat in CATEGORIES:
+            assert f"goodput/{cat}_sec" in gauges
+        md = render_goodput_md(ledger)
+        assert "| category | seconds | frac |" in md
+        for cat in CATEGORIES:
+            assert f"| {cat} |" in md
+        assert "| segment |" in md
+
+
+class TestChaosConfig:
+    def test_min_goodput_frac_validation(self):
+        from llmtrain_tpu.config.schemas import ChaosConfig
+
+        assert ChaosConfig().min_goodput_frac == 0.0
+        assert ChaosConfig(min_goodput_frac=0.5).min_goodput_frac == 0.5
+        with pytest.raises(Exception):
+            ChaosConfig(min_goodput_frac=1.5)
+        with pytest.raises(Exception):
+            ChaosConfig(unknown_knob=1)
+
+
+# ------------------------------------------------- facade + CLI (tier-1 e2e)
+
+
+def _facade_cfg(tmp_path) -> RunConfig:
+    return RunConfig.model_validate(
+        {
+            "run": {"name": "goodput-e2e"},
+            "model": {
+                "name": "dummy_gpt",
+                "block_size": 8,
+                "d_model": 16,
+                "n_layers": 1,
+                "n_heads": 2,
+                "d_ff": 32,
+                "dropout": 0.0,
+                "vocab_size": 32,
+            },
+            "data": {"name": "dummy_text"},
+            "trainer": {
+                "max_steps": 12,
+                "micro_batch_size": 2,
+                "grad_accum_steps": 1,
+                "log_every_steps": 5,
+                "eval_every_steps": 10,
+                "save_every_steps": 10,
+                "warmup_steps": 0,
+            },
+            "output": {"root_dir": str(tmp_path / "runs")},
+        }
+    )
+
+
+def _record_step_loop(telemetry) -> None:
+    """A tiny hand-driven 'fit': the same span vocabulary the trainer
+    records, with real (sleep-backed) durations."""
+    tl = telemetry.timeline
+    time.sleep(0.02)  # "compile"
+    for step in (1, 2, 3):
+        with tl.span("data_wait", cat="data", step=step):
+            time.sleep(0.005)
+        with tl.span("host_dispatch", step=step):
+            time.sleep(0.01)
+    with tl.span("interval_sync", step=3):
+        time.sleep(0.005)
+    with tl.span("eval", step=3):
+        time.sleep(0.005)
+    with tl.span("checkpoint_save", step=3):
+        time.sleep(0.005)
+    telemetry.flush(3)
+
+
+class TestLedgerBalancesInvariant:
+    """Tier-1 invariant: through the REAL facade (no Trainer fit — the
+    slow drills below cover that), the ledger balances and every exposure
+    surface carries the same numbers."""
+
+    def test_finalize_report_cli_agree_and_balance(self, tmp_path, capsys):
+        from llmtrain_tpu import cli
+        from llmtrain_tpu.telemetry import Telemetry
+        from llmtrain_tpu.tracking.base import NullTracker
+
+        cfg = _facade_cfg(tmp_path)
+        run_dir = tmp_path / "runs" / "goodput-e2e"
+        run_dir.mkdir(parents=True)
+        telemetry = Telemetry(cfg, run_dir, NullTracker())
+        _record_step_loop(telemetry)
+        report = telemetry.finalize(run_id="goodput-e2e")
+        telemetry.close()
+
+        ledger = report["goodput"]
+        assert ledger is not None
+        _assert_balances(ledger)
+        assert ledger["num_segments"] == 1
+        assert ledger["segments"][0]["clean_end"] is True
+        assert ledger["segments"][0]["steps_executed"] == 3
+        assert ledger["categories"]["productive_train"] > 0
+        assert ledger["categories"]["compile"] > 0
+
+        # Surface (a): the ledger persists verbatim in report.json/.md.
+        on_disk = json.loads((run_dir / "report.json").read_text())
+        assert on_disk["goodput"] == ledger
+        assert "## Goodput" in (run_dir / "report.md").read_text()
+
+        # Surface (c): llmtrain_goodput_* gauges in the textfile snapshot.
+        prom = (run_dir / "telemetry" / "metrics.prom").read_text()
+        assert "llmtrain_goodput_frac" in prom
+        assert "llmtrain_goodput_productive_train_sec" in prom
+
+        # Surface (b): the CLI reproduces the SAME numbers from artifacts
+        # alone (this is the post-mortem path — nothing in memory).
+        rc = cli.main(["goodput", "--run-dir", str(run_dir), "--json"])
+        assert rc == EXIT_OK
+        cli_ledger = json.loads(capsys.readouterr().out)
+        assert cli_ledger == ledger
+
+        rc = cli.main(["goodput", "--run-dir", str(run_dir)])
+        assert rc == EXIT_OK
+        assert "# Goodput" in capsys.readouterr().out
+
+    def test_simulated_crash_no_footer_still_balances(self, tmp_path):
+        """The SIGKILL shape without the process: record spans, flush,
+        abandon WITHOUT finalize (no footer) — the ledger must still
+        balance, with the segment marked unclean."""
+        from llmtrain_tpu.telemetry import Telemetry
+        from llmtrain_tpu.tracking.base import NullTracker
+
+        cfg = _facade_cfg(tmp_path)
+        run_dir = tmp_path / "runs" / "goodput-e2e"
+        run_dir.mkdir(parents=True)
+        telemetry = Telemetry(cfg, run_dir, NullTracker())
+        _record_step_loop(telemetry)
+        # no finalize(): the process "died" here
+        ledger = compute_goodput(run_dir)
+        assert ledger is not None
+        assert ledger["segments"][0]["clean_end"] is False
+        assert ledger["segments"][0]["steps_executed"] == 3
+        _assert_balances(ledger)
+
+    def test_dropped_events_surface_as_counter(self, tmp_path):
+        from llmtrain_tpu.telemetry import Telemetry
+        from llmtrain_tpu.tracking.base import NullTracker
+
+        cfg = RunConfig.model_validate(
+            {
+                **_facade_cfg(tmp_path).model_dump(),
+                "telemetry": {"max_events": 1000},
+            }
+        )
+        run_dir = tmp_path / "runs" / "goodput-e2e"
+        run_dir.mkdir(parents=True)
+        telemetry = Telemetry(cfg, run_dir, NullTracker())
+        for i in range(1200):
+            telemetry.timeline.instant("noise", step=i)
+        telemetry.flush(1)
+        for i in range(1200):
+            telemetry.timeline.instant("noise", step=i)
+        telemetry.flush(2)
+        assert telemetry.timeline.dropped > 0
+        assert (
+            telemetry.metrics.counters().get("telemetry/timeline_dropped", 0)
+            == telemetry.timeline.dropped
+        )
+        prom = (run_dir / "telemetry" / "metrics.prom").read_text()
+        assert "llmtrain_telemetry_timeline_dropped_total" in prom
+
+    def test_cli_error_paths(self, tmp_path):
+        from llmtrain_tpu import cli
+
+        rc = cli.main(["goodput", "--run-dir", str(tmp_path / "missing")])
+        assert rc == EXIT_CONFIG_ERROR
+        empty = tmp_path / "empty-run"
+        empty.mkdir()
+        rc = cli.main(["goodput", "--run-dir", str(empty)])
+        assert rc == EXIT_TRAIN_FAILURE
+
+
+# ------------------------------------------------------------- @slow drills
+
+
+@pytest.mark.slow
+class TestKillDurability:
+    def test_mid_interval_sigkill_timeline_still_balances(self, tmp_path):
+        """Regression (satellite 1): SIGKILL a REAL training process in
+        the middle of a log interval; the per-step flushes + eager header
+        must leave artifacts the ledger balances from."""
+        cfg = yaml.safe_load(_CHAOS_PRESET.read_text())
+        cfg["run"]["name"] = "gp-kill"
+        cfg["trainer"].update(
+            {
+                "max_steps": 5000,
+                "log_every_steps": 1,  # flush every step: maximal torn-tail odds
+                "save_every_steps": 50,
+                "eval_every_steps": 5000,
+            }
+        )
+        cfg["output"]["root_dir"] = str(tmp_path / "runs")
+        config_path = tmp_path / "kill.yaml"
+        config_path.write_text(yaml.safe_dump(cfg, sort_keys=False))
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "llmtrain_tpu", "train", "--config", str(config_path)],
+            env=env,
+            cwd=str(tmp_path),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.monotonic() + 180
+            timeline = None
+            while time.monotonic() < deadline:
+                hits = list((tmp_path / "runs").glob("**/telemetry/timeline.jsonl"))
+                if hits and '"host_dispatch"' in hits[0].read_text(errors="replace"):
+                    timeline = hits[0]
+                    break
+                if proc.poll() is not None:
+                    pytest.fail(f"train process exited early: rc={proc.returncode}")
+                time.sleep(0.25)
+            assert timeline is not None, "no dispatched step before the deadline"
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        run_dir = timeline.parent.parent
+        ledger = compute_goodput(run_dir)
+        assert ledger is not None
+        assert ledger["num_segments"] == 1
+        assert ledger["segments"][0]["clean_end"] is False
+        assert ledger["segments"][0]["steps_executed"] > 0
+        assert ledger["categories"]["productive_train"] > 0
+        _assert_balances(
+            ledger, tol=0.01 * ledger["wall_clock_sec"] + 0.05
+        )
+
+
+@pytest.mark.slow
+class TestChaosDrillGoodput:
+    def test_three_cycle_drill_ledger(self, tmp_path, capsys):
+        """ISSUE-12 acceptance: 3 chaos cycles produce a ledger balancing
+        within 1%, with recomputed_sec > 0 (the replay the kills cost),
+        and the CLI reproduces the SAME numbers from artifacts alone after
+        every process is dead."""
+        from llmtrain_tpu import cli
+        from llmtrain_tpu.resilience.chaos import run_chaos
+
+        result = run_chaos(
+            _CHAOS_PRESET,
+            cycles=3,
+            seed=1,
+            work_dir=tmp_path / "chaos",
+            timeout_sec=300.0,
+        )
+        ledger = result["goodput"]
+        assert ledger is not None
+        # 3 killed segments + the uninterrupted finishing segment.
+        assert ledger["num_segments"] >= 4
+        assert ledger["categories"]["recomputed"] > 0
+        assert ledger["categories"]["restart_overhead"] > 0
+        wall = ledger["wall_clock_sec"]
+        attributed = sum(ledger["categories"].values())
+        assert abs(attributed - wall) <= 0.01 * wall + 0.05
+
+        chaos_dir = Path(result["work_dir"]) / "runs" / "chaos"
+        rc = cli.main(["goodput", "--run-dir", str(chaos_dir), "--json"])
+        assert rc == EXIT_OK
+        cli_ledger = json.loads(capsys.readouterr().out)
+        assert cli_ledger == ledger
+
+
+@pytest.mark.slow
+class TestFleetStormGoodput:
+    def test_storm_per_tenant_ledgers_and_fleet_rollup(self, tmp_path):
+        """The storm's fleet report carries a balanced per-tenant ledger
+        (suspension windows attributed), the fleet-wide second-weighted
+        goodput_frac, and the llmtrain_fleet_goodput_* gauges — with the
+        configured min_goodput_frac floor enforced inside the storm."""
+        from llmtrain_tpu.fleet.chaos import run_fleet_storm
+
+        raw = yaml.safe_load(_FLEET_PRESET.read_text())
+        raw["resilience"] = {"chaos": {"min_goodput_frac": 0.0}}
+        raw["fleet"] = {
+            "pool_devices": 3,
+            "preempt_grace_sec": 20.0,
+            "tenants": [
+                {"name": "alpha", "priority": 2, "min_devices": 1, "max_devices": 1},
+                {"name": "bravo", "priority": 1, "min_devices": 1, "max_devices": 1},
+                {"name": "charlie", "priority": 0, "min_devices": 1, "max_devices": 1},
+            ],
+        }
+        config_path = tmp_path / "storm3.yaml"
+        config_path.write_text(yaml.safe_dump(raw, sort_keys=False))
+
+        result = run_fleet_storm(
+            config_path,
+            seed=1,
+            work_dir=tmp_path / "storm",
+            timeout_sec=600.0,
+        )
+        assert result["fleet_goodput_frac"] is not None
+        for name, r in result["tenants"].items():
+            ledger = r["goodput"]
+            assert ledger is not None, name
+            wall = ledger["wall_clock_sec"]
+            attributed = sum(ledger["categories"].values())
+            assert abs(attributed - wall) <= 0.01 * wall + 0.05, name
+            assert ledger["num_segments"] >= 2, name  # every tenant was evicted
+        if result["total_suspensions"] >= 1:
+            assert any(
+                r["goodput"]["categories"]["suspended"] > 0
+                for r in result["tenants"].values()
+            ), "suspension windows never attributed to any tenant ledger"
+
+        report = json.loads(Path(result["fleet_report_json"]).read_text())
+        assert report["totals"]["goodput_frac"] == result["fleet_goodput_frac"]
+        assert "goodput_sec" in report["totals"]
+        prom = (Path(result["work_dir"]) / "fleet_metrics.prom").read_text()
+        assert "llmtrain_fleet_goodput_frac" in prom
+        md = (Path(result["work_dir"]) / "fleet_report.md").read_text()
+        assert "fleet goodput" in md

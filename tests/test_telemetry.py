@@ -84,7 +84,13 @@ class TestEventTimeline:
         tl.flush()
         tl.flush()  # idempotent: nothing pending
         lines = path.read_text().strip().splitlines()
-        assert [json.loads(ln)["name"] for ln in lines] == ["a", "b"]
+        # The segment_start header (goodput ledger) is written eagerly at
+        # construction, before any flush; events append exactly once after.
+        assert [json.loads(ln)["name"] for ln in lines] == [
+            "segment_start",
+            "a",
+            "b",
+        ]
 
     def test_rollback_window_tagged_not_dropped(self, tmp_path):
         """Satellite contract: events of a rolled-back window stay in the

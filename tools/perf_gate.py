@@ -25,6 +25,12 @@ apples-vs-oranges gets deleted within a week):
   ``new.value < old.value * (1 - noise)`` (default noise 0.20: CPU
   fallback hosts are shared and wobble; TPU rounds can pass a tighter
   ``--noise``).
+* When both lines of a comparable pair embed a goodput ledger
+  (``detail.goodput.goodput_frac``, docs/observability.md "Goodput"),
+  the fraction gates under the same noise bound as its own compared
+  entry — throughput can hold steady while compile or data-wait creep
+  eats the wall clock, and this is the line that catches it.  A ledger
+  present on only one side is a ``[skip]`` note, never a gate.
 
 Matrix scenarios (the top-level ``matrix`` dict bench.py emits — one
 keyed line per dense/MoE/LoRA x context x loss_impl x matmul_precision
@@ -103,6 +109,16 @@ def _attribution_flops(result: dict[str, Any]) -> float | None:
     return None
 
 
+def _goodput_frac(result: dict[str, Any]) -> float | None:
+    ledger = (result.get("detail") or {}).get("goodput")
+    if isinstance(ledger, dict) and "goodput_frac" in ledger:
+        try:
+            return float(ledger["goodput_frac"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
 def compare(
     old: list[dict[str, Any]],
     new: list[dict[str, Any]],
@@ -145,6 +161,24 @@ def compare(
         compared.append(entry)
         if old_v > 0 and new_v < old_v * (1.0 - noise):
             regressions.append(entry)
+        g_old, g_new = _goodput_frac(prev), _goodput_frac(result)
+        if g_old is not None and g_new is not None:
+            g_entry = {
+                "scenario": key,
+                "metric": "goodput_frac",
+                "old": g_old,
+                "new": g_new,
+                "ratio": g_new / g_old if g_old else float("inf"),
+            }
+            compared.append(g_entry)
+            if g_old > 0 and g_new < g_old * (1.0 - noise):
+                regressions.append(g_entry)
+        elif (g_old is None) != (g_new is None):
+            side = "old" if g_old is None else "new"
+            skipped.append(
+                f"{key}: goodput ledger missing on the {side} side; "
+                "goodput_frac not compared"
+            )
     return {"compared": compared, "regressions": regressions, "skipped": skipped}
 
 
@@ -289,6 +323,37 @@ def _self_test() -> int:
     assert not verdict["regressions"] and verdict["skipped"], "degraded must skip"
     verdict = compare([base], [variant(value=500.0, flops=2.0e9)])
     assert not verdict["regressions"] and verdict["skipped"], "flops drift must skip"
+
+    # --- goodput gate -------------------------------------------------
+    def with_goodput(result: dict[str, Any], frac: float) -> dict[str, Any]:
+        out = json.loads(json.dumps(result))
+        out["detail"]["goodput"] = {"goodput_frac": frac}
+        return out
+
+    g_base = with_goodput(base, 0.90)
+    # Throughput flat but goodput collapsed (compile/data-wait creep) gates.
+    verdict = compare([g_base], [with_goodput(variant(value=1000.0), 0.40)])
+    assert any(
+        r["metric"] == "goodput_frac" for r in verdict["regressions"]
+    ), "goodput collapse must gate"
+    # A small goodput wobble under the noise bound passes.
+    verdict = compare([g_base], [with_goodput(variant(value=1000.0), 0.85)])
+    assert not verdict["regressions"], "goodput wobble must pass"
+    assert any(
+        c["metric"] == "goodput_frac" for c in verdict["compared"]
+    ), "goodput pair must be compared"
+    # A ledger on only one side skips, never gates.
+    verdict = compare([g_base], [variant(value=1000.0)])
+    assert not any(
+        r["metric"] == "goodput_frac" for r in verdict["regressions"]
+    ), "one-sided ledger must not gate"
+    assert any(
+        "goodput ledger missing" in s for s in verdict["skipped"]
+    ), "one-sided ledger must note a skip"
+    verdict = compare([base], [with_goodput(variant(value=1000.0), 0.95)])
+    assert any(
+        "goodput ledger missing" in s for s in verdict["skipped"]
+    ), "ledger new-side-only must note a skip"
 
     # --- matrix gate (compare_matrix) ---------------------------------
     def mline(tps: float, flops: float = 5.0e8, **kw: Any) -> dict[str, Any]:
