@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant verify-goodput train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -79,6 +79,15 @@ verify-telemetry:
 # (fit-path attribution, `llmtrain profile` CLI) ride `make test-all`.
 verify-profile:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py -q -m "not slow"
+	python tools/perf_gate.py --self-test
+
+# Mesh planner + auto-tuner suite (docs/perf.md "Mesh planning and
+# auto-tuning"): wildcard/divisibility plan resolution, capability rules,
+# dominated-candidate pruning with reasons, deterministic seeded candidate
+# order, and the `llmtrain plan` exit-code contract. The @pytest.mark.slow
+# probe-fit e2e and tune->train round-trip ride `make test-all`.
+verify-tune:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q -m "not slow"
 	python tools/perf_gate.py --self-test
 
 # Quantized-training suite (docs/perf.md "Quantized training"):

@@ -1105,6 +1105,51 @@ def _run(
     except Exception as exc:
         attribution = {"error": str(exc)}
 
+    # Analytic mesh-plan pick for this bench shape (autotune/search.py):
+    # the tuner's pruning pass alone — no probes — so BENCH rounds record
+    # which plan the planner WOULD choose and tools/perf_gate.py can flag
+    # (inform, never gate) when a re-tune flips the winner between rounds.
+    # Best-effort like attribution: never sinks the bench line.
+    tuned_plan = None
+    try:
+        from llmtrain_tpu.autotune.plan import caps_from_config
+        from llmtrain_tpu.autotune.search import (
+            enumerate_candidates,
+            prune_candidates,
+            resolve_hbm_limit,
+        )
+
+        bench_caps = caps_from_config(cfg, adapter=adapter)
+        bench_peaks = profiling.resolve_peaks()
+        bench_cands = enumerate_candidates(
+            cfg, jax.device_count(), seed=0, search_remat=False, search_zero=False
+        )
+        bench_pruning = prune_candidates(
+            bench_cands,
+            cfg,
+            device_count=jax.device_count(),
+            caps=bench_caps,
+            peaks=bench_peaks,
+            hbm_limit_bytes=resolve_hbm_limit(
+                str(bench_peaks.get("device_kind", "cpu"))
+            ),
+            max_probes=1,
+        )
+        best = bench_pruning["survivors"][0] if bench_pruning["survivors"] else None
+        tuned_plan = {
+            "winner": best.plan.key() if best is not None and best.plan else None,
+            "predicted_class": (
+                best.predicted["roofline"]["class"] if best is not None else None
+            ),
+            "predicted_us_per_token": (
+                best.predicted["predicted_us_per_token"] if best is not None else None
+            ),
+            "enumerated": bench_pruning["enumerated"],
+            "pruned": len(bench_pruning["pruned"]),
+        }
+    except Exception as exc:
+        tuned_plan = {"error": str(exc)}
+
     return {
         "metric": "tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -1140,6 +1185,9 @@ def _run(
                 "hbm_peak_bytes": peak_memory_bytes(),
                 "attribution": attribution,
             },
+            # The planner's analytic pick for this shape (see above):
+            # perf_gate compares `winner` between rounds as a note.
+            "tuned_plan": tuned_plan,
             # Measured mini-goodput over this scenario's OWN clocks (the
             # bench has no run dir, so no durable ledger): warmup —
             # dominated by XLA compile — is the overhead category, the

@@ -1,0 +1,48 @@
+"""Mesh planning and auto-tuning.
+
+``plan`` is pure (no jax) and re-exported eagerly; ``search`` and
+``tune`` pull heavier deps and are imported lazily via module
+``__getattr__`` so that ``from llmtrain_tpu.autotune import MeshPlan``
+stays cheap for the config/CLI validation paths.
+"""
+
+from __future__ import annotations
+
+from .plan import (
+    MESH_AXES,
+    MeshPlan,
+    MeshPlanError,
+    ModelCaps,
+    caps_from_config,
+    estimate_param_count,
+    plan_from_config,
+    predict_hbm_bytes,
+    resolve_axis_sizes,
+    resolve_plan,
+)
+
+_LAZY = {"search", "tune"}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "MESH_AXES",
+    "MeshPlan",
+    "MeshPlanError",
+    "ModelCaps",
+    "caps_from_config",
+    "estimate_param_count",
+    "plan_from_config",
+    "predict_hbm_bytes",
+    "resolve_axis_sizes",
+    "resolve_plan",
+    "search",
+    "tune",
+]

@@ -606,6 +606,45 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="emit the ledger as JSON"
     )
 
+    plan = sub.add_parser(
+        "plan",
+        help="dry-run the mesh planner: resolve the config's MeshPlan, "
+        "predict its roofline class and per-device HBM, run nothing "
+        "(autotune/plan.py; exit 2 on an infeasible plan)",
+    )
+    plan.add_argument("--config", required=True, help="path to the YAML run config")
+    plan.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="plan against this many devices instead of the locally "
+        "visible count (lets you vet a pod-slice plan from a laptop)",
+    )
+    plan.add_argument("--json", action="store_true", help="emit the plan as JSON")
+
+    tune = sub.add_parser(
+        "tune",
+        help="auto-tune mesh shape x microbatch x remat x zero stage: "
+        "analytic roofline/HBM pruning, then short probe fits scored by "
+        "measured perf_attribution MFU; emits the winner as a loadable "
+        "config (autotune/, docs/perf.md 'Mesh planning and auto-tuning')",
+    )
+    tune.add_argument("--config", required=True, help="path to the YAML run config")
+    tune.add_argument(
+        "--output",
+        default=None,
+        help="emitted config path (default {output.root_dir}/"
+        "tune_{run.name}/tuned.yaml)",
+    )
+    tune.add_argument(
+        "--workdir",
+        default=None,
+        help="probe-run scratch dir (default {output.root_dir}/tune_{run.name})",
+    )
+    tune.add_argument(
+        "--json", action="store_true", help="print the full tune report JSON"
+    )
+
     validate = sub.add_parser("validate", help="validate a config file")
     validate.add_argument("--config", required=True)
     validate.add_argument("--json", action="store_true")
@@ -686,6 +725,157 @@ def _handle_print_config(args: argparse.Namespace) -> int:
         import yaml
 
         print(yaml.safe_dump(resolved, sort_keys=False), end="")
+    return EXIT_OK
+
+
+def _handle_plan(args: argparse.Namespace) -> int:
+    """The analytical half of the tuner as a standalone debugging surface:
+    resolve, predict, print — nothing runs, no params materialize."""
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    from .autotune.plan import MeshPlanError, plan_from_config
+    from .autotune.search import analytic_candidate_cost, resolve_hbm_limit
+    from .telemetry.profiling import classify_roofline, resolve_peaks
+
+    initialize_registries()
+    try:
+        adapter = get_model_adapter(cfg.model.name)
+    except RegistryError as exc:
+        _emit_error(str(exc))
+        return EXIT_CONFIG_ERROR
+    if args.devices is not None:
+        device_count = args.devices
+    else:
+        import jax
+
+        device_count = jax.device_count()
+
+    try:
+        mesh_plan = plan_from_config(cfg, device_count, adapter=adapter)
+    except MeshPlanError as exc:
+        _emit_error(f"infeasible plan: {exc}")
+        return EXIT_CONFIG_ERROR
+
+    peaks = resolve_peaks(None, cfg.telemetry.device_peaks)
+    cost = analytic_candidate_cost(mesh_plan, cfg)
+    roofline = classify_roofline(
+        flops=cost["flops"],
+        bytes_accessed=cost["bytes_accessed"],
+        collective_bytes=cost["collective_bytes"],
+        peaks=peaks,
+    )
+    from .autotune.plan import predict_hbm_bytes
+
+    hbm = predict_hbm_bytes(
+        mesh_plan,
+        n_params=int(cost["n_params"]),
+        d_model=cfg.model.d_model,
+        n_layers=cfg.model.n_layers,
+        vocab_size=int(cfg.model.vocab_size or 50257),
+        block_size=cfg.model.block_size,
+        dtype_bytes=2 if cfg.model.dtype == "bfloat16" else 4,
+        param_dtype_bytes=2 if cfg.model.param_dtype == "bfloat16" else 4,
+    )
+    hbm_limit = resolve_hbm_limit(
+        str(peaks.get("device_kind", "cpu")), cfg.tune.hbm_limit_bytes
+    )
+    feasible = hbm["total_bytes"] <= hbm_limit
+    payload = {
+        "plan": {
+            "key": mesh_plan.key(),
+            "mesh": mesh_plan.axes,
+            "device_count": device_count,
+            "data_parallel": mesh_plan.data_parallel,
+            "global_micro_batch": mesh_plan.global_micro_batch,
+            "micro_batch_size": mesh_plan.micro_batch_size,
+            "grad_accum_steps": mesh_plan.grad_accum_steps,
+            "remat": mesh_plan.remat,
+            "zero_stage": mesh_plan.zero_stage,
+            "topology": mesh_plan.describe_topology(),
+        },
+        "roofline": roofline,
+        "predicted_hbm": hbm,
+        "hbm_limit_bytes": hbm_limit,
+        "device_kind": peaks.get("device_kind", "unknown"),
+        "feasible": feasible,
+    }
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(f"plan      {mesh_plan.key()}")
+        print(f"mesh      {mesh_plan.axes}")
+        print(
+            f"batch     micro={mesh_plan.micro_batch_size} "
+            f"global_micro={mesh_plan.global_micro_batch} "
+            f"accum={mesh_plan.grad_accum_steps}"
+        )
+        print(
+            f"roofline  {roofline['class']} "
+            f"(analytical ms: {roofline['analytical_ms']})"
+        )
+        print(
+            f"hbm       {hbm['total_bytes'] / 2**30:.3f} GiB predicted vs "
+            f"{hbm_limit / 2**30:.1f} GiB limit "
+            f"[{payload['device_kind']}]"
+        )
+    if not feasible:
+        _emit_error(
+            "infeasible plan: predicted per-device HBM "
+            f"{hbm['total_bytes'] / 2**30:.3f} GiB exceeds the "
+            f"{hbm_limit / 2**30:.1f} GiB limit for "
+            f"{payload['device_kind']} (override with tune.hbm_limit_bytes)"
+        )
+        return EXIT_CONFIG_ERROR
+    return EXIT_OK
+
+
+def _handle_tune(args: argparse.Namespace) -> int:
+    try:
+        cfg, _, resolved = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+
+    from .autotune.plan import MeshPlanError
+    from .autotune.tune import run_tune
+
+    workdir = Path(args.workdir or Path(cfg.output.root_dir) / f"tune_{cfg.run.name}")
+    output_path = Path(args.output or workdir / "tuned.yaml")
+    try:
+        report = run_tune(
+            cfg, resolved, workdir=workdir, output_path=output_path
+        )
+    except MeshPlanError as exc:
+        _emit_error(f"infeasible plan: {exc}")
+        return EXIT_CONFIG_ERROR
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        pruned = report["pruned"]
+        print(
+            f"tune      {report['enumerated']} candidates enumerated, "
+            f"{len(pruned)} pruned analytically, "
+            f"{len(report['measured'])} probed "
+            f"({report['elapsed_sec']:.1f}s of {report['budget_sec']:.0f}s budget)"
+        )
+        for record in report["measured"]:
+            status = record.get("status")
+            if status == "ok":
+                marker = "*" if record["key"] == report["winner"]["key"] else " "
+                print(
+                    f"  {marker} {record['key']}: mfu={record['mfu']:.4f} "
+                    f"step={record.get('step_time_sec') or 0:.4f}s"
+                    + (" (baseline)" if record.get("baseline") else "")
+                )
+            else:
+                print(f"    {record['key']}: {status} ({record.get('reason', '')})")
+        print(f"winner    {report['winner']['key']}")
+        print(f"emitted   {report['output_config']}")
+        print(f"report    {workdir / 'tune_report.json'}")
     return EXIT_OK
 
 
@@ -2768,6 +2958,10 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_average_checkpoints(args)
     if args.command == "profile":
         return _handle_profile(args)
+    if args.command == "plan":
+        return _handle_plan(args)
+    if args.command == "tune":
+        return _handle_tune(args)
     if args.command == "goodput":
         return _handle_goodput(args)
     if args.command == "validate":

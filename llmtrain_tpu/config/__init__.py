@@ -21,6 +21,7 @@ from .schemas import (
     RunSectionConfig,
     ServingConfig,
     TrainerConfig,
+    TuneConfig,
     WatchdogConfig,
 )
 
@@ -40,6 +41,7 @@ __all__ = [
     "RunSectionConfig",
     "ServingConfig",
     "TrainerConfig",
+    "TuneConfig",
     "WatchdogConfig",
     "load_and_validate_config",
     "load_yaml_config",

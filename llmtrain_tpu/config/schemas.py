@@ -657,6 +657,55 @@ class OutputConfig(BaseModel):
     model_config = _STRICT
 
 
+class TuneConfig(BaseModel):
+    """Mesh-plan auto-tuner knobs (llmtrain_tpu/autotune/, ``llmtrain tune``,
+    docs/perf.md "Mesh planning and auto-tuning").
+
+    The tuner enumerates mesh shape × microbatch × remat × zero stage,
+    prunes analytically (roofline + predicted HBM, autotune/search.py),
+    then probe-fits the survivors as short subprocess runs scored by the
+    measured ``perf_attribution`` MFU. Every knob here bounds device
+    time, not correctness — the emitted config re-validates through this
+    very schema before it is written.
+    """
+
+    # Optimizer steps per probe fit (enough for compile + a few measured
+    # steps; the first step's compile time is excluded by the metrics).
+    probe_steps: int = Field(4, ge=1)
+    # Wall-clock cap per probe subprocess; timeouts score as failures.
+    probe_timeout_sec: float = Field(120.0, gt=0.0)
+    # Total measuring budget: once spent, remaining survivors are skipped
+    # (recorded in the tune report, never silently).
+    budget_sec: float = Field(600.0, gt=0.0)
+    # Survivor cap after analytic pruning; the baseline probe is exempt.
+    max_probes: int = Field(4, ge=1)
+    # Explicit microbatch grid; empty = {mb/2, mb, 2·mb} around the
+    # config's trainer.micro_batch_size.
+    microbatch_candidates: list[int] = Field(default_factory=list)
+    # Which dimensions to search; a disabled dimension stays pinned at
+    # the config's value.
+    search_mesh: bool = True
+    search_remat: bool = True
+    search_zero: bool = True
+    # Only propose plans the elastic-resume topology matrix would accept
+    # from the current config's topology (resilience/elastic.py) — for
+    # re-tuning a run that must resume from its existing checkpoints.
+    preserve_topology: bool = False
+    # Per-device HBM feasibility limit override (bytes). None = the
+    # DEVICE_HBM_BYTES row for the detected device kind.
+    hbm_limit_bytes: float | None = Field(None, gt=0.0)
+    # Candidate-order shuffle seed; None = run.seed.
+    seed: int | None = None
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_candidates(self) -> Self:
+        if any(m < 1 for m in self.microbatch_candidates):
+            raise ValueError("tune.microbatch_candidates entries must be >= 1")
+        return self
+
+
 class RunConfig(BaseModel):
     """Top-level schema tying every section into one executable run.
 
@@ -676,5 +725,6 @@ class RunConfig(BaseModel):
     mlflow: MLflowConfig = Field(default_factory=MLflowConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)
+    tune: TuneConfig = Field(default_factory=TuneConfig)
 
     model_config = _STRICT

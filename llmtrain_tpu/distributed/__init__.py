@@ -363,27 +363,16 @@ MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipeline", "expert")
 
 
 def resolve_mesh_axes(mesh_cfg: MeshConfig, device_count: int) -> dict[str, int]:
-    """Materialize axis sizes, expanding a single ``-1`` wildcard."""
-    sizes = mesh_cfg.axis_sizes()
-    fixed = 1
-    wildcard_axis: str | None = None
-    for axis, v in sizes.items():
-        if v == -1:
-            wildcard_axis = axis
-        else:
-            fixed *= v
-    if wildcard_axis is not None:
-        if device_count % fixed != 0:
-            raise ValueError(
-                f"device count {device_count} not divisible by fixed mesh axes product {fixed}"
-            )
-        sizes[wildcard_axis] = device_count // fixed
-        fixed *= sizes[wildcard_axis]
-    if fixed != device_count:
-        raise ValueError(
-            f"mesh axes {sizes} multiply to {fixed} but {device_count} devices are available"
-        )
-    return sizes
+    """Materialize axis sizes, expanding a single ``-1`` wildcard.
+
+    The math lives in the mesh planner (autotune/plan.py) — one owner for
+    wildcard/divisibility resolution across trainer, fleet, and tuner.
+    Failures raise ``MeshPlanError`` (a ValueError subclass mapped to the
+    config exit code 2) instead of surfacing as an opaque pjit error.
+    """
+    from ..autotune.plan import resolve_axis_sizes
+
+    return resolve_axis_sizes(mesh_cfg.axis_sizes(), device_count)
 
 
 def build_mesh(mesh_cfg: MeshConfig | None = None, devices=None) -> jax.sharding.Mesh:

@@ -96,6 +96,7 @@ def exit_code_for_exception(exc: BaseException) -> int:
     for a genuine bug would loop the orchestrator forever.
     """
     # Local imports: keep this module importable without jax/pydantic.
+    from ..autotune.plan import MeshPlanError
     from .elastic import TopologyMismatchError
     from .faults import InjectedFault
     from .guard import NonFiniteLossError
@@ -104,8 +105,10 @@ def exit_code_for_exception(exc: BaseException) -> int:
     for node in _exception_chain(exc):
         # An incompatible topology change is a CONFIG problem: the same
         # config replays the same mismatch, so the orchestrator must not
-        # burn restarts on it.
-        if isinstance(node, TopologyMismatchError):
+        # burn restarts on it. An infeasible mesh plan (axis sizes vs
+        # device count / capability rules, autotune/plan.py) is the same
+        # class: deterministic from config, restarting cannot help.
+        if isinstance(node, (TopologyMismatchError, MeshPlanError)):
             return EXIT_CONFIG_ERROR
     for node in _exception_chain(exc):
         # Deterministic divergence beats any wrapped transient error.

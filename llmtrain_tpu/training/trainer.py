@@ -187,6 +187,13 @@ class Trainer:
         self._model = self._adapter.build_model(cfg)
 
         devices = jax.devices() if cfg.run.device == "tpu" else jax.devices("cpu")
+        # Fail-fast plan validation (autotune/plan.py): axis tiling,
+        # capability flags and divisibility rules all raise a named
+        # MeshPlanError (config exit code 2) here, BEFORE any mesh or
+        # params materialize — not as an opaque pjit/XLA error mid-setup.
+        from ..autotune.plan import plan_from_config
+
+        plan_from_config(cfg, len(devices), adapter=self._adapter)
         self._mesh = build_mesh(cfg.distributed.mesh, devices)
         from ..parallel.pipeline import pipeline_degree
 

@@ -119,17 +119,31 @@ def _goodput_frac(result: dict[str, Any]) -> float | None:
     return None
 
 
+def _tuned_plan_winner(result: dict[str, Any]) -> str | None:
+    block = (result.get("detail") or {}).get("tuned_plan")
+    if isinstance(block, dict) and block.get("winner"):
+        return str(block["winner"])
+    return None
+
+
 def compare(
     old: list[dict[str, Any]],
     new: list[dict[str, Any]],
     *,
     noise: float = DEFAULT_NOISE,
 ) -> dict[str, Any]:
-    """Pure comparison core (unit-tested; the CLI is a thin shell)."""
+    """Pure comparison core (unit-tested; the CLI is a thin shell).
+
+    Returns {"compared", "regressions", "skipped", "notes"} — ``notes``
+    carries informational observations that must NEVER gate, like the
+    analytic mesh planner's winning plan (``detail.tuned_plan``,
+    autotune/search.py) flipping between rounds: a plan change explains a
+    throughput shift, it is not itself a regression."""
     old_by_key = {scenario_key(r): r for r in old if not is_degraded(r)}
     regressions: list[dict[str, Any]] = []
     compared: list[dict[str, Any]] = []
     skipped: list[str] = []
+    notes: list[str] = []
     for result in new:
         key = scenario_key(result)
         if is_degraded(result):
@@ -179,7 +193,21 @@ def compare(
                 f"{key}: goodput ledger missing on the {side} side; "
                 "goodput_frac not compared"
             )
-    return {"compared": compared, "regressions": regressions, "skipped": skipped}
+        # Tuned-plan drift: INFORM, never gate — a re-tune picking a
+        # different winning plan between rounds is context for any
+        # throughput movement above, not a failure of its own.
+        p_old, p_new = _tuned_plan_winner(prev), _tuned_plan_winner(result)
+        if p_old and p_new and p_old != p_new:
+            notes.append(
+                f"{key}: tuned plan changed between rounds: "
+                f"{p_old} -> {p_new} (informational, never gates)"
+            )
+    return {
+        "compared": compared,
+        "regressions": regressions,
+        "skipped": skipped,
+        "notes": notes,
+    }
 
 
 def matrix_lines(results: list[dict[str, Any]]) -> dict[str, dict[str, Any]]:
@@ -355,6 +383,34 @@ def _self_test() -> int:
         "goodput ledger missing" in s for s in verdict["skipped"]
     ), "ledger new-side-only must note a skip"
 
+    # --- tuned-plan drift notes ---------------------------------------
+    def with_plan(result: dict[str, Any], winner: str) -> dict[str, Any]:
+        out = json.loads(json.dumps(result))
+        out["detail"]["tuned_plan"] = {"winner": winner, "enumerated": 10, "pruned": 8}
+        return out
+
+    p_base = with_plan(base, "d8.f1.t1.s1.p1.e1|mb4|remat0|zero0")
+    # A plan flip with steady throughput notes, never gates.
+    verdict = compare(
+        [p_base], [with_plan(variant(value=1000.0), "d1.f8.t1.s1.p1.e1|mb8|remat0|zero0")]
+    )
+    assert not verdict["regressions"], "plan flip alone must not gate"
+    assert any("tuned plan changed" in n for n in verdict["notes"]), "plan flip must note"
+    # Same plan both rounds: silent.
+    verdict = compare([p_base], [with_plan(variant(value=1000.0), "d8.f1.t1.s1.p1.e1|mb4|remat0|zero0")])
+    assert not verdict["notes"], "unchanged plan must not note"
+    # A one-sided tuned_plan block (older rounds predate it): silent.
+    verdict = compare([base], [with_plan(variant(value=1000.0), "d8.f1.t1.s1.p1.e1|mb4|remat0|zero0")])
+    assert not verdict["notes"], "one-sided tuned_plan must not note"
+    # A plan flip NEXT TO a genuine regression: both surface, only the
+    # regression gates.
+    verdict = compare(
+        [p_base], [with_plan(variant(value=400.0), "d1.f8.t1.s1.p1.e1|mb8|remat0|zero0")]
+    )
+    assert verdict["regressions"] and any(
+        "tuned plan changed" in n for n in verdict["notes"]
+    ), "regression + plan flip must both surface"
+
     # --- matrix gate (compare_matrix) ---------------------------------
     def mline(tps: float, flops: float = 5.0e8, **kw: Any) -> dict[str, Any]:
         out = {"tokens_per_sec": tps, "attribution": {"flops": flops}}
@@ -450,11 +506,12 @@ def main(argv: list[str] | None = None) -> int:
         )
     for note in verdict["skipped"] + matrix_verdict["skipped"]:
         print(f"  [skip] {note}")
-    for note in matrix_verdict["notes"]:
+    for note in verdict["notes"] + matrix_verdict["notes"]:
         print(f"  [note] {note}")
     if not any(
-        (verdict["compared"], verdict["skipped"], matrix_verdict["compared"],
-         matrix_verdict["skipped"], matrix_verdict["notes"])
+        (verdict["compared"], verdict["skipped"], verdict["notes"],
+         matrix_verdict["compared"], matrix_verdict["skipped"],
+         matrix_verdict["notes"])
     ):
         print("  no bench lines found")
     if regressions:
