@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -123,6 +123,16 @@ verify-goodput:
 verify-serving:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving_engine.py \
 		tests/test_serving.py -q
+
+# Fleet serving tier (docs/serving.md "Fleet tier"): prefix-cache
+# content addressing + refcount/COW/eviction invariants, router
+# placement/affinity/eviction/failover, chunked prefill, checkpoint
+# hot-swap epoch pinning, batched speculative parity — plus the
+# @pytest.mark.slow 2-replica drill (mid-drill rolling hot swap, zero
+# failed requests, bitwise parity on the params each request was
+# admitted under) that plain `make test` skips.
+verify-router:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

@@ -256,6 +256,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="default stop token (requests may override; default: the "
         "tokenizer's EOS, if any)",
     )
+    serve.add_argument(
+        "--router",
+        action="store_true",
+        help="run the fleet tier: a replica router placing each request "
+        "by prefix-cache affinity and load, with rolling zero-downtime "
+        "POST /reload (needs the continuous backend)",
+    )
+    serve.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="in-process replica count for --router "
+        "(default: serving.router.replicas)",
+    )
+    serve.add_argument(
+        "--backends",
+        default=None,
+        help="comma-separated replica base URLs (http://host:port) — "
+        "route across separate serve processes instead of in-process "
+        "replicas (implies --router)",
+    )
+    serve.add_argument(
+        "--discover",
+        default=None,
+        help="host[:port] DNS-resolved into one HTTP backend per A "
+        "record (k8s headless Service discovery; implies --router)",
+    )
 
     bench = sub.add_parser(
         "serve-bench",
@@ -340,6 +367,50 @@ def build_parser() -> argparse.ArgumentParser:
     bench.add_argument(
         "--gamma", type=int, default=None,
         help="speculative lookahead (default: serving.speculative_gamma)",
+    )
+    bench.add_argument(
+        "--router",
+        action="store_true",
+        help="drive the replica-router tier instead of one scheduler "
+        "(in-process replicas; the report gains fleet prefix hit rate "
+        "and per-replica occupancy)",
+    )
+    bench.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="in-process replica count for --router "
+        "(default: serving.router.replicas)",
+    )
+    bench.add_argument(
+        "--shared-prefix-tokens",
+        type=int,
+        default=0,
+        help="prepend one of --shared-prefix-count fixed 'system "
+        "prompts' of this many tokens to every request — the workload "
+        "shared-prefix KV reuse and router affinity pay off on",
+    )
+    bench.add_argument(
+        "--shared-prefix-count", type=int, default=1,
+        help="distinct shared prefixes to draw from",
+    )
+    bench.add_argument(
+        "--long-fraction",
+        type=float,
+        default=0.0,
+        help="fraction of requests using --long-prompt-tokens prompts "
+        "(the bimodal long/short mix chunked prefill exists for)",
+    )
+    bench.add_argument(
+        "--long-prompt-tokens", type=int, default=0,
+        help="prompt length of the long cohort",
+    )
+    bench.add_argument(
+        "--max-per-token-p99-ms",
+        type=float,
+        default=None,
+        help="fail the run if per-token p99 latency exceeds this bound "
+        "(the head-of-line-blocking SLO chunked prefill protects)",
     )
 
     evalp = sub.add_parser(
@@ -1547,14 +1618,40 @@ def _build_serving_backend(
                 f"vocab_size ({model.vocab_size}) — speculative decoding "
                 "needs a shared vocabulary"
             )
+        # Batched speculative: when both models support paged decoding,
+        # attach target + draft engines so greedy requests draft in
+        # batch and the target scores every row's slab in ONE bucketed
+        # verify call. Otherwise the scheduler falls back to the batch-1
+        # speculative_generate path.
+        engine = draft_engine = None
+        if hasattr(model, "for_paged_decoding") and hasattr(
+            draft_model, "for_paged_decoding"
+        ):
+            engine_kwargs = dict(
+                block_tokens=scfg.block_tokens,
+                num_blocks=scfg.num_blocks or None,
+                max_batch_slots=scfg.max_batch_slots,
+                prompt_buckets=scfg.prompt_buckets or None,
+                batch_buckets=scfg.batch_buckets or None,
+            )
+            engine = PagedDecodeEngine(model, params, **engine_kwargs)
+            draft_engine = PagedDecodeEngine(
+                draft_model, draft_params, **engine_kwargs
+            )
+            logger.info(
+                "batched speculative serving: %d slots, gamma from %s",
+                engine.max_batch_slots,
+                "--gamma" if args.gamma is not None else "config",
+            )
         scheduler = ContinuousBatchingScheduler(
-            None,
+            engine,
             policy="speculative",
             registry=registry,
             model=model,
             params=params,
             draft_model=draft_model,
             draft_params=draft_params,
+            draft_engine=draft_engine,
             gamma=args.gamma if args.gamma is not None else scfg.speculative_gamma,
             timeline=timeline,
         )
@@ -1567,6 +1664,8 @@ def _build_serving_backend(
             max_batch_slots=scfg.max_batch_slots,
             prompt_buckets=scfg.prompt_buckets or None,
             batch_buckets=scfg.batch_buckets or None,
+            prefix_cache=scfg.prefix_cache,
+            prefill_chunk=scfg.prefill_chunk,
         )
         logger.info(
             "continuous batching: %d slots, %d-token blocks x %d pool blocks, "
@@ -1581,6 +1680,71 @@ def _build_serving_backend(
             engine, registry=registry, timeline=timeline
         )
     return scheduler, registry
+
+
+def _build_router_backend(
+    cfg,
+    args: argparse.Namespace,
+    model,
+    params,
+    logger,
+):
+    """Replica-router tier for ``serve --router`` / ``serve-bench --router``.
+
+    Default: ``serving.router.replicas`` (or ``--replicas``) in-process
+    replicas, each a full scheduler+engine stack behind one router.
+    ``--backends``/``--discover`` route across separate serve processes
+    over HTTP instead — the k8s shape, where each replica is its own pod
+    behind a headless Service (k8s/router.yaml).
+    """
+    from .serving import (
+        HTTPReplica,
+        InProcessReplica,
+        ReplicaRouter,
+        resolve_backends,
+    )
+    from .telemetry.registry import MetricsRegistry
+
+    rcfg = cfg.serving.router
+    registry = MetricsRegistry(None)
+    replicas: list[Any] = []
+    if getattr(args, "backends", None):
+        urls = [u.strip() for u in args.backends.split(",") if u.strip()]
+        if not urls:
+            raise ValueError("--backends must list at least one base URL")
+        replicas = [
+            HTTPReplica(u, timeout_sec=cfg.serving.request_timeout_sec)
+            for u in urls
+        ]
+    elif getattr(args, "discover", None):
+        replicas = [
+            HTTPReplica(u, timeout_sec=cfg.serving.request_timeout_sec)
+            for u in resolve_backends(args.discover)
+        ]
+    else:
+        n = getattr(args, "replicas", None) or rcfg.replicas
+        for i in range(n):
+            sched, _ = _build_serving_backend(cfg, args, model, params, logger)
+            sched.start()
+            replicas.append(InProcessReplica(sched, f"replica{i}"))
+    router = ReplicaRouter(
+        replicas,
+        registry=registry,
+        affinity_weight=rcfg.affinity_weight,
+        max_affinity_entries=rcfg.max_affinity_entries,
+        fail_threshold=rcfg.fail_threshold,
+        revive_sec=rcfg.revive_sec,
+        block_tokens=cfg.serving.block_tokens,
+    )
+    logger.info(
+        "replica router: %d %s replicas, affinity_weight %.1f, "
+        "fail_threshold %d",
+        len(replicas),
+        "HTTP" if isinstance(replicas[0], HTTPReplica) else "in-process",
+        rcfg.affinity_weight,
+        rcfg.fail_threshold,
+    )
+    return router, registry
 
 
 def _handle_serve(args: argparse.Namespace) -> int:
@@ -1615,6 +1779,16 @@ def _handle_serve(args: argparse.Namespace) -> int:
             "set serving.mode: continuous (or pass --mode continuous)"
         )
         return EXIT_CONFIG_ERROR
+    if args.backends and args.discover:
+        _emit_error("--backends and --discover are mutually exclusive")
+        return EXIT_CONFIG_ERROR
+    use_router = bool(args.router or args.backends or args.discover)
+    if use_router and mode != "continuous":
+        _emit_error(
+            "--router needs the continuous backend; set serving.mode: "
+            "continuous (or pass --mode continuous)"
+        )
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache(cfg.run.compilation_cache_dir)
@@ -1642,9 +1816,14 @@ def _handle_serve(args: argparse.Namespace) -> int:
 
         if mode == "continuous":
             try:
-                scheduler, registry = _build_serving_backend(
-                    cfg, args, model, params, logger
-                )
+                if use_router:
+                    scheduler, registry = _build_router_backend(
+                        cfg, args, model, params, logger
+                    )
+                else:
+                    scheduler, registry = _build_serving_backend(
+                        cfg, args, model, params, logger
+                    )
             except ConfigLoadError as exc:
                 _emit_error(exc.message, details=exc.details, errors=exc.errors)
                 return EXIT_CONFIG_ERROR
@@ -1678,6 +1857,48 @@ def _handle_serve(args: argparse.Namespace) -> int:
             registry=registry,
             request_timeout_sec=cfg.serving.request_timeout_sec,
         )
+
+        if mode == "continuous":
+            # Zero-downtime checkpoint hot-swap: POST /reload re-resolves
+            # the --from spec (a dir or run id resolves to the NEWEST
+            # manifest-committed checkpoint, training/checkpoint.py) and
+            # swaps the params without dropping a request — in-flight
+            # sequences finish on the params they were admitted under,
+            # new admissions use the new ones. With --router the swap
+            # rolls one replica at a time.
+            def _reload(body: dict) -> dict:
+                spec = str(body.get("from") or args.from_spec)
+                _, new_params, new_ckpt, new_step = _load_decode_params(
+                    cfg,
+                    adapter,
+                    model,
+                    spec,
+                    ema=args.ema,
+                    decode_param_dtype=args.decode_param_dtype,
+                    quantize=args.quantize,
+                    logger=logger,
+                    label="reload ",
+                )
+                out: dict[str, Any] = {
+                    "step": new_step,
+                    "checkpoint": str(new_ckpt),
+                }
+                if hasattr(scheduler, "rolling_reload"):
+                    out["replicas"] = scheduler.rolling_reload(
+                        params=new_params,
+                        step=new_step,
+                        checkpoint=str(new_ckpt),
+                    )
+                else:
+                    scheduler.hot_swap(
+                        new_params, step=new_step, checkpoint=str(new_ckpt)
+                    )
+                state.params = new_params
+                state.step, state.checkpoint = new_step, str(new_ckpt)
+                return out
+
+            state.reloader = _reload
+
         httpd = make_server(state, args.host, args.port)
         host, port = httpd.server_address[:2]
         # Machine-readable ready line: tests (and orchestration) read the
@@ -1690,6 +1911,9 @@ def _handle_serve(args: argparse.Namespace) -> int:
                     "port": port,
                     "mode": mode,
                     "policy": scheduler.policy if scheduler else None,
+                    "router": (
+                        len(scheduler.replicas) if use_router else None
+                    ),
                 }
             ),
             flush=True,
@@ -1747,6 +1971,18 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
         # a misleading EXIT_TRAIN_FAILURE instead of a config error.
         _emit_error("--max-new-tokens must be >= 1")
         return EXIT_CONFIG_ERROR
+    if args.long_fraction and not args.long_prompt_tokens:
+        _emit_error("--long-fraction needs --long-prompt-tokens")
+        return EXIT_CONFIG_ERROR
+    if not (0.0 <= args.long_fraction <= 1.0):
+        _emit_error("--long-fraction must be in [0, 1]")
+        return EXIT_CONFIG_ERROR
+    if args.shared_prefix_tokens < 0 or args.shared_prefix_count < 1:
+        _emit_error(
+            "--shared-prefix-tokens must be >= 0 and "
+            "--shared-prefix-count >= 1"
+        )
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache(cfg.run.compilation_cache_dir)
@@ -1781,11 +2017,27 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
         pmax = args.prompt_tokens_max or min(32, block_size - args.max_new_tokens)
         pmax = min(pmax, block_size - args.max_new_tokens)
         pmin = min(args.prompt_tokens_min, pmax)
+        # The mix knobs can push prompts past what a request may hold.
+        worst_prompt = args.shared_prefix_tokens + max(
+            pmax, args.long_prompt_tokens if args.long_fraction else 0
+        )
+        if worst_prompt + args.max_new_tokens > block_size:
+            _emit_error(
+                f"longest possible prompt ({worst_prompt} tokens incl. "
+                f"shared prefix) + --max-new-tokens "
+                f"({args.max_new_tokens}) exceeds block_size ({block_size})"
+            )
+            return EXIT_CONFIG_ERROR
 
         try:
-            scheduler, registry = _build_serving_backend(
-                cfg, args, model, params, logger
-            )
+            if args.router:
+                scheduler, registry = _build_router_backend(
+                    cfg, args, model, params, logger
+                )
+            else:
+                scheduler, registry = _build_serving_backend(
+                    cfg, args, model, params, logger
+                )
         except ConfigLoadError as exc:
             _emit_error(exc.message, details=exc.details, errors=exc.errors)
             return EXIT_CONFIG_ERROR
@@ -1803,6 +2055,10 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             temperature=args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,
+            shared_prefix_tokens=args.shared_prefix_tokens,
+            shared_prefix_count=args.shared_prefix_count,
+            long_fraction=args.long_fraction,
+            long_prompt_tokens=args.long_prompt_tokens,
         )
         logger.info(
             "serve-bench: %d requests, prompts %d-%d tokens, %d new tokens, "
@@ -1835,6 +2091,14 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
                 f"{block['requests']['failed']} failed / "
                 f"{block['requests']['timed_out']} timed-out requests"
             )
+        if args.max_per_token_p99_ms is not None:
+            p99 = block["slo"]["per_token_ms"]["p99"]
+            if p99 is None or p99 > args.max_per_token_p99_ms:
+                failures.append(
+                    f"per-token p99 {p99} ms exceeds the "
+                    f"--max-per-token-p99-ms bound "
+                    f"({args.max_per_token_p99_ms} ms)"
+                )
 
         if args.verify_parity:
             # The exactness contract: batched continuous decode must emit

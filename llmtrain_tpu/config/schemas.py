@@ -458,6 +458,32 @@ class TelemetryConfig(BaseModel):
     model_config = _STRICT
 
 
+class RouterConfig(BaseModel):
+    """Replica-router knobs (serving/router.py, ``llmtrain serve
+    --router``, docs/serving.md "Fleet tier").
+
+    The router places each request on one of N replicas by score:
+    ``affinity_weight * matched_prefix_blocks - load`` — prefix-cache-
+    aware placement so requests sharing a system prompt land where their
+    KV blocks already live. Replicas failing ``fail_threshold``
+    consecutive requests are evicted and probed again after
+    ``revive_sec``.
+    """
+
+    # In-process replicas `--router` spins up when no --backends given.
+    replicas: int = Field(2, ge=1)
+    # Score weight of one matched prefix block vs one unit of load.
+    affinity_weight: float = Field(4.0, ge=0.0)
+    # LRU cap on the prefix-hash -> replica affinity index.
+    max_affinity_entries: int = Field(4096, ge=1)
+    # Consecutive failures before a replica is evicted from rotation.
+    fail_threshold: int = Field(3, ge=1)
+    # Seconds before an evicted replica gets a revival probe.
+    revive_sec: float = Field(10.0, gt=0.0)
+
+    model_config = _STRICT
+
+
 class ServingConfig(BaseModel):
     """Inference-serving knobs (llmtrain_tpu/serving/, docs/serving.md).
 
@@ -487,6 +513,18 @@ class ServingConfig(BaseModel):
     # serve --draft-config/--draft-from, occupancy stays 1).
     policy: Literal["paged", "speculative"] = "paged"
     speculative_gamma: int = Field(4, ge=1)
+    # Shared-prefix KV reuse: content-addressed read-only prefix blocks
+    # with refcounts and copy-on-write at the first divergent token
+    # (serving/paged_kv.py).
+    prefix_cache: bool = False
+    # Chunked prefill: > 0 splits long prompts into chunks of at most
+    # this many tokens, interleaved one per scheduler step with decode —
+    # long prompts stop blocking in-flight decodes, and the compile
+    # budget grows only by the chunk's bucket. 0 = whole-prompt prefill.
+    # Incompatible with the speculative policy.
+    prefill_chunk: int = Field(0, ge=0)
+    # Replica-router tier (`llmtrain serve --router`).
+    router: RouterConfig = Field(default_factory=RouterConfig)
     # Request validation caps (shared by both modes).
     max_new_tokens_cap: int = Field(256, ge=1)
     default_max_new_tokens: int = Field(48, ge=1)
@@ -512,6 +550,21 @@ class ServingConfig(BaseModel):
             )
         if self.num_blocks and self.num_blocks < 2:
             raise ValueError("serving.num_blocks must be 0 (derived) or >= 2")
+        if self.prefill_chunk and self.policy == "speculative":
+            raise ValueError(
+                "serving.prefill_chunk requires the paged policy — the "
+                "speculative draft loop prefills whole prompts"
+            )
+        if (
+            self.prefill_chunk
+            and self.prompt_buckets
+            and self.prefill_chunk > self.prompt_buckets[-1]
+        ):
+            raise ValueError(
+                f"serving.prefill_chunk ({self.prefill_chunk}) exceeds the "
+                f"largest prompt bucket ({self.prompt_buckets[-1]}) — chunks "
+                "must pad into an existing bucket"
+            )
         return self
 
 

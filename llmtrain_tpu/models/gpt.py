@@ -554,14 +554,14 @@ class CausalSelfAttention(nn.Module):
                 f"paged_block_tokens > 0 (got {nb}, {bt}) — use "
                 "GPT.for_paged_decoding()"
             )
-        if self.rope or self.sliding_window or self.kv_cache_dtype != "model":
-            # v1 scope: learned-position, full-causal, full-precision
-            # cache (the GPT serving family). GPT.for_paged_decoding()
-            # pre-checks the fields GPT exposes (sliding_window, cache
-            # dtype); rope only reaches here via attention modules built
-            # directly (the llama family has no paged entrypoint yet).
+        if self.sliding_window or self.kv_cache_dtype != "model":
+            # Scope: full-causal, full-precision cache. RoPE is supported
+            # (rotation by the per-row absolute positions below), so the
+            # llama family serves paged; the sliding-window ring and the
+            # int8 cache keep their named raise — for_paged_decoding()
+            # pre-checks the model-level fields too.
             raise ValueError(
-                "paged decode does not support rope/sliding_window/"
+                "paged decode does not support sliding_window/"
                 "quantized cache yet"
             )
         batch, t, n_heads, head_dim = q.shape
@@ -574,6 +574,14 @@ class CausalSelfAttention(nn.Module):
         )
         # Absolute position of every token in this call, per row.
         pos = positions[:, None] + jnp.arange(t)[None, :]  # (B, t)
+        if self.rope:
+            # Rotate by PER-ROW absolute positions before the cache
+            # write (the linear path's recipe at a (B, t) position grid):
+            # the pool then holds rotated keys, directly comparable to
+            # any later query rotated by its own positions.
+            from ..ops.rope import apply_rope
+
+            q, k = apply_rope(q, k, pos, theta=self.rope_theta)
         blocks = jnp.take_along_axis(block_tables, pos // bt, axis=1)  # (B, t)
         slots = pos % bt
         # Distinct rows hold disjoint physical blocks (allocator invariant),
@@ -864,9 +872,10 @@ class GPT(nn.Module):
             raise ValueError(f"num_blocks must be >= 2 (got {num_blocks})")
         if block_tokens < 1:
             raise ValueError(f"block_tokens must be >= 1 (got {block_tokens})")
-        # (No rope check: GPT has no rope field — rotary embeddings live on
-        # CausalSelfAttention for the llama-family modules, whose paged
-        # path is guarded by the attention-level check instead.)
+        # (No rope check: GPT has no rope field — rotary embeddings live
+        # on CausalSelfAttention for the llama-family modules, and the
+        # paged path rotates by per-row positions; Llama.for_paged_decoding
+        # is the llama-family twin of this entrypoint.)
         if self.sliding_window:
             raise ValueError(
                 "paged decode does not support sliding_window models yet; "

@@ -111,6 +111,12 @@ class LlamaBlock(nn.Module):
     sliding_window: int = 0  # Mistral-style window; 0 = full causal
     ring_slack: int = 0  # extra rolling-cache slots (speculative decode)
     kv_cache_dtype: str = "model"  # "int8": quantized decode cache
+    # Paged block-pool decode cache (models/gpt.py CausalSelfAttention):
+    # RoPE rotates by the per-row absolute positions the paged path
+    # tracks, so the llama family serves continuous-batching too.
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_tokens: int = 0
     # Mixture-of-Experts MLP with SwiGLU experts (models/moe.py,
     # mlp_type="swiglu" — the Mixtral layout); 0 = dense SwiGLU.
     n_experts: int = 0
@@ -124,6 +130,8 @@ class LlamaBlock(nn.Module):
         x: jax.Array,
         attention_mask: jax.Array | None = None,
         deterministic: bool = True,
+        positions: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         norm_kw = dict(
             eps=self.rms_norm_eps,
@@ -156,8 +164,17 @@ class LlamaBlock(nn.Module):
             sliding_window=self.sliding_window,
             ring_slack=self.ring_slack,
             kv_cache_dtype=self.kv_cache_dtype,
+            paged=self.paged,
+            paged_num_blocks=self.paged_num_blocks,
+            paged_block_tokens=self.paged_block_tokens,
             name="attn",
-        )(h, attention_mask, deterministic=deterministic)
+        )(
+            h,
+            attention_mask,
+            deterministic=deterministic,
+            positions=positions,
+            block_tables=block_tables,
+        )
 
         h = nn.with_logical_constraint(RMSNorm(name="mlp_norm", **norm_kw)(x), act)
         if self.n_experts > 0:
@@ -257,12 +274,49 @@ class Llama(nn.Module):
     # Extra rolling-cache slots for speculative decode rollback safety
     # (models/gpt.py CausalSelfAttention.ring_slack).
     ring_slack: int = 0
+    # Paged block-pool decode cache for continuous-batching serving; set
+    # via for_paged_decoding().
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_tokens: int = 0
     # Mixture-of-Experts with SwiGLU experts (model.name llama_moe — the
     # Mixtral architecture); 0 = dense SwiGLU MLPs.
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     router_top_k: int = 1
+
+    def for_paged_decoding(
+        self, *, num_blocks: int, block_tokens: int
+    ) -> "Llama":
+        """Clone configured for paged-KV continuous-batching decode (the
+        GPT.for_paged_decoding contract; serving/engine.py dispatches on
+        this method's presence). RoPE needs no special casing — the paged
+        attention rotates q/k by its per-row absolute positions — but the
+        sliding-window ring and the int8 cache keep their named raise, so
+        Mistral-with-window configs fall back to ``serving.mode: simple``
+        with an actionable error instead of silently wrong K/V."""
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (got {num_blocks})")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1 (got {block_tokens})")
+        if self.sliding_window:
+            raise ValueError(
+                "paged decode does not support sliding_window models yet; "
+                "use for_decoding() (rolling-ring cache)"
+            )
+        if self.kv_cache_dtype != "model":
+            raise ValueError(
+                "paged decode does not support kv_cache_dtype="
+                f"{self.kv_cache_dtype!r} yet; use for_decoding()"
+            )
+        return self.clone(
+            decode=True,
+            paged=True,
+            remat=False,
+            paged_num_blocks=num_blocks,
+            paged_block_tokens=block_tokens,
+        )
 
     def for_decoding(
         self, cache_len: int | None = None, *, ring_slack: int = 0
@@ -286,6 +340,8 @@ class Llama(nn.Module):
         *,
         deterministic: bool = True,
         return_hidden: bool = False,
+        positions: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         _, seqlen = input_ids.shape
         if seqlen > self.block_size:
@@ -327,8 +383,9 @@ class Llama(nn.Module):
                 policy=REMAT_POLICIES[self.remat_policy],
             )
 
+        paged = self.decode and self.paged
         for layer in range(self.n_layers):
-            x = block_cls(
+            block = block_cls(
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 d_ff=self.d_ff,
@@ -349,12 +406,28 @@ class Llama(nn.Module):
                 sliding_window=self.sliding_window,
                 kv_cache_dtype=self.kv_cache_dtype,
                 ring_slack=self.ring_slack if self.decode else 0,
+                paged=paged,
+                paged_num_blocks=self.paged_num_blocks if paged else 0,
+                paged_block_tokens=self.paged_block_tokens if paged else 0,
                 n_experts=self.n_experts,
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
                 router_top_k=self.router_top_k,
                 name=f"block_{layer}",
-            )(x, attention_mask, deterministic)
+            )
+            if paged:
+                # kwargs only on the paged path: the remat wrapper's
+                # positional static_argnums contract stays untouched
+                # (paged implies remat=False anyway, gpt.py precedent).
+                x = block(
+                    x,
+                    attention_mask,
+                    deterministic,
+                    positions=positions,
+                    block_tables=block_tables,
+                )
+            else:
+                x = block(x, attention_mask, deterministic)
 
         x = RMSNorm(
             name="norm_f",

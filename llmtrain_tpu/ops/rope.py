@@ -55,12 +55,22 @@ def apply_rope(
     q: (B, T, H, Dh); k: (B, T, Hkv, Dh) — K may be narrower (GQA); the
     rotation is per-head-feature so both use the same tables.
     ``positions``: (T,) absolute positions shared across the batch
-    (generation batches rectangular prompts, generation.py:111-120).
+    (generation batches rectangular prompts, generation.py:111-120), or
+    (B, T) PER-ROW positions — paged decode batches sequences at
+    different depths, so each row rotates by its own offsets.
     Rotation runs in f32 and casts back to the input dtype.
     """
     cos, sin = rope_angles(positions, q.shape[-1], theta=theta)
-    cos = cos[None, :, None, :]  # (1, T, 1, Dh)
-    sin = sin[None, :, None, :]
+    if positions.ndim == 1:
+        cos = cos[None, :, None, :]  # (1, T, 1, Dh)
+        sin = sin[None, :, None, :]
+    elif positions.ndim == 2:
+        cos = cos[:, :, None, :]  # (B, T, 1, Dh)
+        sin = sin[:, :, None, :]
+    else:
+        raise ValueError(
+            f"positions must be (T,) or (B, T), got shape {positions.shape}"
+        )
 
     def rot(x: jax.Array) -> jax.Array:
         xf = x.astype(jnp.float32)

@@ -15,27 +15,37 @@ whose public names — ``ServerState``, ``make_server``, and the tested
   per-step join/evict, speculative decoding as a first-class policy,
   ``serve/*`` metrics;
 * :mod:`~.loadgen` — seeded open-loop arrival harness emitting the
-  p50/p95/p99 TTFT + per-token SLO block for report.json / Prometheus.
+  p50/p95/p99 TTFT + per-token SLO block for report.json / Prometheus;
+* :mod:`~.router` — the fleet tier: prefix-cache-aware + load-aware
+  dispatch across N in-process or HTTP replicas, health/eviction/
+  failover, rolling zero-downtime checkpoint reloads.
 """
 
 from .engine import PagedDecodeEngine, bucket_for
 from .http import ServerState, ServerStats, _handle_generate_request, make_server
 from .loadgen import build_requests, percentiles, run_loadgen
-from .paged_kv import NULL_BLOCK, BlockTable, PagedKVPool
+from .paged_kv import NULL_BLOCK, BlockTable, PagedKVPool, PrefixMatch, chain_hashes
+from .router import HTTPReplica, InProcessReplica, ReplicaRouter, resolve_backends
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
 
 __all__ = [
     "NULL_BLOCK",
     "BlockTable",
     "ContinuousBatchingScheduler",
+    "HTTPReplica",
+    "InProcessReplica",
     "PagedDecodeEngine",
     "PagedKVPool",
+    "PrefixMatch",
+    "ReplicaRouter",
     "ServeRequest",
     "ServerState",
     "ServerStats",
     "bucket_for",
     "build_requests",
+    "chain_hashes",
     "make_server",
     "percentiles",
+    "resolve_backends",
     "run_loadgen",
 ]

@@ -122,17 +122,22 @@ def _prefill_impl(
     cache: Any,
     prompt: jax.Array,  # (1, Tb) padded
     true_len: jax.Array,  # (1,) int32
+    offsets: jax.Array,  # (1,) int32 — absolute position of prompt[0]
     block_tables: jax.Array,  # (1, MB) int32
     seeds: jax.Array,
     temps: jax.Array,
     top_ks: jax.Array,
     top_ps: jax.Array,
 ) -> tuple[Any, jax.Array]:
+    # `offsets` starts the row mid-sequence: 0 for a whole prompt, the
+    # reused-prefix length under shared-prefix reuse, the chunk start
+    # under chunked prefill. The suffix attends earlier positions through
+    # the block table (cached K/V), exactly like a multi-token decode.
     logits, mutated = model.apply(
         {"params": params, "cache": cache},
         prompt,
         deterministic=True,
-        positions=jnp.zeros((prompt.shape[0],), jnp.int32),
+        positions=offsets,
         block_tables=block_tables,
         mutable=["cache"],
     )
@@ -174,6 +179,39 @@ def _decode_impl(
     return mutated["cache"], tok
 
 
+def _verify_impl(
+    model: Any,
+    params: Any,
+    cache: Any,
+    tokens: jax.Array,  # (B, t) int32 — context token + t-1 draft tokens
+    positions: jax.Array,  # (B,) int32 — absolute position of tokens[:, 0]
+    block_tables: jax.Array,  # (B, MB) int32
+) -> tuple[Any, jax.Array]:
+    """Score a (gamma+1)-token slab per row in ONE call: the batched twin
+    of speculative.py's target forward. Returns the greedy (argmax) token
+    at every slab position — position j's argmax is the target model's
+    next token GIVEN drafts < j, which is all greedy acceptance needs
+    (speculative.py: accept while draft == argmax, emit the first
+    correction from the same logits)."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        deterministic=True,
+        positions=positions,
+        block_tables=block_tables,
+        mutable=["cache"],
+    )
+    return mutated["cache"], jnp.argmax(
+        logits.astype(jnp.float32), axis=-1
+    ).astype(jnp.int32)
+
+
+def _cow_impl(cache: Any, src: jax.Array, dst: jax.Array) -> Any:
+    """Copy-on-write device copy: pool block ``src`` → ``dst`` across
+    every paged cache leaf (leaves are (num_blocks, bt, kv, dh))."""
+    return jax.tree.map(lambda leaf: leaf.at[dst].set(leaf[src]), cache)
+
+
 class PagedDecodeEngine:
     """Bucketed paged-KV decode over one model + params.
 
@@ -193,6 +231,8 @@ class PagedDecodeEngine:
         max_batch_slots: int = 8,
         prompt_buckets: list[int] | None = None,
         batch_buckets: list[int] | None = None,
+        prefix_cache: bool = False,
+        prefill_chunk: int = 0,
     ) -> None:
         if not hasattr(model, "for_paged_decoding"):
             raise ValueError(
@@ -224,10 +264,23 @@ class PagedDecodeEngine:
                 f"largest batch bucket ({self.batch_buckets[-1]}) must equal "
                 f"max_batch_slots ({self.max_batch_slots})"
             )
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 = off), got {prefill_chunk}"
+            )
+        if self.prefill_chunk > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) exceeds the largest "
+                f"prompt bucket ({self.prompt_buckets[-1]}) — chunks must "
+                "pad into an existing bucket (the bounded-compile contract)"
+            )
         self.decode_model = model.for_paged_decoding(
             num_blocks=num_blocks, block_tokens=self.block_tokens
         )
-        self.pool = PagedKVPool(num_blocks, self.block_tokens)
+        self.pool = PagedKVPool(
+            num_blocks, self.block_tokens, prefix_cache=prefix_cache
+        )
 
         # Zero cache pytree from an eval_shape trace — no param init work
         # (the generation.py idiom). Cache shapes are batch-INDEPENDENT
@@ -262,10 +315,20 @@ class PagedDecodeEngine:
         def _decode_bound(params: Any, cache: Any, *rest: Any) -> Any:
             return _decode_impl(self.decode_model, params, cache, *rest)
 
+        def _verify_bound(params: Any, cache: Any, *rest: Any) -> Any:
+            return _verify_impl(self.decode_model, params, cache, *rest)
+
+        def _cow_bound(cache: Any, src: Any, dst: Any) -> Any:
+            return _cow_impl(cache, src, dst)
+
         self._prefill_jit = jax.jit(_prefill_bound, donate_argnums=(1,))
         self._decode_jit = jax.jit(_decode_bound, donate_argnums=(1,))
+        self._verify_jit = jax.jit(_verify_bound, donate_argnums=(1,))
+        self._cow_jit = jax.jit(_cow_bound, donate_argnums=(0,))
         self._prefill_shapes: set[int] = set()
         self._decode_shapes: set[int] = set()
+        self._verify_shapes: set[tuple[int, int]] = set()
+        self._cow_used = False
 
     # --------------------------------------------------------- validation
 
@@ -285,7 +348,10 @@ class PagedDecodeEngine:
                 f"prompt+max_new_tokens ({total}) exceeds the model "
                 f"block_size ({self.block_size})"
             )
-        if prompt_len > self.prompt_buckets[-1]:
+        if self.prefill_chunk == 0 and prompt_len > self.prompt_buckets[-1]:
+            # Chunked prefill lifts this bound: chunks of <= prefill_chunk
+            # tokens each pad into an existing bucket, so long prompts are
+            # servable up to the block_size check above.
             return (
                 f"prompt length ({prompt_len}) exceeds the largest "
                 f"serving prompt bucket ({self.prompt_buckets[-1]})"
@@ -304,15 +370,21 @@ class PagedDecodeEngine:
 
     def prefill(
         self,
-        prompt_ids: np.ndarray,  # (Tp,) int32
+        prompt_ids: np.ndarray,  # (Tp,) int32 — the slab to run (suffix
+        # of the prompt under prefix reuse / one chunk under chunking)
         table_padded: list[int],
         *,
         seed: int,
         temperature: float,
         top_k: int | None,
         top_p: float | None,
+        offset: int = 0,  # absolute position of prompt_ids[0]
+        params: Any | None = None,  # hot-swap: admitted-epoch params
     ) -> int:
-        """Run one joining sequence's prompt; returns its first token."""
+        """Run one joining sequence's prompt slab; returns the token
+        sampled at its last real position (the first output token when
+        the slab ends the prompt; discarded by the caller for non-final
+        chunks — one program either way, the bounded-compile contract)."""
         tp = int(prompt_ids.shape[0])
         tb = bucket_for(tp, self.prompt_buckets)
         self._prefill_shapes.add(tb)
@@ -320,10 +392,11 @@ class PagedDecodeEngine:
         prompt[0, :tp] = prompt_ids
         try:
             cache, tok = self._prefill_jit(
-                self.params,
+                self.params if params is None else params,
                 self._cache,
                 jnp.asarray(prompt),
                 jnp.asarray([tp], jnp.int32),
+                jnp.asarray([int(offset)], jnp.int32),
                 jnp.asarray([table_padded], jnp.int32),
                 jnp.asarray([seed & 0xFFFFFFFF], jnp.uint32),
                 jnp.asarray([temperature], jnp.float32),
@@ -336,7 +409,9 @@ class PagedDecodeEngine:
         self._cache = cache
         return int(tok[0])
 
-    def decode(self, rows: list[dict[str, Any]]) -> list[int]:
+    def decode(
+        self, rows: list[dict[str, Any]], *, params: Any | None = None
+    ) -> list[int]:
         """Advance every row one token; returns next tokens, row-aligned.
 
         Each row dict: ``token`` (last emitted), ``position`` (its
@@ -363,7 +438,7 @@ class PagedDecodeEngine:
             tables[i] = r["table"]
         try:
             cache, tok = self._decode_jit(
-                self.params,
+                self.params if params is None else params,
                 self._cache,
                 jnp.asarray(col("token", 0, np.int32)),
                 jnp.asarray(col("position", 0, np.int32)),
@@ -384,6 +459,71 @@ class PagedDecodeEngine:
             raise
         self._cache = cache
         return [int(t) for t in np.asarray(jax.device_get(tok))[:n]]
+
+    def verify(
+        self,
+        rows: list[dict[str, Any]],
+        *,
+        width: int,
+        params: Any | None = None,
+    ) -> list[list[int]]:
+        """Score a ``width``-token slab for every row in ONE bucketed call
+        (batched speculative verify). Each row dict: ``tokens`` (width
+        ints — last accepted token + the draft tokens), ``position``
+        (tokens[0]'s absolute position), ``table``. Returns each row's
+        per-position argmax — the target model's greedy continuation
+        given every draft prefix. Writes the slab's K/V; rejected
+        positions are simply overwritten when the corrected tokens are
+        fed (position p maps to a fixed (block, slot), and queries never
+        see past their own position — cursorless rollback)."""
+        n = len(rows)
+        if n == 0:
+            return []
+        bb = bucket_for(n, self.batch_buckets)
+        self._verify_shapes.add((bb, width))
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((bb, width), np.int32)
+        positions = np.zeros((bb,), np.int32)
+        tables = np.zeros((bb, mb), np.int32)
+        for i, r in enumerate(rows):
+            if len(r["tokens"]) != width:
+                raise ValueError(
+                    f"verify row {i} holds {len(r['tokens'])} tokens, "
+                    f"expected width {width}"
+                )
+            tokens[i] = r["tokens"]
+            positions[i] = r["position"]
+            tables[i] = r["table"]
+        try:
+            cache, out = self._verify_jit(
+                self.params if params is None else params,
+                self._cache,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(tables),
+            )
+        except Exception:
+            self._recover_cache_after_error()
+            raise
+        self._cache = cache
+        host = np.asarray(jax.device_get(out))
+        return [[int(t) for t in host[i]] for i in range(n)]
+
+    def cow_copy(self, src: int, dst: int) -> None:
+        """Device-side copy-on-write: pool block ``src`` → ``dst`` in every
+        cache leaf. The pool's cow_last_shared() picks the pair; this is
+        the write half of its contract (must run before the next pool
+        mutation can recycle ``src``)."""
+        self._cow_used = True
+        try:
+            self._cache = self._cow_jit(
+                self._cache,
+                jnp.asarray([src], jnp.int32),
+                jnp.asarray([dst], jnp.int32),
+            )
+        except Exception:
+            self._recover_cache_after_error()
+            raise
 
     def _recover_cache_after_error(self) -> None:
         """Donation safety: a jitted call that fails at RUNTIME has already
@@ -441,6 +581,7 @@ class PagedDecodeEngine:
             cache_structs,
             sds((1, tb), jnp.int32),   # prompt
             sds((1,), jnp.int32),      # true_len
+            sds((1,), jnp.int32),      # offsets
             sds((1, mb), jnp.int32),   # block_tables
             sds((1,), jnp.uint32),     # seeds
             sds((1,), jnp.float32),    # temps
@@ -477,25 +618,55 @@ class PagedDecodeEngine:
     def compile_stats(self) -> dict[str, Any]:
         """Bucket usage + compiled-program counts (the bounded-compile
         contract: programs <= prompt_buckets + batch_buckets, asserted by
-        tests and reported by the load harness)."""
+        tests and reported by the load harness). Optional programs widen
+        the budget only when their feature is exercised: batched
+        speculative verify adds at most one program per batch bucket per
+        slab width used, and the COW copy is exactly one program — so
+        chunked prefill adds NOTHING (chunks pad into existing prompt
+        buckets) and the budget stays a static, assertable bound."""
+        verify_widths = {w for _, w in self._verify_shapes}
         stats: dict[str, Any] = {
             "prompt_buckets": list(self.prompt_buckets),
             "batch_buckets": list(self.batch_buckets),
             "prefill_shapes_used": sorted(self._prefill_shapes),
             "decode_shapes_used": sorted(self._decode_shapes),
-            "budget": len(self.prompt_buckets) + len(self.batch_buckets),
+            "verify_shapes_used": sorted(self._verify_shapes),
+            "budget": (
+                len(self.prompt_buckets)
+                + len(self.batch_buckets)
+                + len(self.batch_buckets) * len(verify_widths)
+                + (1 if self._cow_used else 0)
+            ),
         }
         try:  # jax's own cache entry count, when the API exists (0.4.x)
             stats["prefill_programs"] = int(self._prefill_jit._cache_size())
             stats["decode_programs"] = int(self._decode_jit._cache_size())
+            stats["verify_programs"] = int(self._verify_jit._cache_size())
+            stats["cow_programs"] = int(self._cow_jit._cache_size())
         except Exception:  # noqa: BLE001 — accounting is best-effort
             stats["prefill_programs"] = len(self._prefill_shapes)
             stats["decode_programs"] = len(self._decode_shapes)
+            stats["verify_programs"] = len(self._verify_shapes)
+            stats["cow_programs"] = 1 if self._cow_used else 0
         stats["within_budget"] = (
-            stats["prefill_programs"] + stats["decode_programs"]
+            stats["prefill_programs"]
+            + stats["decode_programs"]
+            + stats["verify_programs"]
+            + stats["cow_programs"]
             <= stats["budget"]
         )
         return stats
+
+    # ---------------------------------------------------------- hot swap
+
+    def set_params(self, params: Any) -> None:
+        """Swap the default params between scheduler steps (checkpoint
+        hot-swap). Callers that pin a request to its admitted params pass
+        them explicitly to prefill/decode/verify instead — the jitted
+        programs take params as a traced argument, so neither path
+        recompiles. The prefix cache must be invalidated by the caller
+        (scheduler) — cached K/V is a function of the OLD params."""
+        self.params = params
 
 
 __all__ = [

@@ -152,11 +152,17 @@ class ServerState:
     # scheduler path replaces the lock with the admission queue.
     lock: threading.Lock = field(default_factory=threading.Lock)
     stats: ServerStats = field(default_factory=ServerStats)
-    # Continuous-batching backend (serving/scheduler.py), None = legacy.
+    # Continuous-batching backend (serving/scheduler.py) or a
+    # ReplicaRouter (serving/router.py) — duck-typed; None = legacy.
     scheduler: Any | None = None
     # Telemetry registry served on GET /metrics (llmtrain_serve_*).
     registry: Any | None = None
     request_timeout_sec: float = 120.0
+    # Zero-downtime checkpoint hot-swap: POST /reload calls this with the
+    # request body; it loads the newest manifest-committed checkpoint,
+    # applies scheduler.hot_swap()/router.rolling_reload(), and returns
+    # the response dict (the CLI builds the closure). None = 404.
+    reloader: Any | None = None
 
     @property
     def requests_served(self) -> int:
@@ -327,6 +333,25 @@ def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]
     }
 
 
+def _handle_reload(state: ServerState, body: dict) -> tuple[int, dict]:
+    """POST /reload — zero-downtime checkpoint hot-swap. The heavy work
+    (manifest read, param load, scheduler.hot_swap) lives in the CLI's
+    reloader closure; in-flight requests keep decoding on their admitted
+    params throughout, so this endpoint is safe under live traffic."""
+    if state.reloader is None:
+        return 404, {"error": "this server has no reloader attached"}
+    if body is None:
+        body = {}
+    if not isinstance(body, dict):
+        return _bad_request("request body must be a JSON object (or empty)")
+    try:
+        out = state.reloader(body)
+    except Exception as exc:  # noqa: BLE001 — a bad checkpoint must not 500
+        # the serving loop: the old params keep serving.
+        return 409, {"error": f"reload failed (still serving old params): {exc}"}
+    return 200, {"status": "ok", **(out or {})}
+
+
 def _handle_health(state: ServerState) -> tuple[int, dict]:
     payload: dict[str, Any] = {
         "status": "ok",
@@ -388,7 +413,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(404, {"error": f"no route for GET {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
-        if self.path != "/v1/generate":
+        if self.path not in ("/v1/generate", "/reload"):
             self._respond(404, {"error": f"no route for POST {self.path}"})
             return
         try:
@@ -396,6 +421,9 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b"null")
         except (ValueError, json.JSONDecodeError):
             self._respond(400, {"error": "body is not valid JSON"})
+            return
+        if self.path == "/reload":
+            self._respond(*_handle_reload(self.state, body))
             return
         try:
             self._respond(*_handle_generate_request(self.state, body))

@@ -60,17 +60,44 @@ def build_requests(
     top_k: int | None = None,
     top_p: float | None = None,
     eos_token_id: int | None = None,
+    shared_prefix_tokens: int = 0,
+    shared_prefix_count: int = 1,
+    long_fraction: float = 0.0,
+    long_prompt_tokens: int = 0,
 ) -> list[ServeRequest]:
     """Seeded request population: prompt lengths/ids and per-request rng
     seeds all derive from one numpy Generator, so a run is replayable —
-    the property the bitwise parity check against ``generate()`` needs."""
+    the property the bitwise parity check against ``generate()`` needs.
+
+    Two mix knobs shape the population for the fleet features:
+
+    * ``shared_prefix_tokens`` > 0 prepends one of
+      ``shared_prefix_count`` fixed "system prompts" (seeded, chosen per
+      request) — the workload where shared-prefix KV reuse and the
+      router's prefix-affinity placement pay off;
+    * ``long_fraction`` > 0 makes that fraction of requests use
+      ``long_prompt_tokens``-token prompts (the rest stay in the
+      min..max band) — the bimodal long/short mix chunked prefill
+      exists for.
+    """
     rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, vocab_size, size=shared_prefix_tokens, dtype=np.int64)
+        .astype(np.int32)
+        for _ in range(shared_prefix_count if shared_prefix_tokens > 0 else 0)
+    ]
     reqs: list[ServeRequest] = []
     for i in range(num_requests):
-        tp = int(rng.integers(prompt_tokens_min, prompt_tokens_max + 1))
+        if long_fraction > 0.0 and rng.random() < long_fraction:
+            tp = int(long_prompt_tokens)
+        else:
+            tp = int(rng.integers(prompt_tokens_min, prompt_tokens_max + 1))
         prompt = rng.integers(0, vocab_size, size=tp, dtype=np.int64).astype(
             np.int32
         )
+        if prefixes:
+            prefix = prefixes[int(rng.integers(0, len(prefixes)))]
+            prompt = np.concatenate([prefix, prompt]).astype(np.int32)
         reqs.append(
             ServeRequest(
                 prompt_ids=prompt,
@@ -168,8 +195,49 @@ def run_loadgen(
     }
     if "kv_pool" in stats:
         block["kv_pool"] = stats["kv_pool"]
+        pool = stats["kv_pool"]
+        if "prefix_hit_rate" in pool:
+            # Shared-prefix reuse: blocks bound from cache instead of
+            # re-prefilled — the serving-block gain the bench asserts on.
+            block["prefix_cache"] = {
+                "hits": pool["prefix_hits"],
+                "queries": pool["prefix_queries"],
+                "hit_rate": pool["prefix_hit_rate"],
+                "tokens_reused": pool["prefix_tokens_reused"],
+                "evictions": pool["prefix_evictions"],
+                "cow_copies": pool["cow_copies"],
+            }
     if "compile" in stats:
         block["compile"] = stats["compile"]
+    if "params" in stats:
+        block["params"] = stats["params"]
+    if "router" in stats:
+        # Fleet view: placement counters, per-replica occupancy/health,
+        # and the fleet-wide prefix hit rate.
+        r = stats["router"]
+        block["router"] = {
+            "replicas_healthy": r["replicas_healthy"],
+            "requests_routed": r["requests_routed"],
+            "affinity_routed": r["affinity_routed"],
+            "failovers": r["failovers"],
+            "fleet_prefix": r["fleet_prefix"],
+            "replicas": [
+                {
+                    "name": rep["name"],
+                    "healthy": rep["healthy"],
+                    "routed": rep["routed"],
+                    "peak_batch_occupancy": rep["stats"].get(
+                        "peak_batch_occupancy"
+                    ),
+                    "requests_finished": rep["stats"].get("requests_finished"),
+                    "prefix_hit_rate": rep["stats"]
+                    .get("kv_pool", {})
+                    .get("prefix_hit_rate"),
+                }
+                for rep in r["replicas"]
+            ],
+        }
+        block["prefix_cache"] = r["fleet_prefix"]
 
     registry = scheduler.registry
     if registry is not None:

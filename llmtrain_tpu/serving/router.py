@@ -1,0 +1,627 @@
+"""Fleet-scale serving tier: a replica router with prefix-cache-aware,
+load-aware placement and rolling (zero-downtime) checkpoint reloads.
+
+One engine serves one accelerator; a FLEET serves traffic. This module
+is the front tier that turns N independent serving replicas — in-process
+schedulers (same process, e.g. one per device) or remote HTTP servers
+(k8s pods behind a headless Service) — into one endpoint:
+
+* **Prefix-cache-aware placement.** The router hashes each prompt's
+  full token blocks with the SAME chain hash the pool's prefix cache
+  uses (paged_kv.chain_hashes) and remembers which replica last served
+  each block. A request whose prefix lives on replica R scores toward R
+  — landing it there turns the fleet's per-replica prefix caches into
+  an (approximate) fleet-wide cache, the difference between "the system
+  prompt prefills once per fleet" and "once per replica per eviction".
+* **Load-aware scoring.** Affinity competes against load (queue depth +
+  in-flight sequences + KV-pool utilization, the same numbers
+  ``/healthz`` exposes): ``score = affinity_weight * matched_blocks −
+  load``. A hot replica loses its affinity advantage instead of melting.
+* **Health / eviction / failover.** ``fail_threshold`` consecutive
+  submit failures evict a replica from rotation; it is re-probed after
+  ``revive_sec``. A failed HTTP submit fails over to the next-best
+  replica before the client sees an error.
+* **Rolling hot-swap.** :meth:`rolling_reload` applies a checkpoint
+  swap one replica at a time (scheduler.hot_swap per in-process
+  replica, ``POST /reload`` per HTTP replica) — the rest of the fleet
+  keeps serving, in-flight requests finish on their admitted params,
+  zero requests fail.
+
+The router duck-types the scheduler surface the HTTP layer and load
+harness already consume (``submit`` / ``stats`` / ``registry`` /
+``engine``), so ``make_server`` and ``run_loadgen`` work unchanged with
+a router in the scheduler seat. Metrics publish under ``router/*`` (→
+``llmtrain_router_*`` in Prometheus, scraped on the same federation
+path as the training gauges).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .paged_kv import chain_hashes
+from .scheduler import ContinuousBatchingScheduler, ServeRequest
+
+logger = get_logger()
+
+# Cap on hashed blocks per prompt: affinity only needs the head of the
+# prompt (system prompt / template), not an unbounded hash walk.
+_MAX_AFFINITY_BLOCKS = 64
+
+
+class InProcessReplica:
+    """A serving replica living in this process: one scheduler + engine."""
+
+    def __init__(self, scheduler: ContinuousBatchingScheduler, name: str) -> None:
+        self.scheduler = scheduler
+        self.name = name
+
+    @property
+    def engine(self):
+        return self.scheduler.engine
+
+    def submit(self, req: ServeRequest) -> None:
+        self.scheduler.submit(req)
+
+    def load(self) -> float:
+        """Scalar load for placement: queued + in-flight sequences plus
+        the KV pool's utilization (a nearly-full pool should lose ties
+        even at equal occupancy — its next admission may have to wait)."""
+        s = self.scheduler
+        with s._lock:
+            depth = len(s._queue)
+        load = float(depth + len(s._active) + len(s._prefilling))
+        if s.engine is not None:
+            load += s.engine.pool.stats()["utilization"]
+        return load
+
+    def stats(self) -> dict[str, Any]:
+        return self.scheduler.stats()
+
+    def reload(
+        self,
+        *,
+        params: Any | None = None,
+        step: int | None = None,
+        checkpoint: str | None = None,
+    ) -> dict[str, Any]:
+        if params is None:
+            raise ValueError("in-process reload needs the loaded params")
+        self.scheduler.hot_swap(params, step=step, checkpoint=checkpoint)
+        return {"replica": self.name, "step": step, "checkpoint": checkpoint}
+
+    def healthcheck(self) -> bool:
+        thread = self.scheduler._thread
+        return thread is None or thread.is_alive()
+
+    def close(self) -> None:
+        self.scheduler.close()
+
+
+class HTTPReplica:
+    """A remote serving replica behind ``POST /v1/generate`` (a k8s pod).
+
+    ``submit`` is asynchronous like the scheduler's: the blocking POST
+    runs on a short-lived thread that fills the request's result fields
+    and sets ``done`` — the waiting handler/loadgen code is identical
+    for both replica kinds. Load comes from the replica's ``/healthz``
+    scheduler block, cached for ``poll_sec`` so placement doesn't pay a
+    network round-trip per request.
+    """
+
+    def __init__(
+        self, base_url: str, name: str | None = None, *,
+        timeout_sec: float = 120.0, poll_sec: float = 2.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.name = name or self.base_url
+        self.timeout_sec = float(timeout_sec)
+        self.poll_sec = float(poll_sec)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._cached_load = 0.0
+        self._cached_at = 0.0
+
+    engine = None  # remote: the router cannot pre-validate against it
+
+    def _get(self, path: str) -> dict[str, Any]:
+        with urllib.request.urlopen(
+            self.base_url + path, timeout=min(10.0, self.timeout_sec)
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        data = json.dumps(body).encode("utf-8")
+        request = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(request, timeout=self.timeout_sec) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def perform(self, req: ServeRequest) -> None:
+        """Blocking POST, called on the router's submit thread; raises on
+        transport errors so the router can fail over."""
+        body: dict[str, Any] = {
+            "prompt_ids": [int(t) for t in req.prompt_ids],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "seed": int(req.seed),
+        }
+        if req.top_k is not None:
+            body["top_k"] = int(req.top_k)
+        if req.top_p is not None:
+            body["top_p"] = float(req.top_p)
+        if req.eos_token_id is not None:
+            body["eos_token_id"] = int(req.eos_token_id)
+        try:
+            out = self._post("/v1/generate", body)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        now = time.monotonic()
+        req.tokens = [int(t) for t in out.get("completion_ids", [])]
+        req.first_token_t = now
+        req.token_times = [now] * len(req.tokens)
+        req.finish_reason = out.get("finish_reason", "length")
+        req.finished_t = now
+        req.done.set()
+
+    def submit(self, req: ServeRequest) -> None:
+        req.submitted_t = time.monotonic()
+        req.submitted_pc = time.perf_counter()
+        with self._lock:
+            self._inflight += 1
+        # The router calls perform() itself (failover needs the error);
+        # this direct path exists for scheduler-compatible callers.
+        threading.Thread(
+            target=self._perform_logged, args=(req,), daemon=True
+        ).start()
+
+    def _perform_logged(self, req: ServeRequest) -> None:
+        try:
+            self.perform(req)
+        except Exception as exc:  # noqa: BLE001 — surface on the request
+            logger.warning("replica %s failed: %s", self.name, exc)
+            req.error = str(exc)
+            req.finish_reason = "error"
+            req.finished_t = time.monotonic()
+            req.done.set()
+
+    def load(self) -> float:
+        with self._lock:
+            inflight = self._inflight
+        now = time.monotonic()
+        if now - self._cached_at > self.poll_sec:
+            try:
+                sched = self._get("/healthz").get("scheduler", {})
+                self._cached_load = float(
+                    sched.get("queue_depth", 0)
+                    + sched.get("active_sequences", 0)
+                    + sched.get("prefilling_sequences", 0)
+                    + sched.get("kv_pool", {}).get("utilization", 0.0)
+                )
+                self._cached_at = now
+            except Exception:  # noqa: BLE001 — health probe is best-effort
+                pass
+        # In-flight submits routed here but not yet visible in the remote
+        # queue stats keep bursts from all landing on one replica.
+        return self._cached_load + inflight
+
+    def stats(self) -> dict[str, Any]:
+        try:
+            return self._get("/healthz").get("scheduler", {})
+        except Exception as exc:  # noqa: BLE001
+            return {"error": str(exc)}
+
+    def reload(
+        self,
+        *,
+        params: Any | None = None,
+        step: int | None = None,
+        checkpoint: str | None = None,
+    ) -> dict[str, Any]:
+        body: dict[str, Any] = {}
+        if checkpoint is not None:
+            body["checkpoint"] = checkpoint
+        out = self._post("/reload", body)
+        out.setdefault("replica", self.name)
+        return out
+
+    def healthcheck(self) -> bool:
+        try:
+            return self._get("/healthz").get("status") == "ok"
+        except Exception:  # noqa: BLE001
+            return False
+
+    def close(self) -> None:
+        pass
+
+
+class _ReplicaState:
+    """Router-side health bookkeeping for one replica."""
+
+    def __init__(self, replica: Any) -> None:
+        self.replica = replica
+        self.healthy = True
+        self.consecutive_failures = 0
+        self.evicted_at = 0.0
+        self.routed = 0
+        self.failures = 0
+
+
+class ReplicaRouter:
+    """Load- and prefix-aware dispatch across serving replicas.
+
+    Duck-types the scheduler surface (``submit``/``stats``/``registry``/
+    ``engine``) so the HTTP server and load harness run unchanged with a
+    router in the scheduler seat.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        *,
+        registry: Any | None = None,
+        affinity_weight: float = 4.0,
+        max_affinity_entries: int = 4096,
+        fail_threshold: int = 3,
+        revive_sec: float = 10.0,
+        block_tokens: int | None = None,
+    ) -> None:
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.registry = registry
+        self.affinity_weight = float(affinity_weight)
+        self.max_affinity_entries = int(max_affinity_entries)
+        self.fail_threshold = int(fail_threshold)
+        self.revive_sec = float(revive_sec)
+        self._states = [_ReplicaState(r) for r in replicas]
+        if block_tokens is None:
+            block_tokens = 16
+            for r in replicas:
+                engine = getattr(r, "engine", None)
+                if engine is not None:
+                    block_tokens = engine.pool.block_tokens
+                    break
+        self.block_tokens = int(block_tokens)
+        self._lock = threading.Lock()
+        # chain hash -> replica index, LRU-capped: the router's model of
+        # WHERE each prefix block's K/V most recently landed.
+        self._affinity: OrderedDict[str, int] = OrderedDict()
+        self.requests_routed = 0
+        self.affinity_routed = 0  # placements decided by a prefix match
+        self.failovers = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def replicas(self) -> list[Any]:
+        return [s.replica for s in self._states]
+
+    @property
+    def policy(self) -> str:
+        """Scheduler-surface compat: what the serve ready line reports."""
+        return "router"
+
+    @property
+    def engine(self):
+        """First healthy in-process engine — the HTTP layer's admission
+        validator; None when the fleet is remote (each pod validates)."""
+        for s in self._states:
+            engine = getattr(s.replica, "engine", None)
+            if s.healthy and engine is not None:
+                return engine
+        return None
+
+    def _healthy_indices(self) -> list[int]:
+        now = time.monotonic()
+        out = []
+        for i, s in enumerate(self._states):
+            if not s.healthy and now - s.evicted_at >= self.revive_sec:
+                # Revival probe: one cheap healthcheck, not a request.
+                if s.replica.healthcheck():
+                    logger.info("router: replica %s revived", s.replica.name)
+                    s.healthy = True
+                    s.consecutive_failures = 0
+            if s.healthy:
+                out.append(i)
+        return out
+
+    def _note_failure(self, idx: int, exc: Exception) -> None:
+        s = self._states[idx]
+        s.failures += 1
+        s.consecutive_failures += 1
+        logger.warning(
+            "router: replica %s failure %d/%d: %s",
+            s.replica.name, s.consecutive_failures, self.fail_threshold, exc,
+        )
+        if s.consecutive_failures >= self.fail_threshold and s.healthy:
+            s.healthy = False
+            s.evicted_at = time.monotonic()
+            logger.warning("router: replica %s evicted", s.replica.name)
+
+    def _note_success(self, idx: int) -> None:
+        self._states[idx].consecutive_failures = 0
+
+    # ----------------------------------------------------------- placement
+
+    def _matched_blocks(self, hashes: list[str], idx: int) -> int:
+        run = 0
+        for h in hashes:
+            if self._affinity.get(h) != idx:
+                break
+            run += 1
+        return run
+
+    def _record_affinity(self, hashes: list[str], idx: int) -> None:
+        for h in hashes:
+            self._affinity[h] = idx
+            self._affinity.move_to_end(h)
+        while len(self._affinity) > self.max_affinity_entries:
+            self._affinity.popitem(last=False)
+
+    def select(self, prompt_ids: np.ndarray) -> int:
+        """Pick the replica index for a prompt (placement only, no
+        dispatch — exposed for tests and dry-runs). Raises RuntimeError
+        when every replica is evicted."""
+        healthy = self._healthy_indices()
+        if not healthy:
+            raise RuntimeError("router: no healthy replicas")
+        hashes = chain_hashes(
+            [int(t) for t in prompt_ids[: _MAX_AFFINITY_BLOCKS * self.block_tokens]],
+            self.block_tokens,
+        )
+        with self._lock:
+            scored = []
+            for i in healthy:
+                matched = self._matched_blocks(hashes, i) if hashes else 0
+                load = self._states[i].replica.load()
+                # Affinity wins until the preferred replica is
+                # ~affinity_weight*matched requests busier than a peer.
+                scored.append((self.affinity_weight * matched - load, matched, i))
+            score, matched, best = max(scored, key=lambda t: (t[0], -t[2]))
+            self._record_affinity(hashes, best)
+            self.requests_routed += 1
+            if matched > 0:
+                self.affinity_routed += 1
+            self._states[best].routed += 1
+        return best
+
+    # ------------------------------------------------------------ dispatch
+
+    def submit(self, req: ServeRequest) -> ServeRequest:
+        idx = self.select(req.prompt_ids)
+        replica = self._states[idx].replica
+        if isinstance(replica, HTTPReplica):
+            req.submitted_t = time.monotonic()
+            req.submitted_pc = time.perf_counter()
+            with replica._lock:
+                replica._inflight += 1
+            threading.Thread(
+                target=self._perform_http,
+                args=(req, idx),
+                daemon=True,
+            ).start()
+            return req
+        try:
+            replica.submit(req)
+            self._note_success(idx)
+        except Exception as exc:  # noqa: BLE001 — failover before erroring
+            self._note_failure(idx, exc)
+            return self._failover(req, exclude={idx}, cause=exc)
+        return req
+
+    def _perform_http(self, req: ServeRequest, idx: int) -> None:
+        replica = self._states[idx].replica
+        try:
+            replica.perform(req)
+            self._note_success(idx)
+        except Exception as exc:  # noqa: BLE001 — transport error: failover
+            self._note_failure(idx, exc)
+            try:
+                self._failover(req, exclude={idx}, cause=exc)
+            except Exception as exc2:  # noqa: BLE001 — out of replicas
+                req.error = str(exc2)
+                req.finish_reason = "error"
+                req.finished_t = time.monotonic()
+                req.done.set()
+
+    def _failover(
+        self, req: ServeRequest, *, exclude: set[int], cause: Exception
+    ) -> ServeRequest:
+        healthy = [i for i in self._healthy_indices() if i not in exclude]
+        if not healthy:
+            raise RuntimeError(
+                f"router: no healthy replica left for failover ({cause})"
+            )
+        idx = min(healthy, key=lambda i: self._states[i].replica.load())
+        with self._lock:
+            self.failovers += 1
+            self._states[idx].routed += 1
+        replica = self._states[idx].replica
+        logger.warning(
+            "router: failing request %d over to %s", req.request_id,
+            replica.name,
+        )
+        if isinstance(replica, HTTPReplica):
+            with replica._lock:
+                replica._inflight += 1
+            self._perform_http(req, idx)
+            return req
+        replica.submit(req)
+        self._note_success(idx)
+        return req
+
+    # ------------------------------------------------------------ hot swap
+
+    def rolling_reload(
+        self,
+        *,
+        params: Any | None = None,
+        step: int | None = None,
+        checkpoint: str | None = None,
+    ) -> list[dict[str, Any]]:
+        """Apply a checkpoint swap ONE replica at a time. Each replica's
+        own hot-swap contract (in-flight finishes on old params, new
+        admissions on new) makes the roll zero-downtime: at every moment
+        every replica is serving, some on the old checkpoint, some on
+        the new — exactly a k8s rolling update, without restarting
+        anything or dropping a request."""
+        results = []
+        for idx, s in enumerate(self._states):
+            if not s.healthy:
+                results.append(
+                    {"replica": s.replica.name, "skipped": "evicted"}
+                )
+                continue
+            try:
+                results.append(
+                    s.replica.reload(
+                        params=params, step=step, checkpoint=checkpoint
+                    )
+                )
+                self._note_success(idx)
+            except Exception as exc:  # noqa: BLE001 — roll on; report
+                self._note_failure(idx, exc)
+                results.append({"replica": s.replica.name, "error": str(exc)})
+        return results
+
+    # ----------------------------------------------------------- telemetry
+
+    def stats(self) -> dict[str, Any]:
+        """Fleet stats in the scheduler's shape (the load harness reads
+        occupancy/policy keys) + a ``router`` block with placement and
+        per-replica detail."""
+        per_replica = []
+        agg = {
+            "peak_batch_occupancy": 0,
+            "mean_batch_occupancy": 0.0,
+            "max_batch_slots": 0,
+            "queue_depth": 0,
+            "active_sequences": 0,
+            "requests_finished": 0,
+            "tokens_generated": 0,
+        }
+        policy = None
+        prefix_hits = prefix_queries = prefix_hit_queries = prefix_tokens = 0
+        for s in self._states:
+            rs = s.replica.stats() if s.healthy else {"evicted": True}
+            policy = policy or rs.get("policy")
+            for k in agg:
+                v = rs.get(k)
+                if isinstance(v, (int, float)):
+                    agg[k] += v
+            pool = rs.get("kv_pool", {})
+            prefix_hits += pool.get("prefix_hits", 0)
+            prefix_queries += pool.get("prefix_queries", 0)
+            prefix_hit_queries += pool.get("prefix_hit_queries", 0)
+            prefix_tokens += pool.get("prefix_tokens_reused", 0)
+            per_replica.append(
+                {
+                    "name": s.replica.name,
+                    "healthy": s.healthy,
+                    "routed": s.routed,
+                    "failures": s.failures,
+                    "load": s.replica.load() if s.healthy else None,
+                    "stats": rs,
+                }
+            )
+        out: dict[str, Any] = dict(agg)
+        out["policy"] = policy or "paged"
+        out["mean_batch_occupancy"] = round(agg["mean_batch_occupancy"], 4)
+        out["router"] = {
+            "replicas": per_replica,
+            "replicas_healthy": sum(1 for s in self._states if s.healthy),
+            "requests_routed": self.requests_routed,
+            "affinity_routed": self.affinity_routed,
+            "affinity_entries": len(self._affinity),
+            "failovers": self.failovers,
+            "affinity_weight": self.affinity_weight,
+            "fleet_prefix": {
+                "hits": prefix_hits,
+                "queries": prefix_queries,
+                "hit_queries": prefix_hit_queries,
+                "tokens_reused": prefix_tokens,
+                # hits counts reused BLOCKS (can exceed queries); the rate
+                # is the fraction of admissions that reused anything.
+                "hit_rate": round(prefix_hit_queries / max(1, prefix_queries), 4),
+            },
+        }
+        self._publish_metrics(out)
+        return out
+
+    def _publish_metrics(self, stats: dict[str, Any]) -> None:
+        if self.registry is None:
+            return
+        r = stats["router"]
+        gauges = {
+            "router/replicas_healthy": float(r["replicas_healthy"]),
+            "router/requests_routed": float(r["requests_routed"]),
+            "router/affinity_routed": float(r["affinity_routed"]),
+            "router/affinity_entries": float(r["affinity_entries"]),
+            "router/failovers": float(r["failovers"]),
+            "router/fleet_prefix_hit_rate": float(
+                r["fleet_prefix"]["hit_rate"]
+            ),
+            "router/queue_depth": float(stats["queue_depth"]),
+            "router/active_sequences": float(stats["active_sequences"]),
+        }
+        for i, rep in enumerate(r["replicas"]):
+            gauges[f"router/replica{i}_healthy"] = float(bool(rep["healthy"]))
+            gauges[f"router/replica{i}_routed"] = float(rep["routed"])
+            if rep["load"] is not None:
+                gauges[f"router/replica{i}_load"] = float(rep["load"])
+            occ = rep["stats"].get("active_sequences")
+            if isinstance(occ, (int, float)):
+                gauges[f"router/replica{i}_active_sequences"] = float(occ)
+        self.registry.publish(gauges)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self, timeout: float = 30.0) -> None:
+        for s in self._states:
+            try:
+                s.replica.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    def start(self) -> "ReplicaRouter":
+        """Scheduler-API compat: in-process replicas are started by their
+        builder; remote ones are already running."""
+        return self
+
+
+def resolve_backends(discover: str) -> list[str]:
+    """DNS-resolve ``host:port`` into one base URL per A record — the
+    k8s headless-Service discovery path (the Service name resolves to
+    every ready pod IP). Falls back to the literal host on resolver
+    failure, so a plain hostname keeps working."""
+    import socket
+
+    host, _, port = discover.partition(":")
+    port = port or "8000"
+    try:
+        infos = socket.getaddrinfo(host, int(port), proto=socket.IPPROTO_TCP)
+        addrs = sorted({info[4][0] for info in infos})
+    except OSError:
+        addrs = [host]
+    return [f"http://{a}:{port}" for a in addrs]
+
+
+__all__ = [
+    "HTTPReplica",
+    "InProcessReplica",
+    "ReplicaRouter",
+    "resolve_backends",
+]

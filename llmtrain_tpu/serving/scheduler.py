@@ -11,15 +11,38 @@ multi-tenant device (MinT, PAPERS.md): a long generation no longer
 blocks a short one behind it, and batch occupancy — not queue discipline
 — sets throughput.
 
+Scheduler-level policies layered on the paged path:
+
+* **Shared-prefix reuse** — at admission the prompt is looked up in the
+  pool's content-addressed prefix cache (paged_kv.py); matched blocks
+  bind read-only (COW on a partial match) and prefill runs only the
+  unmatched SUFFIX at its true offset. After the prompt is fully
+  written, its full blocks are registered for later requests.
+* **Chunked prefill** — with ``engine.prefill_chunk > 0``, long prompts
+  stream into the pool one chunk per step, interleaved with the decode
+  batch, so a huge prompt cannot stall every in-flight sequence's next
+  token. Chunks pad into the EXISTING prompt buckets (engine contract),
+  so the compile budget does not grow.
+* **Checkpoint hot-swap** — :meth:`hot_swap` queues new params; the
+  scheduler thread applies them between steps. Every request is pinned
+  at admission to its **param epoch**: in-flight sequences finish on the
+  params they were admitted under (decode runs grouped by epoch — params
+  is a traced argument, so no recompile), new admissions use the new
+  ones, and the prefix cache is invalidated (cached K/V is a function of
+  the old params). Zero requests fail or restart across a swap.
+
 Policies:
 
 * ``paged`` (default) — the continuous-batching path above.
-* ``speculative`` — draft-and-verify decode (speculative.py) as a
-  first-class scheduler policy: requests flow through the SAME queue,
-  metrics, and SLO accounting, but each is served by
-  ``speculative_generate`` (batch-1 by that algorithm's contract, so
-  occupancy stays 1 — the latency-optimal regime, while ``paged`` is the
-  throughput-optimal one).
+* ``speculative`` — draft-and-verify decode as a first-class scheduler
+  policy. With a ``draft_engine`` attached, greedy requests are drafted
+  and verified IN BATCH: gamma draft tokens per row come from batched
+  one-token decodes on the draft engine, and the target scores every
+  row's (gamma+1)-token slab in ONE bucketed ``verify`` call — emitted
+  tokens are bit-identical to ``generate()`` (greedy acceptance keeps a
+  draft only when it equals the target argmax). Sampled requests fall
+  back to the batch-1 ``speculative_generate`` path (its per-token rng
+  schedule is not batch-replayable).
 
 SLO accounting is server-side and per-request: submit→first-token (TTFT)
 and inter-token gaps, the numbers the load harness (loadgen.py)
@@ -71,6 +94,9 @@ class ServeRequest:
     tokens: list[int] = field(default_factory=list)
     finish_reason: str | None = None
     error: str | None = None
+    # Checkpoint step of the params this request was ADMITTED under
+    # (hot-swap audit trail: parity must check against these params).
+    params_step: int | None = None
     done: threading.Event = field(default_factory=threading.Event)
     # Set by a waiter that gave up (HTTP timeout, loadgen deadline): the
     # scheduler sheds the request — queued or in flight — instead of
@@ -100,6 +126,14 @@ class _Row:
     req: ServeRequest
     table: Any  # BlockTable
     prompt_len: int
+    # Prompt tokens whose K/V is already in the pool (cached prefix +
+    # prefilled chunks); == prompt_len once the first token can sample.
+    prefilled: int = 0
+    # Param epoch pinned at admission: the row decodes on these params
+    # until it retires, whatever hot_swap() does meanwhile.
+    epoch: int = 0
+    # Batched speculative only: the row's table on the DRAFT engine pool.
+    draft_table: Any = None
 
 
 class ContinuousBatchingScheduler:
@@ -116,6 +150,7 @@ class ContinuousBatchingScheduler:
         params: Any | None = None,
         draft_model: Any | None = None,
         draft_params: Any | None = None,
+        draft_engine: PagedDecodeEngine | None = None,
         gamma: int = 4,
         timeline: Any | None = None,  # telemetry EventTimeline
     ) -> None:
@@ -134,6 +169,18 @@ class ContinuousBatchingScheduler:
                 "policy='speculative' requires model/params AND "
                 "draft_model/draft_params"
             )
+        if draft_engine is not None and policy != "speculative":
+            raise ValueError("draft_engine only applies to policy='speculative'")
+        if draft_engine is not None and engine is None:
+            raise ValueError(
+                "batched speculative serving needs the TARGET PagedDecodeEngine "
+                "too (draft_engine alone cannot verify)"
+            )
+        if policy == "speculative" and engine is not None and engine.prefill_chunk:
+            raise ValueError(
+                "chunked prefill is a paged-policy feature; the speculative "
+                "verify slab needs the whole prompt resident before drafting"
+            )
         self.engine = engine
         self.policy = policy
         self.registry = registry
@@ -147,22 +194,44 @@ class ContinuousBatchingScheduler:
         )
         self._model, self._params = model, params
         self._draft_model, self._draft_params = draft_model, draft_params
+        self._draft_engine = draft_engine
         self._gamma = int(gamma)
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queue: deque[ServeRequest] = deque()
         self._active: list[_Row] = []
+        # Rows still streaming their prompt in under chunked prefill —
+        # they hold a batch slot (their KV is resident) but don't decode.
+        self._prefilling: list[_Row] = []
         self._closed = False
         self._thread: threading.Thread | None = None
+
+        # Param epochs (checkpoint hot-swap). Epoch 0 is the params the
+        # scheduler was built with; hot_swap() appends. Old epochs stay
+        # resident only while a row admitted under them is in flight.
+        self._param_epoch = 0
+        self._params_by_epoch: dict[int, Any] = {
+            0: engine.params if engine is not None else params
+        }
+        self._param_meta: dict[int, dict[str, Any]] = {
+            0: {"step": None, "checkpoint": None}
+        }
+        self._epoch_refs: dict[int, int] = {}
+        self._pending_swap: tuple[Any, int | None, str | None] | None = None
+        self.hot_swaps = 0
 
         # Aggregate accounting (scheduler thread only).
         self.requests_finished = 0
         self.tokens_generated = 0
-        self.prefill_tokens = 0
+        self.prefill_tokens = 0  # tokens actually COMPUTED (reuse excluded)
         self.peak_occupancy = 0
         self._occupancy_samples = 0
         self._occupancy_total = 0
+        # Batched speculative accounting.
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     # ----------------------------------------------------------- frontend
 
@@ -176,6 +245,24 @@ class ContinuousBatchingScheduler:
             self._queue.append(req)
             self._wake.notify()
         return req
+
+    def hot_swap(
+        self,
+        params: Any,
+        *,
+        step: int | None = None,
+        checkpoint: str | None = None,
+    ) -> None:
+        """Queue a zero-downtime params swap (thread-safe); the scheduler
+        thread applies it BETWEEN steps. In-flight sequences finish on
+        the params they were admitted under (per-row epoch pinning);
+        admissions after the swap use the new ones; the prefix cache is
+        invalidated. No request fails or restarts."""
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._pending_swap = (params, step, checkpoint)
+            self._wake.notify()
 
     # ------------------------------------------------------------- backend
 
@@ -199,22 +286,183 @@ class ContinuousBatchingScheduler:
             request_id=req.request_id,
         )
 
+    # -------------------------------------------------------- param epochs
+
+    def _apply_pending_swap(self) -> bool:
+        with self._lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return False
+        params, step, checkpoint = pending
+        self._param_epoch += 1
+        self._params_by_epoch[self._param_epoch] = params
+        self._param_meta[self._param_epoch] = {
+            "step": step, "checkpoint": checkpoint
+        }
+        # Legacy (batch-1) speculative serves new admissions on the new
+        # params too; its in-flight unit is one whole request, so the
+        # epoch pin is trivially the pop.
+        self._params = params
+        if self.engine is not None:
+            self.engine.set_params(params)
+            flushed = self.engine.pool.invalidate_prefix_cache()
+            if flushed:
+                logger.info(
+                    "serve: hot-swap invalidated %d cached prefix blocks",
+                    flushed,
+                )
+        self.hot_swaps += 1
+        self._gc_epochs()
+        logger.info(
+            "serve: hot-swapped params to step %s (epoch %d, %d in flight "
+            "pinned to older epochs)",
+            step,
+            self._param_epoch,
+            sum(self._epoch_refs.values()),
+        )
+        return True
+
+    def _pin_epoch(self, epoch: int) -> None:
+        self._epoch_refs[epoch] = self._epoch_refs.get(epoch, 0) + 1
+
+    def _unpin_epoch(self, epoch: int) -> None:
+        n = self._epoch_refs.get(epoch, 0) - 1
+        if n <= 0:
+            self._epoch_refs.pop(epoch, None)
+        else:
+            self._epoch_refs[epoch] = n
+        self._gc_epochs()
+
+    def _gc_epochs(self) -> None:
+        """Drop superseded params once their last pinned row retires —
+        a swap must not double resident param memory forever."""
+        for ep in [
+            e
+            for e in self._params_by_epoch
+            if e != self._param_epoch and self._epoch_refs.get(e, 0) == 0
+        ]:
+            del self._params_by_epoch[ep]
+            self._param_meta.pop(ep, None)
+
+    # ------------------------------------------------------------ stepping
+
     def step(self) -> bool:
         """One scheduler iteration: join, advance, evict. Returns whether
         any work happened (False = idle)."""
+        swapped = self._apply_pending_swap()
         if self.policy == "speculative":
-            return self._step_speculative()
-        return self._step_paged()
+            return self._step_speculative() or swapped
+        return self._step_paged() or swapped
+
+    def _admit_paged(self, req: ServeRequest, overshoot: int = 0) -> _Row | None:
+        """Reserve + prefix-bind one popped request (paged path). Returns
+        the row (epoch pinned, prefix bound, COW issued) or None when the
+        pool is full — the caller re-queues. Raises nothing; COW device
+        failures are handled by the caller's prefill error path because
+        the copy is issued lazily with the first slab."""
+        engine = self.engine
+        assert engine is not None
+        tp = int(req.prompt_ids.shape[0])
+        total = tp + int(req.max_new_tokens) + int(overshoot)
+        table = engine.pool.try_reserve(total)
+        if table is None:
+            return None
+        row = _Row(req=req, table=table, prompt_len=tp, epoch=self._param_epoch)
+        req.params_step = self._param_meta[row.epoch].get("step")
+        self._pin_epoch(row.epoch)
+        return row
+
+    def _prefill_next(self, row: _Row, *, limit: int | None = None) -> bool:
+        """Prefill the row's next prompt slab (everything remaining, or at
+        most ``limit`` tokens under chunked prefill) at its true offset.
+        The FINAL slab samples the first output token, registers the
+        prompt's full blocks in the prefix cache, and stamps TTFT; the
+        sampled token of a non-final chunk is discarded (same compiled
+        program either way). On failure the row is failed and released —
+        and if the donated cache was consumed, every in-flight row goes
+        with it. Returns success."""
+        engine = self.engine
+        assert engine is not None
+        before = engine.cache_epoch
+        start = row.prefilled
+        end = (
+            row.prompt_len
+            if limit is None
+            else min(row.prompt_len, start + int(limit))
+        )
+        slab = row.req.prompt_ids[start:end]
+        final = end == row.prompt_len
+        engine.pool.grow(row.table, end)
+        try:
+            with self._span(
+                "serve/prefill",
+                request_id=row.req.request_id,
+                prompt_tokens=end - start,
+                offset=start,
+            ):
+                tok = engine.prefill(
+                    slab,
+                    row.table.padded(engine.max_blocks_per_seq),
+                    seed=row.req.seed,
+                    temperature=row.req.temperature,
+                    top_k=row.req.top_k,
+                    top_p=row.req.top_p,
+                    offset=start,
+                    params=self._params_by_epoch[row.epoch],
+                )
+        except Exception as exc:  # noqa: BLE001 — fail THIS request only
+            self._drop_row(row)
+            self._fail(row.req, exc)
+            if engine.cache_epoch != before:
+                # The failed call had already consumed the donated cache:
+                # every in-flight sequence's KV went with it.
+                self._fail_all_in_flight(exc)
+            return False
+        row.prefilled = end
+        self.prefill_tokens += end - start
+        if final:
+            if row.epoch == self._param_epoch:
+                # Publish only CURRENT-epoch K/V: a row that straddled a
+                # hot swap finished prefilling under superseded params,
+                # and registering its blocks would hand stale cache to
+                # post-swap admissions (their parity would break).
+                engine.pool.register_prefix(row.table, row.req.prompt_ids)
+            now = time.monotonic()
+            row.req.first_token_t = now
+            row.req.token_times.append(now)
+            row.req.tokens.append(tok)
+            self.tokens_generated += 1
+        return True
+
+    def _finish_or_activate(self, row: _Row) -> None:
+        if self._is_finished(row):
+            self._retire(row)
+        else:
+            self._active.append(row)
+
+    def _shed_abandoned_in_flight(self) -> None:
+        """Shed abandoned in-flight work (the waiter already got its
+        timeout response) so the device never decodes for a gone client."""
+        for rows in (self._active, self._prefilling):
+            kept: list[_Row] = []
+            for r in rows:
+                if r.req.abandoned.is_set():
+                    self._drop_row(r)
+                    self._retire_abandoned(r.req)
+                else:
+                    kept.append(r)
+            rows[:] = kept
 
     def _step_paged(self) -> bool:
         engine = self.engine
         assert engine is not None
         epoch = engine.cache_epoch
+        chunk = engine.prefill_chunk
         # ---- join: admit while a slot AND a worst-case block budget exist.
         # Head-of-line order — admission is FIFO so a huge request cannot
         # be starved by a stream of small ones slipping past it.
         admitted = 0
-        while len(self._active) < self.max_batch_slots:
+        while len(self._active) + len(self._prefilling) < self.max_batch_slots:
             with self._lock:
                 req = self._queue[0] if self._queue else None
             if req is None:
@@ -237,133 +485,144 @@ class ContinuousBatchingScheduler:
                     self._queue.popleft()
                 self._fail(req, ValueError(reason))
                 continue
-            total = int(req.prompt_ids.shape[0]) + int(req.max_new_tokens)
-            table = engine.pool.try_reserve(total)
-            if table is None:
+            row = self._admit_paged(req)
+            if row is None:
                 break  # pool full: stays queued, retried next step
             with self._lock:
                 self._queue.popleft()
-            tp = int(req.prompt_ids.shape[0])
-            engine.pool.grow(table, tp)
+            # Shared-prefix reuse: bind cached blocks read-only BEFORE any
+            # grow; prefill then runs only the unmatched suffix. A partial
+            # block match needs a private copy (COW) before its divergent
+            # tail is written.
+            match = engine.pool.match_prefix(req.prompt_ids)
+            if match.hit:
+                engine.pool.bind_prefix(row.table, match)
+                row.prefilled = match.matched_tokens
+                if match.partial_block is not None:
+                    src, dst = engine.pool.cow_last_shared(row.table)
+                    try:
+                        engine.cow_copy(src, dst)
+                    except Exception as exc:  # noqa: BLE001 — contain
+                        self._drop_row(row)
+                        self._fail(req, exc)
+                        if engine.cache_epoch != epoch:
+                            self._fail_all_in_flight(exc)
+                            epoch = engine.cache_epoch
+                        continue
             self._record_queue_wait(req)
-            try:
-                with self._span(
-                    "serve/prefill", request_id=req.request_id, prompt_tokens=tp
-                ):
-                    tok = engine.prefill(
-                        req.prompt_ids,
-                        table.padded(engine.max_blocks_per_seq),
-                        seed=req.seed,
-                        temperature=req.temperature,
-                        top_k=req.top_k,
-                        top_p=req.top_p,
-                    )
-            except Exception as exc:  # noqa: BLE001 — fail THIS request only
-                engine.pool.release(table)
-                self._fail(req, exc)
-                if engine.cache_epoch != epoch:
-                    # The failed call had already consumed the donated
-                    # cache: every in-flight sequence's KV went with it.
-                    self._fail_all_active(exc)
-                    epoch = engine.cache_epoch
+            if chunk and (row.prompt_len - row.prefilled) > chunk:
+                # Chunked prefill: the prompt streams in one chunk per
+                # step (below), interleaved with decode.
+                self._prefilling.append(row)
+                admitted += 1
                 continue
-            now = time.monotonic()
-            req.first_token_t = now
-            req.token_times.append(now)
-            req.tokens.append(tok)
-            self.prefill_tokens += tp
-            self.tokens_generated += 1
-            row = _Row(req=req, table=table, prompt_len=tp)
-            if self._is_finished(row):
-                self._retire(row)
-            else:
-                self._active.append(row)
+            if not self._prefill_next(row):
+                epoch = engine.cache_epoch
+                continue
+            self._finish_or_activate(row)
             admitted += 1
 
-        # ---- shed abandoned in-flight work (the waiter already got its
-        # timeout response) so the device never decodes for a gone client.
-        kept: list[_Row] = []
-        for r in self._active:
-            if r.req.abandoned.is_set():
-                engine.pool.release(r.table)
-                self._retire_abandoned(r.req)
-            else:
-                kept.append(r)
-        self._active = kept
+        self._shed_abandoned_in_flight()
 
-        # ---- advance every in-flight sequence one token.
+        # ---- advance chunked prefills: ONE chunk per step, head-of-line,
+        # so prompt streaming shares the device fairly with decode.
+        chunked = False
+        if self._prefilling:
+            row = self._prefilling.pop(0)
+            if self._prefill_next(row, limit=chunk):
+                if row.prefilled == row.prompt_len:
+                    self._finish_or_activate(row)
+                else:
+                    self._prefilling.insert(0, row)
+            else:
+                epoch = engine.cache_epoch
+            chunked = True
+
+        # ---- advance every in-flight sequence one token, grouped by the
+        # param epoch each row was ADMITTED under (hot-swap pinning).
+        # Params is a traced argument, so the groups share one compiled
+        # program per batch bucket.
         stepped = False
         if self._active:
             occupancy = len(self._active)
             self.peak_occupancy = max(self.peak_occupancy, occupancy)
             self._occupancy_samples += 1
             self._occupancy_total += occupancy
-            rows = []
+            by_epoch: dict[int, list[_Row]] = {}
             for r in self._active:
-                # The fed token's absolute position; grow() binds its
-                # block within the admission-time reservation.
-                pos = r.prompt_len + len(r.req.tokens) - 1
-                engine.pool.grow(r.table, pos + 1)
-                rows.append(
-                    {
-                        "token": r.req.tokens[-1],
-                        "position": pos,
-                        "table": r.table.padded(engine.max_blocks_per_seq),
-                        "seed": r.req.seed,
-                        "emit_idx": len(r.req.tokens),
-                        "temperature": r.req.temperature,
-                        "top_k": 0 if r.req.top_k is None else r.req.top_k,
-                        "top_p": 0.0 if r.req.top_p is None else r.req.top_p,
-                    }
-                )
-            try:
-                with self._span(
-                    "serve/decode",
-                    request_ids=[r.req.request_id for r in self._active],
-                    batch=len(rows),
-                ):
-                    toks = engine.decode(rows)
-            except Exception as exc:  # noqa: BLE001 — contain: a decode
-                # failure must not kill the scheduler thread (every later
-                # waiter would time out against a dead loop). The batch's
-                # step output is unusable either way, so each in-flight
-                # request fails loudly — and if the donated cache was
-                # consumed the engine has already rebuilt it zeroed.
-                self._fail_all_active(exc)
-                self._publish_metrics()
-                return True
-            now = time.monotonic()
+                by_epoch.setdefault(r.epoch, []).append(r)
+            epochs = sorted(by_epoch)
             survivors: list[_Row] = []
-            for r, tok in zip(self._active, toks):
-                r.req.tokens.append(int(tok))
-                r.req.token_times.append(now)
-                self.tokens_generated += 1
-                if self._is_finished(r):
-                    self._retire(r)
-                else:
-                    survivors.append(r)
+            for gi, ep in enumerate(epochs):
+                group = by_epoch[ep]
+                rows = []
+                for r in group:
+                    # The fed token's absolute position; grow() binds its
+                    # block within the admission-time reservation.
+                    pos = r.prompt_len + len(r.req.tokens) - 1
+                    engine.pool.grow(r.table, pos + 1)
+                    rows.append(
+                        {
+                            "token": r.req.tokens[-1],
+                            "position": pos,
+                            "table": r.table.padded(engine.max_blocks_per_seq),
+                            "seed": r.req.seed,
+                            "emit_idx": len(r.req.tokens),
+                            "temperature": r.req.temperature,
+                            "top_k": 0 if r.req.top_k is None else r.req.top_k,
+                            "top_p": 0.0 if r.req.top_p is None else r.req.top_p,
+                        }
+                    )
+                try:
+                    with self._span(
+                        "serve/decode",
+                        request_ids=[r.req.request_id for r in group],
+                        batch=len(rows),
+                        param_epoch=ep,
+                    ):
+                        toks = engine.decode(
+                            rows, params=self._params_by_epoch[ep]
+                        )
+                except Exception as exc:  # noqa: BLE001 — contain: a decode
+                    # failure must not kill the scheduler thread (every
+                    # later waiter would time out against a dead loop). The
+                    # step output is unusable either way, so each in-flight
+                    # request fails loudly — and if the donated cache was
+                    # consumed the engine has already rebuilt it zeroed.
+                    self._active = survivors + [
+                        r for e2 in epochs[gi:] for r in by_epoch[e2]
+                    ]
+                    self._fail_all_in_flight(exc)
+                    self._publish_metrics()
+                    return True
+                now = time.monotonic()
+                for r, tok in zip(group, toks):
+                    r.req.tokens.append(int(tok))
+                    r.req.token_times.append(now)
+                    self.tokens_generated += 1
+                    if self._is_finished(r):
+                        self._retire(r)
+                    else:
+                        survivors.append(r)
             self._active = survivors
             stepped = True
 
         self._publish_metrics()
-        return stepped or admitted > 0
+        return stepped or chunked or admitted > 0
+
+    # -------------------------------------------------------- speculative
 
     def _step_speculative(self) -> bool:
+        if self._draft_engine is not None:
+            return self._step_speculative_batched()
+        return self._step_speculative_one()
+
+    def _serve_speculative_single(self, req: ServeRequest) -> None:
+        """Serve one request end-to-end via ``speculative_generate``
+        (batch-1 by that algorithm's contract)."""
         from ..speculative import speculative_generate
 
-        with self._lock:
-            req = self._queue.popleft() if self._queue else None
-        if req is None:
-            self._publish_metrics()
-            return False
-        if req.abandoned.is_set():
-            self._retire_abandoned(req)
-            self._publish_metrics()
-            return True
-        self.peak_occupancy = max(self.peak_occupancy, 1)
-        self._occupancy_samples += 1
-        self._occupancy_total += 1
-        self._record_queue_wait(req)
+        req.params_step = self._param_meta[self._param_epoch].get("step")
         try:
             with self._span(
                 "serve/speculative_decode", request_id=req.request_id
@@ -384,8 +643,7 @@ class ContinuousBatchingScheduler:
                 )
         except Exception as exc:  # noqa: BLE001 — fail THIS request only
             self._fail(req, exc)
-            self._publish_metrics()
-            return True
+            return
         now = time.monotonic()
         completion = [int(t) for t in out[0, req.prompt_ids.shape[0] :]]
         if req.eos_token_id is not None and req.eos_token_id in completion:
@@ -405,8 +663,238 @@ class ContinuousBatchingScheduler:
         if self.registry is not None:
             self.registry.inc("serve/requests")
         req.done.set()
+
+    def _step_speculative_one(self) -> bool:
+        with self._lock:
+            req = self._queue.popleft() if self._queue else None
+        if req is None:
+            self._publish_metrics()
+            return False
+        if req.abandoned.is_set():
+            self._retire_abandoned(req)
+            self._publish_metrics()
+            return True
+        self.peak_occupancy = max(self.peak_occupancy, 1)
+        self._occupancy_samples += 1
+        self._occupancy_total += 1
+        self._record_queue_wait(req)
+        self._serve_speculative_single(req)
         self._publish_metrics()
         return True
+
+    def _step_speculative_batched(self) -> bool:
+        """Draft-and-verify for EVERY in-flight greedy sequence per step:
+        gamma+1 batched one-token decodes on the draft engine (the +1
+        re-feeds the last draft so its K/V lands before the next round),
+        then ONE bucketed target ``verify`` per param epoch. Greedy
+        acceptance — keep draft j only while it equals the target argmax
+        given drafts < j — makes the emitted stream bit-identical to
+        ``generate()`` on the admitted params."""
+        engine, draft = self.engine, self._draft_engine
+        assert engine is not None and draft is not None
+        gamma = self._gamma
+        epoch_guard = engine.cache_epoch
+        admitted = 0
+        while len(self._active) < self.max_batch_slots:
+            with self._lock:
+                req = self._queue[0] if self._queue else None
+            if req is None:
+                break
+            if req.abandoned.is_set():
+                with self._lock:
+                    self._queue.popleft()
+                self._retire_abandoned(req)
+                continue
+            if req.temperature > 0.0:
+                # Sampled: categorical draws aren't replayable across the
+                # batched slab; serve batch-1 (same results as before).
+                with self._lock:
+                    self._queue.popleft()
+                self._record_queue_wait(req)
+                self._serve_speculative_single(req)
+                admitted += 1
+                continue
+            tp = int(req.prompt_ids.shape[0])
+            need = int(req.max_new_tokens) + gamma  # verify overshoots by γ
+            reason = engine.validate_request(tp, need) or draft.validate_request(
+                tp, need
+            )
+            if reason is not None:
+                with self._lock:
+                    self._queue.popleft()
+                self._fail(req, ValueError(reason))
+                continue
+            row = self._admit_paged(req, overshoot=gamma)
+            if row is None:
+                break
+            row.draft_table = draft.pool.try_reserve(tp + need)
+            if row.draft_table is None:
+                engine.pool.release(row.table)
+                self._unpin_epoch(row.epoch)
+                break
+            with self._lock:
+                self._queue.popleft()
+            engine.pool.grow(row.table, tp)
+            draft.pool.grow(row.draft_table, tp)
+            self._record_queue_wait(req)
+            try:
+                with self._span(
+                    "serve/prefill", request_id=req.request_id, prompt_tokens=tp
+                ):
+                    tok = engine.prefill(
+                        req.prompt_ids,
+                        row.table.padded(engine.max_blocks_per_seq),
+                        seed=req.seed,
+                        temperature=req.temperature,
+                        top_k=req.top_k,
+                        top_p=req.top_p,
+                        params=self._params_by_epoch[row.epoch],
+                    )
+                    # Draft prefill: its sampled token is discarded; the
+                    # call exists to write the prompt's DRAFT K/V.
+                    draft.prefill(
+                        req.prompt_ids,
+                        row.draft_table.padded(draft.max_blocks_per_seq),
+                        seed=req.seed,
+                        temperature=0.0,
+                        top_k=None,
+                        top_p=None,
+                    )
+            except Exception as exc:  # noqa: BLE001 — fail THIS request only
+                self._drop_row(row)
+                self._fail(req, exc)
+                if engine.cache_epoch != epoch_guard:
+                    self._fail_all_in_flight(exc)
+                    epoch_guard = engine.cache_epoch
+                continue
+            now = time.monotonic()
+            req.first_token_t = now
+            req.token_times.append(now)
+            req.tokens.append(tok)
+            self.prefill_tokens += tp
+            self.tokens_generated += 1
+            self._finish_or_activate(row)
+            admitted += 1
+
+        self._shed_abandoned_in_flight()
+
+        stepped = False
+        if self._active:
+            occupancy = len(self._active)
+            self.peak_occupancy = max(self.peak_occupancy, occupancy)
+            self._occupancy_samples += 1
+            self._occupancy_total += occupancy
+            # ---- draft γ tokens per row, batched across rows; round γ
+            # re-feeds the final draft so its K/V is resident next step.
+            rows_now = list(self._active)
+            drafts: list[list[int]] = [[] for _ in rows_now]
+            prev = [r.req.tokens[-1] for r in rows_now]
+            base = [r.prompt_len + len(r.req.tokens) - 1 for r in rows_now]
+            try:
+                with self._span(
+                    "serve/speculative_draft",
+                    batch=len(rows_now),
+                    gamma=gamma,
+                ):
+                    for j in range(gamma + 1):
+                        drows = []
+                        for i, r in enumerate(rows_now):
+                            pos = base[i] + j
+                            draft.pool.grow(r.draft_table, pos + 1)
+                            drows.append(
+                                {
+                                    "token": prev[i],
+                                    "position": pos,
+                                    "table": r.draft_table.padded(
+                                        draft.max_blocks_per_seq
+                                    ),
+                                    "seed": 0,
+                                    "emit_idx": 0,
+                                    "temperature": 0.0,
+                                    "top_k": 0,
+                                    "top_p": 0.0,
+                                }
+                            )
+                        out = draft.decode(drows)
+                        if j < gamma:
+                            for i, t in enumerate(out):
+                                drafts[i].append(int(t))
+                            prev = [int(t) for t in out]
+            except Exception as exc:  # noqa: BLE001 — drafts unusable
+                self._fail_all_in_flight(exc)
+                self._publish_metrics()
+                return True
+            # ---- one bucketed verify per param epoch.
+            by_epoch: dict[int, list[int]] = {}
+            for i, r in enumerate(rows_now):
+                by_epoch.setdefault(r.epoch, []).append(i)
+            epochs = sorted(by_epoch)
+            survivors: list[_Row] = []
+            for gi, ep in enumerate(epochs):
+                idxs = by_epoch[ep]
+                vrows = []
+                for i in idxs:
+                    r = rows_now[i]
+                    engine.pool.grow(r.table, base[i] + gamma + 1)
+                    vrows.append(
+                        {
+                            "tokens": [r.req.tokens[-1]] + drafts[i],
+                            "position": base[i],
+                            "table": r.table.padded(engine.max_blocks_per_seq),
+                        }
+                    )
+                try:
+                    with self._span(
+                        "serve/speculative_verify",
+                        batch=len(vrows),
+                        width=gamma + 1,
+                        param_epoch=ep,
+                    ):
+                        outs = engine.verify(
+                            vrows,
+                            width=gamma + 1,
+                            params=self._params_by_epoch[ep],
+                        )
+                except Exception as exc:  # noqa: BLE001 — contain
+                    self._active = survivors + [
+                        rows_now[i] for e2 in epochs[gi:] for i in by_epoch[e2]
+                    ]
+                    self._fail_all_in_flight(exc)
+                    self._publish_metrics()
+                    return True
+                now = time.monotonic()
+                for i, a in zip(idxs, outs):
+                    r, d = rows_now[i], drafts[i]
+                    self.spec_rounds += 1
+                    self.spec_drafted += gamma
+                    # a[j] = target argmax given drafts < j: emit a[0],
+                    # then keep extending while the draft guessed it.
+                    emitted = [a[0]]
+                    acc = 0
+                    while acc < gamma and d[acc] == a[acc]:
+                        emitted.append(a[acc + 1])
+                        acc += 1
+                    self.spec_accepted += acc
+                    for t in emitted:
+                        if len(r.req.tokens) >= r.req.max_new_tokens:
+                            break
+                        r.req.tokens.append(int(t))
+                        r.req.token_times.append(now)
+                        self.tokens_generated += 1
+                        if (
+                            r.req.eos_token_id is not None
+                            and int(t) == r.req.eos_token_id
+                        ):
+                            break
+                    if self._is_finished(r):
+                        self._retire(r)
+                    else:
+                        survivors.append(r)
+            self._active = survivors
+            stepped = True
+
+        self._publish_metrics()
+        return stepped or admitted > 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -420,9 +908,16 @@ class ContinuousBatchingScheduler:
             return True
         return False
 
-    def _retire(self, row: _Row) -> None:
+    def _drop_row(self, row: _Row) -> None:
+        """Return a row's pool resources + epoch pin (no req bookkeeping)."""
         assert self.engine is not None
         self.engine.pool.release(row.table)
+        if row.draft_table is not None and self._draft_engine is not None:
+            self._draft_engine.pool.release(row.draft_table)
+        self._unpin_epoch(row.epoch)
+
+    def _retire(self, row: _Row) -> None:
+        self._drop_row(row)
         row.req.finished_t = time.monotonic()
         self.requests_finished += 1
         if self.registry is not None:
@@ -439,10 +934,9 @@ class ContinuousBatchingScheduler:
             self.registry.inc("serve/requests_abandoned")
         req.done.set()
 
-    def _fail_all_active(self, cause: Exception) -> None:
-        assert self.engine is not None
-        for r in self._active:
-            self.engine.pool.release(r.table)
+    def _fail_all_in_flight(self, cause: Exception) -> None:
+        for r in self._active + self._prefilling:
+            self._drop_row(r)
             self._fail(
                 r.req,
                 RuntimeError(
@@ -450,6 +944,7 @@ class ContinuousBatchingScheduler:
                 ),
             )
         self._active = []
+        self._prefilling = []
 
     def _fail(self, req: ServeRequest, exc: Exception) -> None:
         logger.warning("serve request %d failed: %s", req.request_id, exc)
@@ -470,12 +965,23 @@ class ContinuousBatchingScheduler:
             "serve/batch_occupancy": float(len(self._active)),
             "serve/peak_batch_occupancy": float(self.peak_occupancy),
             "serve/tokens_generated": float(self.tokens_generated),
+            "serve/hot_swaps": float(self.hot_swaps),
         }
         if self.engine is not None:
             pool = self.engine.pool.stats()
             metrics["serve/kv_pool_used_blocks"] = pool["allocated_blocks"]
             metrics["serve/kv_pool_utilization"] = pool["utilization"]
             metrics["serve/kv_pool_reserved_blocks"] = pool["reserved_blocks"]
+            if "prefix_hit_rate" in pool:
+                metrics["serve/prefix_hits"] = pool["prefix_hits"]
+                metrics["serve/prefix_hit_rate"] = pool["prefix_hit_rate"]
+                metrics["serve/prefix_tokens_reused"] = pool[
+                    "prefix_tokens_reused"
+                ]
+        if self._draft_engine is not None and self.spec_drafted:
+            metrics["serve/spec_acceptance_rate"] = round(
+                self.spec_accepted / self.spec_drafted, 4
+            )
         self.registry.publish(metrics)
 
     # ----------------------------------------------------------- lifecycle
@@ -492,6 +998,7 @@ class ContinuousBatchingScheduler:
             "policy": self.policy,
             "queue_depth": depth,
             "active_sequences": len(self._active),
+            "prefilling_sequences": len(self._prefilling),
             "max_batch_slots": self.max_batch_slots,
             "requests_finished": self.requests_finished,
             "tokens_generated": self.tokens_generated,
@@ -499,20 +1006,62 @@ class ContinuousBatchingScheduler:
             "peak_batch_occupancy": self.peak_occupancy,
             "mean_batch_occupancy": round(mean_occ, 4),
         }
+        meta = self._param_meta.get(self._param_epoch, {})
+        out["params"] = {
+            "epoch": self._param_epoch,
+            "step": meta.get("step"),
+            "checkpoint": meta.get("checkpoint"),
+            "hot_swaps": self.hot_swaps,
+            "live_epochs": sorted(self._params_by_epoch),
+        }
         if self.engine is not None:
             out["kv_pool"] = self.engine.pool.stats()
             out["compile"] = self.engine.compile_stats()
+            if self.engine.prefill_chunk:
+                out["prefill_chunk"] = self.engine.prefill_chunk
+        if self.policy == "speculative":
+            spec: dict[str, Any] = {
+                "gamma": self._gamma,
+                "mode": "batched" if self._draft_engine is not None else "batch-1",
+            }
+            if self._draft_engine is not None:
+                spec.update(
+                    {
+                        "rounds": self.spec_rounds,
+                        "drafted": self.spec_drafted,
+                        "accepted": self.spec_accepted,
+                        "acceptance_rate": round(
+                            self.spec_accepted / max(1, self.spec_drafted), 4
+                        ),
+                        "draft_kv_pool": self._draft_engine.pool.stats(),
+                        "draft_compile": self._draft_engine.compile_stats(),
+                    }
+                )
+            out["speculative"] = spec
         return out
 
     def run_forever(self, poll_sec: float = 0.005) -> None:
         """Scheduler loop body for the background thread."""
         while True:
             with self._wake:
-                if self._closed and not self._queue and not self._active:
+                idle = (
+                    not self._queue
+                    and not self._active
+                    and not self._prefilling
+                    and self._pending_swap is None
+                )
+                if self._closed and idle:
                     return
-                if not self._queue and not self._active and not self._closed:
+                if idle and not self._closed:
                     self._wake.wait(timeout=poll_sec * 20)
-            if self._closed and not self._queue and not self._active:
+            with self._lock:
+                idle = (
+                    not self._queue
+                    and not self._active
+                    and not self._prefilling
+                    and self._pending_swap is None
+                )
+            if self._closed and idle:
                 return
             if not self.step():
                 time.sleep(poll_sec)

@@ -255,6 +255,12 @@ class TestServingConfig:
         assert cfg.serving.prompt_buckets == []
         assert cfg.serving.batch_buckets == []
         assert cfg.serving.max_new_tokens_cap == 256
+        # Fleet-tier knobs default OFF / to sane fleet sizes.
+        assert cfg.serving.prefix_cache is False
+        assert cfg.serving.prefill_chunk == 0
+        assert cfg.serving.router.replicas == 2
+        assert cfg.serving.router.affinity_weight == 4.0
+        assert cfg.serving.router.fail_threshold == 3
 
     def test_continuous_with_buckets(self):
         cfg = RunConfig.model_validate(
@@ -271,6 +277,24 @@ class TestServingConfig:
         assert cfg.serving.mode == "continuous"
         assert cfg.serving.batch_buckets[-1] == cfg.serving.max_batch_slots
 
+    def test_fleet_tier_knobs(self):
+        cfg = RunConfig.model_validate(
+            {
+                **MINIMAL,
+                "serving": {
+                    "mode": "continuous",
+                    "prefix_cache": True,
+                    "prefill_chunk": 8,
+                    "prompt_buckets": [8, 16],
+                    "router": {"replicas": 3, "revive_sec": 5.0},
+                },
+            }
+        )
+        assert cfg.serving.prefix_cache is True
+        assert cfg.serving.prefill_chunk == 8
+        assert cfg.serving.router.replicas == 3
+        assert cfg.serving.router.revive_sec == 5.0
+
     @pytest.mark.parametrize(
         "serving",
         [
@@ -284,6 +308,16 @@ class TestServingConfig:
             {"max_batch_slots": 4, "batch_buckets": [2, 8]},  # last != slots
             {"request_timeout_sec": 0},
             {"bogus": 1},
+            {"prefill_chunk": -1},
+            # Chunks must pad into an existing bucket.
+            {"prefill_chunk": 64, "prompt_buckets": [8, 16]},
+            # The speculative verify slab needs the whole prompt resident.
+            {"policy": "speculative", "prefill_chunk": 8},
+            {"router": {"replicas": 0}},
+            {"router": {"fail_threshold": 0}},
+            {"router": {"revive_sec": 0}},
+            {"router": {"affinity_weight": -1.0}},
+            {"router": {"bogus": 1}},  # strict: typos rejected
         ],
     )
     def test_rejections(self, serving):

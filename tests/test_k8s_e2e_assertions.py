@@ -223,6 +223,7 @@ def manifests():
         for name in (
             "job.yaml", "job-tpu-v5e.yaml", "infra.yaml", "configmap.yaml",
             "dashboard-admin.yaml", "kind-config.yaml", "serve.yaml",
+            "router.yaml",
         )
     }
 
@@ -458,6 +459,69 @@ class TestServeManifest:
             train = yaml.safe_load(cm["data"]["train.yaml"])
             for key in ("name", "d_model", "n_layers", "n_heads", "block_size"):
                 assert cfg["model"][key] == train["model"][key]
+
+
+class TestRouterManifest:
+    """k8s/router.yaml: the fleet tier — replica pods behind a headless
+    Service, fronted by a router Deployment that discovers them over DNS
+    (docs/serving.md "Fleet tier")."""
+
+    def _deployments(self, manifests):
+        deps = {d["metadata"]["name"]: d
+                for d in _by_kind(manifests["router.yaml"], "Deployment")}
+        return deps["llmtrain-tpu-serve-replica"], deps["llmtrain-tpu-router"]
+
+    def test_replica_service_is_headless_and_selects_replicas(self, manifests):
+        """DNS-based discovery only works through a headless Service: one
+        A record per READY replica pod is what resolve_backends consumes."""
+        svcs = {s["metadata"]["name"]: s
+                for s in _by_kind(manifests["router.yaml"], "Service")}
+        headless = svcs["llmtrain-tpu-serve-replicas"]
+        assert headless["spec"]["clusterIP"] == "None"
+        replica_dep, _ = self._deployments(manifests)
+        labels = replica_dep["spec"]["template"]["metadata"]["labels"]
+        assert headless["spec"]["selector"].items() <= labels.items()
+        assert replica_dep["spec"]["replicas"] >= 2  # a fleet, not a pod
+
+    def test_router_discovers_the_headless_service(self, manifests):
+        """The router's --discover target must be the headless Service's
+        name on the port the replicas actually serve."""
+        _, router_dep = self._deployments(manifests)
+        (ctr,) = router_dep["spec"]["template"]["spec"]["containers"]
+        cmd = ctr["command"]
+        assert "--discover" in cmd
+        target = cmd[cmd.index("--discover") + 1]
+        host, port = target.rsplit(":", 1)
+        svcs = {s["metadata"]["name"]: s
+                for s in _by_kind(manifests["router.yaml"], "Service")}
+        assert host == "llmtrain-tpu-serve-replicas"
+        (svc_port,) = svcs[host]["spec"]["ports"]
+        assert int(port) == svc_port["port"]
+
+    def test_both_deployments_probe_healthz_and_resolve_references(
+        self, manifests
+    ):
+        sa_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["infra.yaml"], "ServiceAccount")}
+        pvc_names = {
+            d["metadata"]["name"]
+            for d in _by_kind(manifests["infra.yaml"], "PersistentVolumeClaim")
+        }
+        cm_names = {d["metadata"]["name"]
+                    for d in _by_kind(manifests["configmap.yaml"], "ConfigMap")}
+        for dep in self._deployments(manifests):
+            pod = dep["spec"]["template"]["spec"]
+            assert pod["serviceAccountName"] in sa_names
+            for vol in pod["volumes"]:
+                if "persistentVolumeClaim" in vol:
+                    assert vol["persistentVolumeClaim"]["claimName"] in pvc_names
+                if "configMap" in vol:
+                    assert vol["configMap"]["name"] in cm_names
+            (ctr,) = pod["containers"]
+            for probe_name in ("readinessProbe", "livenessProbe"):
+                assert ctr[probe_name]["httpGet"]["path"] == "/healthz"
+            # Cold-cache compiles must not be probe-killed.
+            assert ctr["livenessProbe"]["initialDelaySeconds"] >= 60
 
 
 class TestAssertTelemetryArtifacts:
