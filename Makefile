@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -100,6 +100,16 @@ verify-tune:
 # own self-test (new-key/removed-key/degraded-parity matrix cases).
 verify-quant:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant_train.py -q
+	python tools/perf_gate.py --self-test
+
+# Activation-tier suite (docs/perf.md "Activation tiers and host
+# offload"): spec grammar, per-layer jaxpr remat boundaries, forward
+# bitwise parity, the remat->tiers deprecation shim, the per-tier HBM
+# model + ladder enumeration, and the @slow Trainer fits (offload
+# fallback warning, resume with tiers changed) — plus the perf-gate
+# offload scenario contract.
+verify-offload:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_activation_tiers.py -q
 	python tools/perf_gate.py --self-test
 
 # Goodput-ledger suite (docs/observability.md "Goodput"): synthetic-

@@ -33,16 +33,22 @@ _MFU_TARGET = 0.30
 _CHILD_ENV = "LLMTRAIN_BENCH_CHILD"
 _PROBE_ENV = "LLMTRAIN_BENCH_PROBE"
 _ZERO_ENV = "LLMTRAIN_BENCH_ZERO_CHILD"
+_OFFLOAD_ENV = "LLMTRAIN_BENCH_OFFLOAD_CHILD"
 _MATRIX_ENV = "LLMTRAIN_BENCH_MATRIX_CHILD"
 _MATRIX_SPEC_ENV = "LLMTRAIN_BENCH_MATRIX_SPEC"
 # stderr sentinels: the child prints one right before starting an OPTIONAL
-# phase (auto-sweep / ZeRO scenario / matrix), so a parent-side timeout
-# after it is "optional phase cut short", not a failure of the main
-# measurement.
+# phase (auto-sweep / ZeRO scenario / offload scenario / matrix), so a
+# parent-side timeout after it is "optional phase cut short", not a
+# failure of the main measurement.
 _SWEEP_MARKER = "[bench] starting auto-sweep"
 _ZERO_MARKER = "[bench] starting zero scenario"
+_OFFLOAD_MARKER = "[bench] starting offload scenario"
 _MATRIX_MARKER = "[bench] starting matrix scenario"
-_OPTIONAL_MARKERS = (_SWEEP_MARKER, _ZERO_MARKER, _MATRIX_MARKER)
+_OPTIONAL_MARKERS = (_SWEEP_MARKER, _ZERO_MARKER, _OFFLOAD_MARKER, _MATRIX_MARKER)
+# Loss-parity band for the sequence-parallel matrix lines (ring/ulysses
+# are EXACT attention — docs/perf.md "Sequence parallelism" — so the only
+# tolerated drift is fp reduction-order noise amplified over the steps).
+_PAR_RTOL = 2e-3
 # Loss-parity bands for the quantized matrix scenarios (docs/perf.md
 # "Quantized training"): N quantized steps must track the f32 trajectory
 # within these relative tolerances or the scenario line fails as degraded.
@@ -451,6 +457,33 @@ def _child_main() -> None:
                 flush=True,
             )
 
+    # Activation-tier OFFLOAD scenario (model.extra.activation_tiers,
+    # docs/perf.md "Activation tiers and host offload"): the r05 bench
+    # shape trained twice through the real Trainer — all-`none` tiers vs
+    # an offload-bottom ladder — with the planner's predicted HBM for
+    # both, proving the tiered run fits under a cap the all-`none` run
+    # does not, with bitwise-identical loss. Same budget/skip/carry
+    # contract as the zero scenario; CPU children only.
+    offload_info = None
+    if scenarios_on and os.environ.get("LLMTRAIN_BENCH_OFFLOAD", "1") != "0":
+        offload_budget = min(deadline - (time.perf_counter() - t0) - 60.0, 300.0)
+        if offload_budget > 60.0:
+            print(_OFFLOAD_MARKER, file=sys.stderr, flush=True)
+            offload_info = _offload_scenario(offload_budget)
+            if offload_info is not None:
+                result["detail"]["offload"] = offload_info
+                result["skipped"] = skipped
+                print(json.dumps(result), flush=True)
+        else:
+            skipped.append(
+                {"scenario": "offload", "reason": "deadline budget exhausted"}
+            )
+            print(
+                "offload scenario skipped: not enough of the deadline budget left",
+                file=sys.stderr,
+                flush=True,
+            )
+
     # Scenario MATRIX (dense/MoE/LoRA x context x loss_impl x
     # matmul_precision): each scenario runs in its own CPU subprocess —
     # exactly the _zero_scenario pattern — and lands as a keyed line under
@@ -538,6 +571,8 @@ def _child_main() -> None:
                 # The sweep line supersedes the banked one (last JSON
                 # wins); carry the zero scenario forward so it survives.
                 best["detail"]["zero"] = zero_info
+            if offload_info is not None:
+                best["detail"]["offload"] = offload_info
             if matrix_lines:
                 best["matrix"] = matrix_lines
             if skipped or "skipped" in result:
@@ -673,14 +708,185 @@ def _zero_main() -> None:
     print(json.dumps({"zero_scenario": out}), flush=True)
 
 
+def _offload_scenario(timeout_sec: float) -> dict | None:
+    """Run the activation-tier offload comparison in a CPU subprocess with
+    an emulated 4-device mesh (same isolation rationale as
+    _zero_scenario). Returns the scenario dict, or None when the
+    subprocess failed/timed out — the banked main line stands either
+    way."""
+    env = dict(os.environ)
+    env.pop(_CHILD_ENV, None)
+    env[_OFFLOAD_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Pin the emulated mesh to exactly 4 devices, REPLACING any inherited
+    # count: the planner's per-device HBM prediction — the fits/doesn't-fit
+    # claim — depends on the dp degree.
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_sec,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"offload scenario timed out after {timeout_sec:.0f}s; skipping",
+            file=sys.stderr,
+        )
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and "offload_scenario" in parsed:
+                return parsed["offload_scenario"]
+    tail = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else "no stderr"
+    print(
+        f"offload scenario child failed rc={proc.returncode} ({tail[:200]})",
+        file=sys.stderr,
+    )
+    return None
+
+
+def _offload_main() -> None:
+    """Offload scenario child: the r05 bench shape trained through the
+    REAL Trainer twice — all-``none`` activation tiers, then an
+    offload-bottom ladder (``offload:0-0,full:1-1``; on backends without
+    a pinned_host memory space the offload tier degrades to ``full``
+    remat, models/activation_policy.py) — plus the mesh planner's
+    predicted per-device HBM for both configs. The HBM cap is derived as
+    the midpoint of the two predictions, so the line carries a concrete
+    budget under which the tiered run fits and the all-``none`` run does
+    not, the ordering ``llmtrain plan`` predicts and
+    tests/test_activation_tiers.py pins. Prints one
+    ``{"offload_scenario": ...}`` JSON line (no "metric" key — it must
+    never shadow the headline line in the parent's last-JSON-wins
+    parse)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from llmtrain_tpu.autotune.plan import plan_from_config, predict_hbm_bytes
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.registry import initialize_registries
+    from llmtrain_tpu.tracking import NullTracker
+    from llmtrain_tpu.training import Trainer
+
+    initialize_registries()
+    ndev = len(jax.devices())
+    steps = int(os.environ.get("LLMTRAIN_BENCH_OFFLOAD_STEPS", "4"))
+    ladder = "offload:0-0,full:1-1"
+
+    def run(tiers: str | None) -> dict:
+        extra: dict = {"assume_packed": True}
+        if tiers is not None:
+            extra["activation_tiers"] = tiers
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "bench-offload", "device": "cpu"},
+                "model": {
+                    "name": "gpt",
+                    "block_size": 128,
+                    "d_model": 1280,
+                    "n_layers": 2,
+                    "n_heads": 8,
+                    "d_ff": 5120,
+                    "dropout": 0.0,
+                    "vocab_size": 1024,
+                    "extra": extra,
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": steps,
+                    "micro_batch_size": max(16 // ndev, 1),
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 0,
+                    "log_every_steps": 1,
+                    "eval_every_steps": 1_000_000,
+                    "save_every_steps": 1_000_000,
+                    "prefetch_depth": 0,
+                },
+                "distributed": {"mesh": {"data": ndev}},
+                "mlflow": {"enabled": False},
+            }
+        )
+        trainer = Trainer(cfg, None, NullTracker(), None)
+        result = trainer.fit()
+        latest = trainer._telemetry.metrics.latest()
+        plan = plan_from_config(cfg, ndev, adapter=trainer._adapter)
+        hbm = predict_hbm_bytes(
+            plan,
+            n_params=int(trainer._param_count),
+            d_model=cfg.model.d_model,
+            n_layers=cfg.model.n_layers,
+            vocab_size=int(cfg.model.vocab_size or 1024),
+            block_size=cfg.model.block_size,
+            dtype_bytes=4,
+            param_dtype_bytes=4,
+        )
+        return {
+            "tiers": tiers if tiers is not None else "none:*",
+            "tokens_per_sec": round(latest["train/tokens_per_sec"][0], 1),
+            "step_time_ms": round(latest["train/step_time_sec"][0] * 1e3, 2),
+            "predicted_hbm_bytes": int(hbm["total_bytes"]),
+            "predicted_activation_bytes": int(hbm["activation_bytes"]),
+            "predicted_host_bytes": int(hbm["activation_host_bytes"]),
+            "first_step_loss": result.first_step_loss,
+            "final_loss": result.final_loss,
+        }
+
+    baseline = run(None)
+    tiered = run(ladder)
+    cap = (baseline["predicted_hbm_bytes"] + tiered["predicted_hbm_bytes"]) // 2
+    out = {
+        "devices": ndev,
+        "model": f"gpt L2 d1280 T128 b16 (r05 bench shape, {ndev}-dev CPU emulation)",
+        "tiers": ladder,
+        "hbm_cap_bytes": int(cap),
+        "baseline": baseline,
+        "tiered": tiered,
+        "baseline_fits": baseline["predicted_hbm_bytes"] <= cap,
+        "tiered_fits": tiered["predicted_hbm_bytes"] <= cap,
+        # Remat changes nothing about the forward math: the step-1 loss
+        # (pure forward on identical init) must be bit-identical. The
+        # final loss after updates is reported alongside for context —
+        # rematerialized backward passes may reassociate reductions.
+        "loss_bitwise_identical": baseline["first_step_loss"]
+        == tiered["first_step_loss"],
+        "final_loss_rel_diff": round(
+            abs(baseline["final_loss"] - tiered["final_loss"])
+            / max(abs(baseline["final_loss"]), 1e-9),
+            8,
+        ),
+    }
+    print(json.dumps({"offload_scenario": out}), flush=True)
+
+
 def _matrix_scenarios() -> list[dict]:
     """The bench scenario matrix: dense/MoE/LoRA x short/long context x
-    loss_impl x matmul_precision, sampled (a full cross product would be
-    36 lines and blow every budget; these 7 cover each axis against the
+    loss_impl x matmul_precision x parallelism, sampled (a full cross
+    product would blow every budget; these cover each axis against the
     dense/short/dense_ce/f32 baseline). Shapes are tiny on purpose — the
     matrix measures RELATIVE deltas (quantization, chunked CE, MoE
-    routing, LoRA) per round; tools/perf_gate.py gates each key against
-    the same key last round, never across keys."""
+    routing, LoRA, sequence-parallel attention, ZeRO) per round;
+    tools/perf_gate.py gates each key against the same key last round,
+    never across keys.
+
+    Keys with a fifth ``|par`` segment run through the REAL Trainer on an
+    emulated 4-device ``{data: 2, sequence: 2}`` mesh (ring/ulysses are
+    sharded collectives — a single-device jit cannot exercise them), with
+    a dense-attention twin on the SAME mesh as the loss-parity reference
+    (exact-attention claim, docs/perf.md "Sequence parallelism")."""
     base = {"model": "gpt", "seq": 64, "batch": 8, "steps": 3, "extra": {}}
 
     def spec(key: str, **kw) -> dict:
@@ -711,6 +917,26 @@ def _matrix_scenarios() -> list[dict]:
             "lora|short|dense_ce|f32",
             extra={"loss_impl": "dense", "lora": {"rank": 4, "alpha": 8}},
         ),
+        spec(
+            "dense|short|dense_ce|f32|ring-zero0",
+            extra={"loss_impl": "dense"},
+            par={"attention": "ring", "zero": False},
+        ),
+        spec(
+            "dense|short|dense_ce|f32|ring-zero1",
+            extra={"loss_impl": "dense"},
+            par={"attention": "ring", "zero": True},
+        ),
+        spec(
+            "dense|short|dense_ce|f32|ulysses-zero0",
+            extra={"loss_impl": "dense"},
+            par={"attention": "ulysses", "zero": False},
+        ),
+        spec(
+            "dense|short|dense_ce|f32|ulysses-zero1",
+            extra={"loss_impl": "dense"},
+            par={"attention": "ulysses", "zero": True},
+        ),
     ]
 
 
@@ -724,6 +950,16 @@ def _matrix_scenario(spec: dict, timeout_sec: float) -> dict | None:
     env[_MATRIX_ENV] = "1"
     env[_MATRIX_SPEC_ENV] = json.dumps(spec)
     env["JAX_PLATFORMS"] = "cpu"
+    if spec.get("par"):
+        # Parallelism lines need the emulated 4-device {data:2, sequence:2}
+        # mesh; REPLACE any inherited device count (zero-scenario idiom).
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append("--xla_force_host_platform_device_count=4")
+        env["XLA_FLAGS"] = " ".join(flags)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -755,6 +991,149 @@ def _matrix_scenario(spec: dict, timeout_sec: float) -> dict | None:
     return None
 
 
+def _matrix_par_main(spec: dict) -> None:
+    """Parallelism matrix child: ONE ring/ulysses x ZeRO cell trained
+    through the REAL Trainer on an emulated 4-device ``{data: 2,
+    sequence: 2}`` mesh, plus a dense-attention twin on the SAME mesh and
+    ZeRO setting as the loss-parity reference — ring/ulysses compute
+    EXACT attention (ops/ring_attention.py, ops/ulysses_attention.py), so
+    the two runs must agree to fp reduction-order noise (_PAR_RTOL). The
+    cost attribution re-lowers the trainer's jitted step (trace only,
+    telemetry/profiling.py), so tools/perf_gate.py applies the same >1%
+    flops-drift comparability rule as every other matrix line. Prints one
+    ``{"matrix_scenario": ...}`` JSON line (no "metric" key)."""
+    import jax
+
+    from llmtrain_tpu.config.schemas import RunConfig
+    from llmtrain_tpu.registry import initialize_registries
+    from llmtrain_tpu.tracking import NullTracker
+    from llmtrain_tpu.training import Trainer
+
+    initialize_registries()
+    par = spec["par"]
+    seq, batch, steps = spec["seq"], spec["batch"], spec["steps"]
+    depth, d_model, n_heads, d_ff, vocab = 2, 128, 4, 256, 512
+    ndev = len(jax.devices())
+
+    def train(attention: str) -> dict:
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "bench-matrix-par", "device": "cpu"},
+                "model": {
+                    "name": spec["model"],
+                    "block_size": seq,
+                    "d_model": d_model,
+                    "n_layers": depth,
+                    "n_heads": n_heads,
+                    "d_ff": d_ff,
+                    "dropout": 0.0,
+                    "vocab_size": vocab,
+                    "attention": attention,
+                    "extra": {**spec["extra"], "assume_packed": True},
+                },
+                "data": {"name": "dummy_text"},
+                "trainer": {
+                    "max_steps": steps,
+                    "micro_batch_size": batch,
+                    "grad_accum_steps": 1,
+                    "warmup_steps": 0,
+                    "log_every_steps": 1,
+                    "eval_every_steps": 1_000_000,
+                    "save_every_steps": 1_000_000,
+                    "prefetch_depth": 0,
+                    "zero": {"enabled": bool(par["zero"])},
+                },
+                "distributed": {"mesh": {"data": 2, "sequence": 2}},
+                "mlflow": {"enabled": False},
+            }
+        )
+        trainer = Trainer(cfg, None, NullTracker(), None)
+        result = trainer.fit()
+        latest = trainer._telemetry.metrics.latest()
+        attribution = None
+        try:
+            from llmtrain_tpu.telemetry import profiling
+
+            prof = profiling.lower_cost_profile(
+                trainer._jit_train_step,
+                (trainer._state, trainer._batch_struct, jax.random.key(0)),
+                name="matrix_par_step",
+                n_chips=ndev,
+            )
+            if prof is not None:
+                peaks = profiling.resolve_peaks()
+                roof = profiling.classify_roofline(
+                    flops=prof["flops"],
+                    bytes_accessed=prof["bytes_accessed"],
+                    peaks=peaks,
+                )
+                attribution = {**prof, "roofline": roof}
+        except Exception as exc:  # noqa: BLE001
+            attribution = {"error": str(exc)}
+        monitor = trainer._telemetry.memory
+        hbm_peak = monitor.peaks()["hbm_peak_bytes"] if monitor is not None else 0.0
+        return {
+            "tokens_per_sec": round(latest["train/tokens_per_sec"][0], 1),
+            "step_time_ms": round(latest["train/step_time_sec"][0] * 1e3, 2),
+            "hbm_peak_bytes": int(hbm_peak),
+            "first_step_loss": float(result.first_step_loss or 0.0),
+            "final_loss": float(result.final_loss),
+            "attribution": attribution,
+        }
+
+    measured = train(par["attention"])
+    ref = train("dense")
+    diffs = [
+        abs(q - f) / max(abs(f), 1e-6)
+        for q, f in (
+            (measured["first_step_loss"], ref["first_step_loss"]),
+            (measured["final_loss"], ref["final_loss"]),
+        )
+    ]
+    max_rel = max(diffs)
+    ok = max_rel <= _PAR_RTOL
+    line = {
+        "key": spec["key"],
+        "model": f"{spec['model']} L{depth} d{d_model} T{seq}",
+        "batch": batch,
+        "steps": steps,
+        "loss_impl": spec["extra"].get("loss_impl", "dense"),
+        "matmul_precision": "f32",
+        "par": {
+            "attention": par["attention"],
+            "zero": bool(par["zero"]),
+            "mesh": {"data": 2, "sequence": 2},
+            "devices": ndev,
+        },
+        "tokens_per_sec": measured["tokens_per_sec"],
+        "step_time_ms": measured["step_time_ms"],
+        "hbm_peak_bytes": measured["hbm_peak_bytes"],
+        "losses": [
+            round(measured["first_step_loss"], 6),
+            round(measured["final_loss"], 6),
+        ],
+        "attribution": measured["attribution"],
+        "parity": {
+            "vs": "dense attention, same mesh + zero setting",
+            "rtol": _PAR_RTOL,
+            "max_rel_diff": round(max_rel, 6),
+            "ok": ok,
+            "dense_losses": [
+                round(ref["first_step_loss"], 6),
+                round(ref["final_loss"], 6),
+            ],
+            "dense_tokens_per_sec": ref["tokens_per_sec"],
+        },
+    }
+    if not ok:
+        line["degraded"] = True
+        line["fallback"] = (
+            f"loss parity vs dense failed: max rel diff {max_rel:.4f} "
+            f"> rtol {_PAR_RTOL}"
+        )
+    print(json.dumps({"matrix_scenario": line}), flush=True)
+
+
 def _matrix_main() -> None:
     """Matrix scenario child: ONE cell of the scenario matrix measured on
     the real jitted train step at a tiny CPU shape, with the PR 10 cost
@@ -781,6 +1160,9 @@ def _matrix_main() -> None:
 
     initialize_registries()
     spec = json.loads(os.environ[_MATRIX_SPEC_ENV])
+    if spec.get("par"):
+        _matrix_par_main(spec)
+        return
     seq, batch, steps = spec["seq"], spec["batch"], spec["steps"]
     depth, d_model, n_heads, d_ff, vocab = 2, 128, 4, 256, 512
 
@@ -1210,6 +1592,8 @@ def _run(
 if __name__ == "__main__":
     if os.environ.get(_MATRIX_ENV) == "1":
         _matrix_main()
+    elif os.environ.get(_OFFLOAD_ENV) == "1":
+        _offload_main()
     elif os.environ.get(_ZERO_ENV) == "1":
         _zero_main()
     elif os.environ.get(_PROBE_ENV) == "1":

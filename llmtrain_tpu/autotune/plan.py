@@ -38,6 +38,7 @@ import math
 from dataclasses import dataclass
 from typing import Any, Mapping
 
+from ..config.activation_tiers import canonical_tier_spec, parse_activation_tiers
 from ..resilience.elastic import ELASTIC_AXES, MODEL_AXES, describe_topology
 
 # Canonical axis order — must match distributed.MESH_AXES (physical
@@ -115,6 +116,10 @@ class ModelCaps:
     n_kv_heads: int = 0
     n_experts: int = 0
     pipeline_microbatches: int = 4
+    # Layer count, consumed by activation-tier spec validation; 0 =
+    # unknown (pure unit tests constructing ModelCaps directly) — tier
+    # specs then pass through unvalidated.
+    n_layers: int = 0
 
 
 def caps_from_config(cfg: Any, adapter: Any | None = None) -> ModelCaps:
@@ -130,6 +135,7 @@ def caps_from_config(cfg: Any, adapter: Any | None = None) -> ModelCaps:
         n_kv_heads=int(extra.get("n_kv_heads", 0) or 0),
         n_experts=int(extra.get("n_experts", 0) or 0),
         pipeline_microbatches=int(extra.get("pipeline_microbatches", 4) or 4),
+        n_layers=int(cfg.model.n_layers),
     )
 
 
@@ -150,6 +156,9 @@ class MeshPlan:
     zero_stage: int = 0  # 0 = ZeRO off; 1/2 per trainer.zero.stage
     attention: str = "dense"
     model_name: str = ""
+    # Canonical per-layer activation-tier spec (config/activation_tiers.py),
+    # "" = unset (the legacy remat flag above describes the layout).
+    activation_tiers: str = ""
 
     @property
     def data_parallel(self) -> int:
@@ -184,16 +193,21 @@ class MeshPlan:
         )
 
     def key(self) -> str:
-        """Compact stable identity, e.g. ``d2.f2.t1.s1.p1.e2|mb4|remat0|zero1``."""
+        """Compact stable identity, e.g. ``d2.f2.t1.s1.p1.e2|mb4|remat0|zero1``
+        (``|act=<spec>`` appended only when a tier ladder is set, so every
+        pre-tier key string is unchanged)."""
         mesh = ".".join(f"{a[0]}{self.axes[a]}" for a in MESH_AXES)
-        return f"{mesh}|mb{self.micro_batch_size}|remat{int(self.remat)}|zero{self.zero_stage}"
+        base = f"{mesh}|mb{self.micro_batch_size}|remat{int(self.remat)}|zero{self.zero_stage}"
+        if self.activation_tiers:
+            return f"{base}|act={self.activation_tiers}"
+        return base
 
     def config_overrides(self) -> dict[str, Any]:
         """The config fields this plan pins, as a nested dict that deep-
         merges into a ``RunConfig.model_dump()`` — the emitted tuned YAML
         and the probe configs are both built through this, so what the
         tuner measured is exactly what ``llmtrain train`` later runs."""
-        return {
+        overrides: dict[str, Any] = {
             "distributed": {"mesh": self.mesh_axis_sizes()},
             "trainer": {
                 "micro_batch_size": self.micro_batch_size,
@@ -204,6 +218,14 @@ class MeshPlan:
             },
             "model": {"remat": self.remat},
         }
+        if self.activation_tiers:
+            # Tiers subsume remat; pin remat off so the merged config
+            # passes the schema's mutual-exclusion check.
+            overrides["model"] = {
+                "remat": False,
+                "extra": {"activation_tiers": self.activation_tiers},
+            }
+        return overrides
 
 
 def resolve_plan(
@@ -217,6 +239,7 @@ def resolve_plan(
     zero_stage: int = 0,
     attention: str | None = None,
     model_name: str = "",
+    activation_tiers: str = "",
 ) -> MeshPlan:
     """Resolve + validate one layout into a :class:`MeshPlan`.
 
@@ -311,6 +334,21 @@ def resolve_plan(
             "holds an equal expert slice"
         )
 
+    tiers_spec = str(activation_tiers or "")
+    if tiers_spec:
+        if remat:
+            raise MeshPlanError(
+                "model.remat: true conflicts with activation_tiers; tiers "
+                "subsume the remat flag"
+            )
+        if caps.n_layers > 0:
+            try:
+                tiers_spec = canonical_tier_spec(
+                    parse_activation_tiers(tiers_spec, caps.n_layers)
+                )
+            except ValueError as exc:
+                raise MeshPlanError(f"activation_tiers: {exc}") from exc
+
     return MeshPlan(
         axes=axes,
         device_count=device_count,
@@ -320,6 +358,7 @@ def resolve_plan(
         zero_stage=int(zero_stage),
         attention=att,
         model_name=model_name,
+        activation_tiers=tiers_spec,
     )
 
 
@@ -341,12 +380,39 @@ def plan_from_config(
         zero_stage=int(zero.stage) if zero.enabled else 0,
         attention=cfg.model.attention,
         model_name=cfg.model.name,
+        activation_tiers=str(
+            (cfg.model.extra or {}).get("activation_tiers", "") or ""
+        ),
     )
 
 
 # --------------------------------------------------------------------------
 # Analytic memory model (per-device HBM prediction)
 # --------------------------------------------------------------------------
+
+# Device-resident activation copies of [tokens, d_model] per layer by
+# tier. none=14 / full=2 are the pre-tier all-or-nothing model (the exact
+# values the old `2.0 if remat else 14.0` used); selective keeps the ~6
+# matmul outputs dots_saveable pins; offload keeps ~1 (the in-flight
+# staging buffer) and parks the block boundary on the host instead.
+TIER_ACT_COPIES: dict[str, float] = {
+    "none": 14.0,
+    "selective": 6.0,
+    "full": 2.0,
+    "offload": 1.0,
+}
+
+# Host-RAM copies of [tokens, d_model] per offload layer: the block-input
+# residual, double-buffered so the D2H of layer i overlaps layer i+1.
+OFFLOAD_HOST_COPIES = 2.0
+
+
+def plan_layer_tiers(plan: MeshPlan, n_layers: int) -> tuple[str, ...]:
+    """The per-layer tier list a plan implies: the parsed spec when set,
+    else the legacy remat flag mapped to all-``full``/all-``none``."""
+    if plan.activation_tiers:
+        return parse_activation_tiers(plan.activation_tiers, n_layers)
+    return ("full",) * n_layers if plan.remat else ("none",) * n_layers
 
 
 def estimate_param_count(
@@ -408,14 +474,26 @@ def predict_hbm_bytes(
     opt_shard = plan.device_count if plan.zero_stage > 0 else max(model_shard, 1)
     opt_b = 2 * n_params * 4.0 / max(opt_shard, 1)  # AdamW m+v, f32
     # Per-device activation tokens: batch shards over dp, context over
-    # sequence. ~14 activation copies of [tokens, d_model] per layer dense;
-    # remat keeps ~2 (block boundaries) and recomputes the rest.
+    # sequence. Device-resident copies of [tokens, d_model] per layer come
+    # from the layer's activation tier (TIER_ACT_COPIES — none=14 dense,
+    # full=2 block boundaries, offload additionally parks the boundary in
+    # host RAM, tracked separately since it spends no HBM).
     tokens = (
         plan.micro_batch_size
         * (block_size / max(plan.axes["sequence"], 1))
     )
-    act_per_layer = 2.0 if plan.remat else 14.0
-    acts_b = tokens * d_model * n_layers * act_per_layer * dtype_bytes
+    try:
+        tiers = plan_layer_tiers(plan, n_layers)
+    except ValueError as exc:
+        raise MeshPlanError(f"activation_tiers: {exc}") from exc
+    per_copy = tokens * d_model * dtype_bytes
+    by_tier: dict[str, float] = {}
+    host_b = 0.0
+    for tier in tiers:
+        by_tier[tier] = by_tier.get(tier, 0.0) + per_copy * TIER_ACT_COPIES[tier]
+        if tier == "offload":
+            host_b += per_copy * OFFLOAD_HOST_COPIES
+    acts_b = sum(by_tier.values())
     logits_b = tokens * vocab_size * 4.0  # CE runs f32
     total = params_b + grads_b + opt_b + acts_b + logits_b
     return {
@@ -423,6 +501,8 @@ def predict_hbm_bytes(
         "grads_bytes": round(grads_b),
         "opt_state_bytes": round(opt_b),
         "activation_bytes": round(acts_b),
+        "activation_bytes_by_tier": {t: round(v) for t, v in by_tier.items()},
+        "activation_host_bytes": round(host_b),
         "logits_bytes": round(logits_b),
         "total_bytes": round(total),
     }
@@ -433,9 +513,12 @@ __all__ = [
     "MeshPlan",
     "MeshPlanError",
     "ModelCaps",
+    "OFFLOAD_HOST_COPIES",
+    "TIER_ACT_COPIES",
     "caps_from_config",
     "estimate_param_count",
     "plan_from_config",
+    "plan_layer_tiers",
     "predict_hbm_bytes",
     "resolve_axis_sizes",
     "resolve_plan",

@@ -1,6 +1,7 @@
 """Candidate enumeration + analytical pruning for ``llmtrain tune``.
 
-The search space is mesh shape x microbatch x remat x zero stage.  Every
+The search space is mesh shape x microbatch x activation regime (remat /
+tier ladder) x zero stage.  Every
 candidate is scored *analytically* first — the PaLM FLOP model
 (utils/hw.py), the plan-level HBM prediction (autotune/plan.py), and the
 ``DEVICE_PEAKS`` roofline (telemetry/profiling.py) — and infeasible or
@@ -30,17 +31,36 @@ from typing import Any, Callable, Mapping
 from ..resilience.elastic import TopologyMismatchError, classify_topology_change
 from ..telemetry.profiling import classify_roofline, gradient_collective_bytes
 from ..utils.hw import transformer_flops_per_token
+from ..config.activation_tiers import canonical_tier_spec, parse_activation_tiers
 from .plan import (
     MESH_AXES,
     MeshPlan,
     MeshPlanError,
     ModelCaps,
     estimate_param_count,
+    plan_layer_tiers,
     predict_hbm_bytes,
     resolve_plan,
 )
 
 logger = logging.getLogger("llmtrain")
+
+# Recompute-FLOPs factor by activation tier, applied as the mean over
+# layers. none re-runs nothing; full/offload re-run the forward inside
+# the backward (the classic ~4/3 on 6N); selective replays only the cheap
+# elementwise ops between saved matmul outputs.
+TIER_FLOPS_FACTOR: dict[str, float] = {
+    "none": 1.0,
+    "selective": 1.1,
+    "full": 4.0 / 3.0,
+    "offload": 4.0 / 3.0,
+}
+
+# Host<->device staging bandwidth for the offload tier's analytical time
+# term (bytes/s) — a PCIe4/DMA-class placeholder, deliberately coarse:
+# it only has to rank offload ladders against recompute, not predict
+# wall-clock.
+HOST_DMA_BYTES_PER_SEC = 100e9
 
 # Per-device HBM capacity by device kind (bytes), substring-matched like
 # DEVICE_PEAKS (longest key wins). These bound the feasibility half of the
@@ -97,6 +117,10 @@ class Candidate:
     micro_batch_size: int
     remat: bool
     zero_stage: int
+    # Tier-ladder spec ("" = legacy remat flag only) — carried into the
+    # plan key and tune_report.json so a "winner changed" note names the
+    # ladder, not just a remat bit.
+    activation_tiers: str = ""
     plan: MeshPlan | None = None
     predicted: dict[str, Any] = field(default_factory=dict)
 
@@ -104,10 +128,13 @@ class Candidate:
         if self.plan is not None:
             return self.plan.key()
         mesh = ".".join(f"{a[0]}{self.mesh_sizes.get(a, 1)}" for a in MESH_AXES)
-        return (
+        base = (
             f"{mesh}|mb{self.micro_batch_size}"
             f"|remat{int(self.remat)}|zero{self.zero_stage}"
         )
+        if self.activation_tiers:
+            return f"{base}|act={self.activation_tiers}"
+        return base
 
 
 def enumerate_candidates(
@@ -154,8 +181,44 @@ def enumerate_candidates(
         mbs = sorted({int(m) for m in microbatch_candidates if int(m) >= 1})
     else:
         mbs = sorted({m for m in (base_mb // 2, base_mb, base_mb * 2) if m >= 1})
-    remats = [False, True] if search_remat else [bool(cfg.model.remat)]
     zeros = [0, 1, 2] if search_zero else [base_zero]
+
+    # Activation axis: (remat, tier-ladder) pairs. The legacy remat
+    # toggle IS the all-none / all-full ladder pair (plan_layer_tiers
+    # maps remat0 -> none:*, remat1 -> full:*), so those ladders stay as
+    # the unsuffixed remat0/remat1 keys; searching additionally proposes
+    # the offload-bottom-K ladder (bottom-of-stack residuals are the
+    # cheapest to stage — they are reused last in the backward pass).
+    # A config that already pins a tier spec forces every candidate to
+    # carry an explicit spec: the emitted overrides deep-merge over the
+    # base config, and an override without a spec would silently inherit
+    # the base ladder under a key that claims plain remat.
+    n_layers = int(cfg.model.n_layers)
+    base_spec = str((cfg.model.extra or {}).get("activation_tiers", "") or "")
+    if base_spec:
+        base_spec = canonical_tier_spec(
+            parse_activation_tiers(base_spec, n_layers)
+        )
+    k = max(1, n_layers // 4)
+    if n_layers > k:
+        offload_ladder = f"offload:0-{k - 1},full:{k}-{n_layers - 1}"
+    else:
+        offload_ladder = "offload:*"
+    offload_ladder = canonical_tier_spec(
+        parse_activation_tiers(offload_ladder, n_layers)
+    )
+    if base_spec:
+        if search_remat:
+            specs = list(dict.fromkeys(
+                [base_spec, "none:*", "full:*", offload_ladder]
+            ))
+        else:
+            specs = [base_spec]
+        activations = [(False, s) for s in specs]
+    elif search_remat:
+        activations = [(False, ""), (True, ""), (False, offload_ladder)]
+    else:
+        activations = [(bool(cfg.model.remat), "")]
 
     grid = [
         Candidate(
@@ -163,10 +226,11 @@ def enumerate_candidates(
             micro_batch_size=mb,
             remat=remat,
             zero_stage=z,
+            activation_tiers=tiers,
         )
         for shape in shapes
         for mb in mbs
-        for remat in remats
+        for remat, tiers in activations
         for z in zeros
     ]
     random.Random(seed).shuffle(grid)
@@ -204,7 +268,8 @@ def analytic_candidate_cost(
         d_model=m.d_model,
     )
     tokens_global = plan.global_micro_batch * m.block_size
-    remat_factor = 4.0 / 3.0 if plan.remat else 1.0
+    tiers = plan_layer_tiers(plan, m.n_layers)
+    remat_factor = sum(TIER_FLOPS_FACTOR[t] for t in tiers) / len(tiers)
     flops = flops_per_token * tokens_global / plan.device_count * remat_factor
 
     dtype_b = 2 if m.dtype == "bfloat16" else 4
@@ -218,10 +283,17 @@ def analytic_candidate_cost(
     collective = gradient_collective_bytes(
         plan.axes, n_params * 4.0 / model_shard
     )
+    # Offload tier staging traffic: each offloaded block-input residual
+    # crosses the host link twice per step (D2H after forward, H2D before
+    # its backward). Separate from bytes_accessed — it rides the DMA
+    # engines, not HBM (ranked via HOST_DMA_BYTES_PER_SEC in the pruner).
+    n_offload = sum(1 for t in tiers if t == "offload")
+    offload_bytes = tokens_dev * m.d_model * dtype_b * 2.0 * n_offload
     return {
         "flops": float(flops),
         "bytes_accessed": float(bytes_accessed),
         "collective_bytes": float(collective),
+        "offload_bytes": float(offload_bytes),
         "n_params": float(n_params),
         "source": "analytic",
     }
@@ -270,12 +342,21 @@ def lowered_candidate_cost(cfg: Any, plan: MeshPlan) -> dict[str, float] | None:
         model_shard = max(
             plan.axes["tensor"] * plan.axes["pipeline"] * plan.axes["fsdp"], 1
         )
-        remat_factor = 4.0 / 3.0 if plan.remat else 1.0
+        tiers = plan_layer_tiers(plan, cfg.model.n_layers)
+        remat_factor = sum(TIER_FLOPS_FACTOR[t] for t in tiers) / len(tiers)
+        dtype_b = 2 if cfg.model.dtype == "bfloat16" else 4
+        tokens_dev = (
+            plan.global_micro_batch * cfg.model.block_size / plan.device_count
+        )
+        n_offload = sum(1 for t in tiers if t == "offload")
         return {
             "flops": float(prof["flops"]) * remat_factor,
             "bytes_accessed": float(prof["bytes_accessed"]),
             "collective_bytes": gradient_collective_bytes(
                 plan.axes, grad_bytes / model_shard
+            ),
+            "offload_bytes": float(
+                tokens_dev * cfg.model.d_model * dtype_b * 2.0 * n_offload
             ),
             "source": "lowered",
         }
@@ -342,6 +423,7 @@ def prune_candidates(
                 zero_stage=cand.zero_stage,
                 attention=cfg.model.attention,
                 model_name=cfg.model.name,
+                activation_tiers=cand.activation_tiers,
             )
         except MeshPlanError as exc:
             pruned.append({"key": cand.key(), "reason": f"topology-illegal: {exc}"})
@@ -368,7 +450,13 @@ def prune_candidates(
             collective_bytes=cost.get("collective_bytes", 0.0),
             peaks=peaks,
         )
-        predicted_ms = sum(roof["analytical_ms"].values())
+        # Offload staging rides the host DMA link, a resource the
+        # roofline's three peaks don't model — append it as its own
+        # serial term (conservative: no overlap credit).
+        offload_ms = (
+            cost.get("offload_bytes", 0.0) / HOST_DMA_BYTES_PER_SEC * 1e3
+        )
+        predicted_ms = sum(roof["analytical_ms"].values()) + offload_ms
         hbm = predict_hbm_bytes(
             plan,
             n_params=n_params,
@@ -388,6 +476,7 @@ def prune_candidates(
             "roofline": roof,
             "predicted_step_ms": round(predicted_ms, 6),
             "predicted_us_per_token": round(predicted_ms * 1e3 / tokens, 6),
+            "offload_ms": round(offload_ms, 6),
             "hbm": hbm,
             "hbm_limit_bytes": hbm_limit_bytes,
         }
@@ -465,6 +554,8 @@ def prune_candidates(
 __all__ = [
     "Candidate",
     "DEVICE_HBM_BYTES",
+    "HOST_DMA_BYTES_PER_SEC",
+    "TIER_FLOPS_FACTOR",
     "analytic_candidate_cost",
     "enumerate_candidates",
     "lowered_candidate_cost",

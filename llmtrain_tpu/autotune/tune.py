@@ -98,6 +98,11 @@ def _run_probe(
     import yaml
 
     record: dict[str, Any] = {"key": plan.key(), "run_id": run_id}
+    if plan.activation_tiers:
+        # The tier ladder, named explicitly (it is also suffixed into the
+        # key) so perf_gate's tuned-plan "winner changed" notes and report
+        # consumers see which activation regime the winner runs.
+        record["activation_tiers"] = plan.activation_tiers
     dump = deep_merge(
         base_dump,
         _probe_overrides(

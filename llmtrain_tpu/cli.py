@@ -695,7 +695,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     tune = sub.add_parser(
         "tune",
-        help="auto-tune mesh shape x microbatch x remat x zero stage: "
+        help="auto-tune mesh shape x microbatch x activation tiers x zero stage: "
         "analytic roofline/HBM pruning, then short probe fits scored by "
         "measured perf_attribution MFU; emits the winner as a loadable "
         "config (autotune/, docs/perf.md 'Mesh planning and auto-tuning')",
@@ -866,6 +866,7 @@ def _handle_plan(args: argparse.Namespace) -> int:
             "grad_accum_steps": mesh_plan.grad_accum_steps,
             "remat": mesh_plan.remat,
             "zero_stage": mesh_plan.zero_stage,
+            "activation_tiers": mesh_plan.activation_tiers,
             "topology": mesh_plan.describe_topology(),
         },
         "roofline": roofline,
@@ -893,6 +894,17 @@ def _handle_plan(args: argparse.Namespace) -> int:
             f"{hbm_limit / 2**30:.1f} GiB limit "
             f"[{payload['device_kind']}]"
         )
+        by_tier = hbm.get("activation_bytes_by_tier", {})
+        if by_tier:
+            breakdown = " ".join(
+                f"{tier}={v / 2**30:.3f}GiB"
+                for tier, v in sorted(by_tier.items())
+            )
+            host_b = hbm.get("activation_host_bytes", 0)
+            line = f"acts      {breakdown}"
+            if host_b:
+                line += f" host_offload={host_b / 2**30:.3f}GiB"
+            print(line)
     if not feasible:
         _emit_error(
             "infeasible plan: predicted per-device HBM "

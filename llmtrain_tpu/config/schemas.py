@@ -77,6 +77,25 @@ class ModelConfig(BaseModel):
             raise ValueError("d_model must be divisible by n_heads")
         if self.d_ff < self.d_model:
             raise ValueError("d_ff must be greater than or equal to d_model")
+        # Strict-validate the per-layer activation-tier spec at config
+        # time (unknown tiers, malformed/overlapping/out-of-range ranges,
+        # conflict with the deprecated `remat` flag). A backend without a
+        # pinned_host memory space is deliberately NOT a config error —
+        # offload degrades to full remat at runtime with a warning
+        # (models/activation_policy.py).
+        spec = self.extra.get("activation_tiers")
+        if spec is not None:
+            from .activation_tiers import parse_activation_tiers
+
+            if self.remat:
+                raise ValueError(
+                    "model.remat: true conflicts with model.extra."
+                    "activation_tiers; drop model.remat (tiers subsume it)"
+                )
+            try:
+                parse_activation_tiers(str(spec), self.n_layers)
+            except ValueError as exc:
+                raise ValueError(f"model.extra.activation_tiers: {exc}") from exc
         return self
 
 

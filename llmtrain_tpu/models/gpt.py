@@ -33,8 +33,14 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..config.activation_tiers import canonical_tier_spec, parse_activation_tiers
 from ..config.schemas import RunConfig
 from ..registry.models import register_model
+from .activation_policy import (
+    resolve_activation_tiers,
+    tag_block_input,
+    tier_block_classes,
+)
 from .base import (
     Batch,
     ModelAdapter,
@@ -62,6 +68,55 @@ def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
 logger = logging.getLogger(__name__)
 
 _CE_AUTO_LOGGED = False
+_TIER_MIGRATION_LOGGED = False
+
+
+def _log_tier_migration(remat_policy: str, spec: str) -> None:
+    """One-time (per process) log naming the remat->tiers migration."""
+    global _TIER_MIGRATION_LOGGED
+    if not _TIER_MIGRATION_LOGGED:
+        _TIER_MIGRATION_LOGGED = True
+        logger.info(
+            "model.remat: true is deprecated; mapped remat_policy %r to "
+            "model.extra.activation_tiers: %r (set activation_tiers "
+            "directly to silence this)",
+            remat_policy,
+            spec,
+        )
+
+
+# Deprecation shim: `model.remat: true` maps onto the tier that keeps its
+# remat_policy semantics ("dots_no_batch" has no tier — it stays on the
+# legacy module remat path).
+_REMAT_POLICY_TO_TIER = {"nothing": "full", "dots": "selective"}
+
+
+def resolve_config_activation_tiers(cfg: RunConfig) -> tuple[str, ...] | None:
+    """Per-layer activation tiers for ``cfg``, backend-resolved.
+
+    Explicit ``model.extra.activation_tiers`` wins (and conflicts with
+    ``model.remat: true``); the deprecated ``model.remat: true`` migrates
+    to an equivalent all-layers tier with a one-time INFO log. Returns
+    None when the model should use the legacy remat fields (remat off, or
+    remat_policy ``dots_no_batch``).
+    """
+    spec = cfg.model.extra.get("activation_tiers")
+    if spec is not None:
+        if cfg.model.remat:
+            raise ValueError(
+                "model.remat: true conflicts with model.extra."
+                "activation_tiers; drop model.remat (tiers subsume it)"
+            )
+        tiers = parse_activation_tiers(str(spec), cfg.model.n_layers)
+        return resolve_activation_tiers(tiers)
+    if cfg.model.remat:
+        remat_policy = str(cfg.model.extra.get("remat_policy", "nothing"))
+        tier = _REMAT_POLICY_TO_TIER.get(remat_policy)
+        if tier is None:
+            return None
+        _log_tier_migration(remat_policy, f"{tier}:*")
+        return (tier,) * cfg.model.n_layers
+    return None
 
 
 def _log_ce_auto_select(vocab_size: int, ce_auto_vocab: int) -> None:
@@ -706,6 +761,9 @@ class TransformerBlock(nn.Module):
         positions: jax.Array | None = None,
         block_tables: jax.Array | None = None,
     ) -> jax.Array:
+        # Residual tag consumed by the "offload" activation tier's
+        # checkpoint policy; identity under every other policy.
+        x = tag_block_input(x)
         ln_kw = dict(
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -807,6 +865,13 @@ class GPT(nn.Module):
     # and recomputes only the cheap elementwise ops — less recompute on
     # the MXU for a modest memory cost, often the better MFU point.
     remat_policy: str = "nothing"
+    # Per-layer activation tiers (model.extra.activation_tiers), one of
+    # none|selective|full|offload per block — parsed/validated by the
+    # adapter (config/activation_tiers.py) and already backend-resolved
+    # (offload -> full where pinned_host is missing). When set it
+    # replaces the global remat/remat_policy pair above, which stays for
+    # direct module users and the dots_no_batch policy.
+    activation_tiers: tuple[str, ...] | None = None
     attention: str = "dense"
     decode: bool = False  # KV-cache generation mode (see for_decoding())
     decode_cache_len: int = 0  # KV-cache capacity; 0 = block_size
@@ -890,6 +955,7 @@ class GPT(nn.Module):
             decode=True,
             paged=True,
             remat=False,
+            activation_tiers=None,
             paged_num_blocks=num_blocks,
             paged_block_tokens=block_tokens,
         )
@@ -912,6 +978,7 @@ class GPT(nn.Module):
         return self.clone(
             decode=True,
             remat=False,
+            activation_tiers=None,
             decode_cache_len=min(cache_len, self.block_size),
             ring_slack=ring_slack,
         )
@@ -972,26 +1039,38 @@ class GPT(nn.Module):
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
-        block_cls = TransformerBlock
-        if self.remat:
-            if self.remat_policy not in REMAT_POLICIES:
-                # Direct module users; the adapter validates at config time.
+        if self.activation_tiers is not None:
+            if len(self.activation_tiers) != self.n_layers:
                 raise ValueError(
-                    f"remat_policy {self.remat_policy!r} unknown; expected "
-                    f"one of {sorted(REMAT_POLICIES)}"
+                    f"activation_tiers has {len(self.activation_tiers)} "
+                    f"entries for a {self.n_layers}-layer model"
                 )
-            # argnums include the module at 0; 3 = `deterministic`, a
-            # trace-time bool that must stay static through the remat boundary.
-            # policy=None is nn.remat's own default (save nothing).
-            block_cls = nn.remat(
-                TransformerBlock,
-                static_argnums=(3,),
-                policy=REMAT_POLICIES[self.remat_policy],
+            tier_classes = tier_block_classes(
+                TransformerBlock, self.activation_tiers
             )
+            layer_classes = [tier_classes[t] for t in self.activation_tiers]
+        else:
+            block_cls = TransformerBlock
+            if self.remat:
+                if self.remat_policy not in REMAT_POLICIES:
+                    # Direct module users; the adapter validates at config time.
+                    raise ValueError(
+                        f"remat_policy {self.remat_policy!r} unknown; expected "
+                        f"one of {sorted(REMAT_POLICIES)}"
+                    )
+                # argnums include the module at 0; 3 = `deterministic`, a
+                # trace-time bool that must stay static through the remat boundary.
+                # policy=None is nn.remat's own default (save nothing).
+                block_cls = nn.remat(
+                    TransformerBlock,
+                    static_argnums=(3,),
+                    policy=REMAT_POLICIES[self.remat_policy],
+                )
+            layer_classes = [block_cls] * self.n_layers
 
         paged = self.decode and self.paged
         for layer in range(self.n_layers):
-            block = block_cls(
+            block = layer_classes[layer](
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 d_ff=self.d_ff,
@@ -1067,7 +1146,8 @@ class GPTAdapter(ModelAdapter):
     known_extra_keys = frozenset(
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
          "assume_packed", "remat_policy", "sliding_window",
-         "kv_cache_dtype", "matmul_precision", "ce_auto_vocab"}
+         "kv_cache_dtype", "matmul_precision", "ce_auto_vocab",
+         "activation_tiers"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -1115,6 +1195,7 @@ class GPTAdapter(ModelAdapter):
                 f"model.extra.remat_policy {remat_policy!r} unknown; "
                 f"expected one of {sorted(REMAT_POLICIES)}"
             )
+        activation_tiers = resolve_config_activation_tiers(cfg)
         if cfg.model.attention in ("flash", "ring", "ulysses") and cfg.model.dropout > 0.0:
             raise ValueError(
                 f"attention={cfg.model.attention!r} does not support "
@@ -1164,6 +1245,7 @@ class GPTAdapter(ModelAdapter):
             n_kv_heads=n_kv_heads,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
             remat_policy=remat_policy,
+            activation_tiers=activation_tiers,
             sliding_window=sliding_window,
             kv_cache_dtype=kv_cache_dtype,
             matmul_precision=matmul_precision,

@@ -34,6 +34,7 @@ from flax import linen as nn
 
 from ..config.schemas import RunConfig
 from ..registry.models import register_model
+from .activation_policy import tag_block_input, tier_block_classes
 from .gpt import (
     _DENSE_INIT,
     _EMBED_INIT,
@@ -133,6 +134,9 @@ class LlamaBlock(nn.Module):
         positions: jax.Array | None = None,
         block_tables: jax.Array | None = None,
     ) -> jax.Array:
+        # Residual tag consumed by the "offload" activation tier's
+        # checkpoint policy; identity under every other policy.
+        x = tag_block_input(x)
         norm_kw = dict(
             eps=self.rms_norm_eps,
             dtype=self.dtype,
@@ -248,6 +252,9 @@ class Llama(nn.Module):
     param_dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: str = "nothing"
+    # Per-layer activation tiers (models/gpt.py GPT.activation_tiers):
+    # overrides the remat fields above when set.
+    activation_tiers: tuple[str, ...] | None = None
     attention: str = "dense"
     decode: bool = False
     decode_cache_len: int = 0
@@ -314,6 +321,7 @@ class Llama(nn.Module):
             decode=True,
             paged=True,
             remat=False,
+            activation_tiers=None,
             paged_num_blocks=num_blocks,
             paged_block_tokens=block_tokens,
         )
@@ -328,6 +336,7 @@ class Llama(nn.Module):
         return self.clone(
             decode=True,
             remat=False,
+            activation_tiers=None,
             decode_cache_len=min(cache_len, self.block_size),
             ring_slack=ring_slack,
         )
@@ -370,22 +379,32 @@ class Llama(nn.Module):
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "length", "act_embed"))
 
-        block_cls = LlamaBlock
-        if self.remat:
-            if self.remat_policy not in REMAT_POLICIES:
+        if self.activation_tiers is not None:
+            if len(self.activation_tiers) != self.n_layers:
                 raise ValueError(
-                    f"remat_policy {self.remat_policy!r} unknown; expected "
-                    f"one of {sorted(REMAT_POLICIES)}"
+                    f"activation_tiers has {len(self.activation_tiers)} "
+                    f"entries for a {self.n_layers}-layer model"
                 )
-            block_cls = nn.remat(
-                LlamaBlock,
-                static_argnums=(3,),
-                policy=REMAT_POLICIES[self.remat_policy],
-            )
+            tier_classes = tier_block_classes(LlamaBlock, self.activation_tiers)
+            layer_classes = [tier_classes[t] for t in self.activation_tiers]
+        else:
+            block_cls = LlamaBlock
+            if self.remat:
+                if self.remat_policy not in REMAT_POLICIES:
+                    raise ValueError(
+                        f"remat_policy {self.remat_policy!r} unknown; expected "
+                        f"one of {sorted(REMAT_POLICIES)}"
+                    )
+                block_cls = nn.remat(
+                    LlamaBlock,
+                    static_argnums=(3,),
+                    policy=REMAT_POLICIES[self.remat_policy],
+                )
+            layer_classes = [block_cls] * self.n_layers
 
         paged = self.decode and self.paged
         for layer in range(self.n_layers):
-            block = block_cls(
+            block = layer_classes[layer](
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 d_ff=self.d_ff,
@@ -508,6 +527,7 @@ class LlamaAdapter(GPTAdapter):
             param_dtype=base.param_dtype,
             remat=base.remat,
             remat_policy=base.remat_policy,
+            activation_tiers=base.activation_tiers,
             attention=base.attention,
             loss_impl=base.loss_impl,
             ce_chunk=base.ce_chunk,

@@ -185,6 +185,17 @@ class Telemetry:
         self.memory.record_opt_state(info)
         self.metrics.publish({f"mem/{k}": float(v) for k, v in info.items()})
 
+    def record_activation_bytes(self, info: dict[str, float]) -> None:
+        """Analytic activation footprint under the activation-tier ladder
+        (trainer._activation_memory): ``activation_bytes`` device-resident
+        + ``activation_bytes_offloaded`` host-staged, into the report's
+        memory block AND as ``mem/*`` gauges — same contract as the
+        opt-state accounting above."""
+        if self.memory is None:
+            return
+        self.memory.record_activations(info)
+        self.metrics.publish({f"mem/{k}": float(v) for k, v in info.items()})
+
     def flush(self, step: int | None = None) -> None:
         """The per-log-interval flush point: sample memory, push the pending
         metrics sample to the tracker (degraded on failure), persist the

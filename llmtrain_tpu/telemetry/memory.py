@@ -105,6 +105,7 @@ class MemoryMonitor:
         self._in_excursion = False
         self.headroom_warnings = 0
         self._opt_state: dict[str, float] = {}
+        self._activations: dict[str, float] = {}
 
     @property
     def source(self) -> str:
@@ -187,6 +188,14 @@ class MemoryMonitor:
         Merged into the report's memory block."""
         self._opt_state = {k: float(v) for k, v in info.items()}
 
+    def record_activations(self, info: dict[str, float]) -> None:
+        """Analytic activation footprint under the run's activation-tier
+        ladder (trainer._activation_memory): ``activation_bytes``
+        (device-resident), ``activation_bytes_offloaded`` (staged in host
+        RAM by the offload tier). Merged into the report's memory block
+        like the opt-state block."""
+        self._activations = {k: float(v) for k, v in info.items()}
+
     def peaks(self) -> dict[str, float]:
         """End-of-run summary block for the report."""
         out = {
@@ -196,6 +205,7 @@ class MemoryMonitor:
             "headroom_warnings": float(self.headroom_warnings),
         }
         out.update(self._opt_state)
+        out.update(self._activations)
         return out
 
 

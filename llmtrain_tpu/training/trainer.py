@@ -854,6 +854,20 @@ class Trainer:
             opt_mem["opt_state_bytes_per_device"] / 2**20,
             opt_mem["opt_state_bytes_host"] / 2**20,
         )
+        # Activation footprint under the activation-tier ladder: like the
+        # opt-state block, static for the whole fit — the analytic number
+        # `llmtrain plan` feasibility-checks against, recorded so the
+        # tiering/offload win is visible in report.json and as mem/*
+        # gauges (docs/perf.md "Activation tiers and host offload").
+        act_mem = self._activation_memory()
+        if act_mem is not None:
+            self._telemetry.record_activation_bytes(act_mem)
+            logger.info(
+                "activations (analytic): %.1f MiB on-device, %.1f MiB "
+                "host-offloaded per device",
+                act_mem["activation_bytes"] / 2**20,
+                act_mem["activation_bytes_offloaded"] / 2**20,
+            )
 
         self._telemetry.metrics.safe_log_params(cfg.model_dump())
 
@@ -2115,6 +2129,37 @@ class Trainer:
             "opt_state_bytes": total,
             "opt_state_bytes_per_device": per_device,
             "opt_state_bytes_host": on_host,
+        }
+
+    def _activation_memory(self) -> dict[str, float] | None:
+        """Analytic per-device activation footprint under the run's
+        activation-tier ladder (autotune/plan.py predict_hbm_bytes — the
+        same model `llmtrain plan` feasibility-checks): device-resident
+        bytes plus the host-RAM bytes the offload tier stages. None when
+        the plan cannot be resolved (never kills the fit it measures)."""
+        from ..autotune.plan import plan_from_config, predict_hbm_bytes
+
+        cfg = self._cfg
+        try:
+            plan = plan_from_config(
+                cfg, self._mesh.devices.size, adapter=self._adapter
+            )
+            hbm = predict_hbm_bytes(
+                plan,
+                n_params=int(self._param_count),
+                d_model=cfg.model.d_model,
+                n_layers=cfg.model.n_layers,
+                vocab_size=int(cfg.model.vocab_size or 50257),
+                block_size=cfg.model.block_size,
+                dtype_bytes=2 if cfg.model.dtype == "bfloat16" else 4,
+                param_dtype_bytes=2 if cfg.model.param_dtype == "bfloat16" else 4,
+            )
+        except Exception as exc:  # noqa: BLE001 — accounting must not kill runs
+            logger.debug("activation memory accounting skipped: %s", exc)
+            return None
+        return {
+            "activation_bytes": float(hbm["activation_bytes"]),
+            "activation_bytes_offloaded": float(hbm["activation_host_bytes"]),
         }
 
 

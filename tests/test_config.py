@@ -242,6 +242,77 @@ class TestUnknownExtraWarnings:
         assert "los_impl" in proc.stderr and "warning" in proc.stderr
 
 
+class TestActivationTierSpecs:
+    """model.extra.activation_tiers strict-validates at config time
+    (config/activation_tiers.py grammar; docs/perf.md "Activation tiers
+    and host offload")."""
+
+    def _model(self, *, n_layers=4, remat=False, **extra):
+        return {
+            **MINIMAL,
+            "model": {
+                "name": "gpt",
+                "block_size": 8,
+                "d_model": 16,
+                "n_layers": n_layers,
+                "n_heads": 4,
+                "d_ff": 32,
+                "vocab_size": 64,
+                "remat": remat,
+                "extra": extra,
+            },
+        }
+
+    def test_valid_spec_validates_and_round_trips(self):
+        cfg = RunConfig.model_validate(
+            self._model(activation_tiers="offload:0-1,full:2-3")
+        )
+        assert cfg.model.extra["activation_tiers"] == "offload:0-1,full:2-3"
+        again = RunConfig.model_validate(cfg.model_dump(mode="json"))
+        assert again.model.extra["activation_tiers"] == "offload:0-1,full:2-3"
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            "turbo:*",  # unknown tier
+            "full:0-9",  # out of range for 4 layers
+            "full:0-1,none:1",  # overlap
+            "full:3-1",  # inverted range
+            "full:*,none:0",  # '*' alongside other entries
+            "",  # empty
+        ],
+    )
+    def test_bad_specs_are_config_errors(self, spec):
+        with pytest.raises(ValueError, match="activation_tiers"):
+            RunConfig.model_validate(self._model(activation_tiers=spec))
+
+    def test_remat_conflict_is_a_config_error(self):
+        with pytest.raises(ValueError, match="conflicts"):
+            RunConfig.model_validate(
+                self._model(remat=True, activation_tiers="full:*")
+            )
+
+    def test_remat_alone_still_validates(self):
+        # The deprecated flag keeps working (shim maps it at build time).
+        cfg = RunConfig.model_validate(self._model(remat=True))
+        assert cfg.model.remat is True
+
+    def test_offload_without_pinned_host_is_not_a_config_error(self):
+        """A backend without a pinned_host memory space downgrades offload
+        at RUNTIME (models/activation_policy.py) — the same YAML must
+        validate everywhere, so the schema never probes the backend."""
+        cfg = RunConfig.model_validate(self._model(activation_tiers="offload:*"))
+        assert cfg.model.extra["activation_tiers"] == "offload:*"
+
+    def test_activation_tiers_is_a_known_extra_key(self):
+        from llmtrain_tpu.config.extras import unknown_extra_keys
+
+        cfg = RunConfig.model_validate(
+            self._model(tokenizer="byte", activation_tiers="full:*")
+        )
+        assert unknown_extra_keys(cfg) == {}
+
+
 class TestServingConfig:
     """serving: section (llmtrain_tpu/serving/, docs/serving.md)."""
 

@@ -126,6 +126,36 @@ def _tuned_plan_winner(result: dict[str, Any]) -> str | None:
     return None
 
 
+def _offload_tps(result: dict[str, Any]) -> float | None:
+    """Tiered tokens/s out of the activation-tier offload scenario block
+    (``detail.offload``, bench.py _offload_main), or None when the block
+    is absent/malformed — or when the scenario itself is degraded: a
+    non-bitwise loss or a tiered config that no longer fits under its own
+    cap means the scenario measured something broken, and a broken line
+    never gates (same philosophy as the parity-failed matrix lines)."""
+    block = (result.get("detail") or {}).get("offload")
+    if not isinstance(block, dict):
+        return None
+    if not block.get("loss_bitwise_identical") or not block.get("tiered_fits"):
+        return None
+    try:
+        return float((block.get("tiered") or {})["tokens_per_sec"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _offload_degraded(result: dict[str, Any]) -> str | None:
+    """Reason string when an offload block is present but unusable."""
+    block = (result.get("detail") or {}).get("offload")
+    if not isinstance(block, dict):
+        return None
+    if not block.get("loss_bitwise_identical"):
+        return "loss not bitwise identical"
+    if not block.get("tiered_fits"):
+        return "tiered config does not fit its own cap"
+    return None
+
+
 def compare(
     old: list[dict[str, Any]],
     new: list[dict[str, Any]],
@@ -192,6 +222,36 @@ def compare(
             skipped.append(
                 f"{key}: goodput ledger missing on the {side} side; "
                 "goodput_frac not compared"
+            )
+        # Offload scenario (detail.offload, bench.py): the TIERED run's
+        # tokens/s gates under the same noise bound — the offload ladder
+        # must stay competitive round-over-round, not just fit. Degraded
+        # blocks (loss not bitwise / tiered no longer fits) skip, and a
+        # block on only one side skips — same contract as goodput.
+        o_old, o_new = _offload_tps(prev), _offload_tps(result)
+        has_o_old = isinstance((prev.get("detail") or {}).get("offload"), dict)
+        has_o_new = isinstance((result.get("detail") or {}).get("offload"), dict)
+        o_reason = _offload_degraded(result)
+        if o_reason is not None:
+            skipped.append(
+                f"{key}: offload scenario degraded ({o_reason}); never gates"
+            )
+        if o_old is not None and o_new is not None:
+            o_entry = {
+                "scenario": key,
+                "metric": "offload_tiered_tokens_per_sec",
+                "old": o_old,
+                "new": o_new,
+                "ratio": o_new / o_old if o_old else float("inf"),
+            }
+            compared.append(o_entry)
+            if o_old > 0 and o_new < o_old * (1.0 - noise):
+                regressions.append(o_entry)
+        elif (has_o_old or has_o_new) and o_reason is None:
+            side = "old" if o_old is None else "new"
+            skipped.append(
+                f"{key}: offload scenario missing or degraded on the {side} "
+                "side; not compared"
             )
         # Tuned-plan drift: INFORM, never gate — a re-tune picking a
         # different winning plan between rounds is context for any
@@ -465,6 +525,90 @@ def _self_test() -> int:
         ),
     )
     assert not verdict["regressions"] and verdict["skipped"], "degraded parity line must skip"
+
+    # --- offload scenario gate (detail.offload) -----------------------
+    def with_offload(
+        result: dict[str, Any], tps: float, *, bitwise: bool = True, fits: bool = True
+    ) -> dict[str, Any]:
+        out = json.loads(json.dumps(result))
+        out["detail"]["offload"] = {
+            "tiers": "offload:0-0,full:1-1",
+            "hbm_cap_bytes": 100,
+            "baseline": {"tokens_per_sec": tps * 1.1, "predicted_hbm_bytes": 120},
+            "tiered": {"tokens_per_sec": tps, "predicted_hbm_bytes": 80},
+            "baseline_fits": False,
+            "tiered_fits": fits,
+            "loss_bitwise_identical": bitwise,
+        }
+        return out
+
+    o_base = with_offload(base, 100.0)
+    # Throughput flat but the tiered run collapsed: gates.
+    verdict = compare([o_base], [with_offload(variant(value=1000.0), 40.0)])
+    assert any(
+        r["metric"] == "offload_tiered_tokens_per_sec" for r in verdict["regressions"]
+    ), "offload throughput collapse must gate"
+    # A small wobble under the noise bound passes but is compared.
+    verdict = compare([o_base], [with_offload(variant(value=1000.0), 95.0)])
+    assert not verdict["regressions"], "offload wobble must pass"
+    assert any(
+        c["metric"] == "offload_tiered_tokens_per_sec" for c in verdict["compared"]
+    ), "offload pair must be compared"
+    # Non-bitwise loss marks the block degraded: skip, never gate.
+    verdict = compare(
+        [o_base], [with_offload(variant(value=1000.0), 40.0, bitwise=False)]
+    )
+    assert not verdict["regressions"], "degraded offload must not gate"
+    assert any(
+        "offload scenario degraded" in s for s in verdict["skipped"]
+    ), "degraded offload must note a skip"
+    # Tiered config no longer fitting its own cap = degraded too.
+    verdict = compare(
+        [o_base], [with_offload(variant(value=1000.0), 100.0, fits=False)]
+    )
+    assert not verdict["regressions"] and any(
+        "offload scenario degraded" in s for s in verdict["skipped"]
+    ), "cap-violating offload must skip"
+    # A block on only one side skips, never gates (scenario's first round).
+    verdict = compare([base], [with_offload(variant(value=1000.0), 100.0)])
+    assert not any(
+        r["metric"] == "offload_tiered_tokens_per_sec" for r in verdict["regressions"]
+    ), "one-sided offload must not gate"
+    assert any(
+        "offload scenario missing" in s for s in verdict["skipped"]
+    ), "one-sided offload must note a skip"
+    # Neither side carrying the block stays silent.
+    verdict = compare([base], [variant(value=980.0)])
+    assert not any("offload" in s for s in verdict["skipped"]), "no block, no note"
+
+    # --- parallelism matrix keys (fifth |par segment) ------------------
+    par_key = "dense|short|dense_ce|f32|ring-zero1"
+    par_parity = {"rtol": 2e-3, "max_rel_diff": 0.0, "ok": True}
+    old_par = round_({par_key: mline(1000.0, parity=par_parity)})
+    verdict = compare_matrix(
+        old_par, round_({par_key: mline(400.0, parity=par_parity)})
+    )
+    assert verdict["regressions"], "par matrix drop must gate"
+    # A parity-failed (degraded) par line skips, never compares.
+    verdict = compare_matrix(
+        old_par,
+        round_(
+            {
+                par_key: mline(
+                    980.0,
+                    degraded=True,
+                    fallback="loss parity vs dense failed: max rel diff 0.0100 > rtol 0.002",
+                    parity={"rtol": 2e-3, "max_rel_diff": 0.01, "ok": False},
+                )
+            }
+        ),
+    )
+    assert not verdict["regressions"] and verdict["skipped"], "parity-failed par line must skip"
+    # Budget-skipped par key notes instead of warning, like any matrix key.
+    verdict = compare_matrix(
+        old_par, round_({}, skipped=[{"scenario": par_key, "reason": "budget"}])
+    )
+    assert not any("WARNING" in n for n in verdict["notes"]), "par budget skip must not warn"
     print("perf_gate self-test: OK")
     return 0
 
