@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -143,6 +143,16 @@ verify-serving:
 # admitted under) that plain `make test` skips.
 verify-router:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
+
+# Promotion-lifecycle drill (docs/robustness.md "Canary, promote,
+# rollback"): ledger replay/idempotence, checkpoint-watch edge cases,
+# controller decision units — plus the @pytest.mark.slow chaos drill
+# (poisoned checkpoint canaried on a real 2-replica fleet, detected,
+# rolled back with zero failed requests and bitwise parity on the
+# admitted params; clean checkpoint promotes fleet-wide, every
+# transition durable in promotions.jsonl) that plain `make test` skips.
+verify-promote:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_promote.py -q
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

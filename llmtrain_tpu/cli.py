@@ -284,6 +284,73 @@ def build_parser() -> argparse.ArgumentParser:
         "record (k8s headless Service discovery; implies --router)",
     )
 
+    promote = sub.add_parser(
+        "promote",
+        help="continuous train→canary→promote lifecycle: watch a training "
+        "run's manifest stream, canary each new commit on one replica, "
+        "score it (eval loss + TTFT/per-token SLO soak), then promote "
+        "fleet-wide or auto-roll-back (lifecycle/, docs/robustness.md "
+        "'Canary, promote, rollback')",
+    )
+    promote.add_argument(
+        "--config", required=True, help="path to the YAML run config"
+    )
+    promote.add_argument(
+        "--watch",
+        required=True,
+        help="training run dir (or its checkpoints/ dir) whose manifest "
+        "stream to watch; promotions.jsonl is written next to the run's "
+        "other durable artifacts",
+    )
+    promote.add_argument(
+        "--from",
+        dest="from_spec",
+        default=None,
+        help="initial baseline checkpoint to serve (default: the last "
+        "promoted entry in promotions.jsonl, else the stream's newest "
+        "commit — promote waits for the first one if needed)",
+    )
+    promote.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="in-process fleet size (default: serving.router.replicas)",
+    )
+    promote.add_argument(
+        "--max-promotions",
+        type=int,
+        default=None,
+        help="stop after this many promotions (default: promote.max_promotions; "
+        "0 = run until the stream ends)",
+    )
+    promote.add_argument(
+        "--idle-timeout-sec",
+        type=float,
+        default=None,
+        help="exit after this long with no new commit and no training "
+        "heartbeat (default: promote.idle_timeout_sec)",
+    )
+    promote.add_argument(
+        "--no-eval",
+        action="store_true",
+        help="skip the held-out eval-loss gate (soak/SLO gates still run)",
+    )
+    promote.add_argument("--json", action="store_true", help="emit the result as JSON")
+    # Decode-stack flags shared with serve's loaders (promote keeps the
+    # defaults; the flags exist so _load_decode_params is reused as-is).
+    promote.set_defaults(
+        draft_config=None,
+        draft_from=None,
+        gamma=None,
+        backends=None,
+        discover=None,
+        decode_param_dtype="compute",
+        quantize="none",
+        ema=False,
+        mode="continuous",
+        router=True,
+    )
+
     bench = sub.add_parser(
         "serve-bench",
         help="seeded open-loop load generator against the continuous-"
@@ -1868,6 +1935,7 @@ def _handle_serve(args: argparse.Namespace) -> int:
             scheduler=scheduler,
             registry=registry,
             request_timeout_sec=cfg.serving.request_timeout_sec,
+            liveness_stale_sec=cfg.serving.liveness_stale_sec,
         )
 
         if mode == "continuous":
@@ -1943,6 +2011,242 @@ def _handle_serve(args: argparse.Namespace) -> int:
     finally:
         if scheduler is not None:
             scheduler.close()
+
+
+def _resolve_watch_dirs(watch: str) -> tuple[Path, Path]:
+    """``--watch`` path → (run_dir, ckpt_dir). Accepts the run dir (the
+    conventional layout puts checkpoints in ``{run_dir}/checkpoints``)
+    or the checkpoints dir itself."""
+    path = Path(watch)
+    if path.name == "checkpoints":
+        return path.parent, path
+    if (path / "checkpoints").is_dir() or not any(
+        p.name.startswith("step_") for p in (path.glob("step_*") if path.is_dir() else [])
+    ):
+        return path, path / "checkpoints"
+    # A dir holding step_* files directly IS the checkpoint dir.
+    return path.parent, path
+
+
+def _handle_promote(args: argparse.Namespace) -> int:
+    """Continuous train→canary→promote lifecycle (lifecycle/controller.py).
+
+    Watches the training run's manifest stream (durable artifacts only),
+    serves an in-process replica fleet from the promoted baseline,
+    canaries each new commit on one replica, scores it over a soak
+    window (held-out eval loss + TTFT/per-token percentiles, optional
+    A/B traffic split), then promotes fleet-wide via rolling reload or
+    auto-rolls the canary back. Every decision is a durable
+    ``promotions.jsonl`` entry the goodput ledger attributes.
+
+    Exit taxonomy: training finished (report.json) or the promotion
+    budget spent → 0; the training run dying mid-stream (stale
+    heartbeat, no report) → EXIT_TRAIN_FAILURE.
+    """
+    try:
+        cfg, _, _ = load_and_validate_config(args.config)
+    except ConfigLoadError as exc:
+        _emit_error(exc.message, details=exc.details, errors=exc.errors)
+        return EXIT_CONFIG_ERROR
+    lora_err = _lora_spec_error(cfg)
+    if lora_err is not None:
+        _emit_error(lora_err)
+        return EXIT_CONFIG_ERROR
+    pcfg = cfg.promote
+    overrides: dict[str, Any] = {}
+    if args.max_promotions is not None:
+        overrides["max_promotions"] = args.max_promotions
+    if args.idle_timeout_sec is not None:
+        overrides["idle_timeout_sec"] = args.idle_timeout_sec
+    if overrides:
+        pcfg = pcfg.model_copy(update=overrides)
+
+    run_dir, ckpt_dir = _resolve_watch_dirs(args.watch)
+    if not run_dir.is_dir():
+        _emit_error(f"--watch run dir not found: {run_dir}")
+        return EXIT_CONFIG_ERROR
+
+    configure_platform(cfg.run.device)
+    configure_compilation_cache(cfg.run.compilation_cache_dir)
+    configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
+    logger = get_logger()
+    router = None
+    timeline = None
+    try:
+        from .lifecycle import (
+            CheckpointWatcher,
+            PromotionController,
+            PromotionLedger,
+            RouterFleet,
+        )
+
+        initialize_registries()
+        ledger = PromotionLedger(run_dir / "promotions.jsonl")
+        watcher = CheckpointWatcher(ckpt_dir, run_dir=run_dir)
+
+        # Baseline: the last promoted checkpoint (ledger replay — a
+        # SIGKILLed promote resumes where it decided, never re-promotes),
+        # else --from, else the stream's first commit (waited for).
+        spec = None
+        promoted = ledger.last_promoted()
+        if promoted and promoted.get("checkpoint") and Path(
+            promoted["checkpoint"]
+        ).exists():
+            spec = promoted["checkpoint"]
+            logger.info(
+                "promote: resuming from ledger — step %d is the baseline",
+                promoted["step"],
+            )
+        elif args.from_spec:
+            spec = args.from_spec
+        else:
+            deadline = time.monotonic() + pcfg.idle_timeout_sec
+            while spec is None:
+                polled = watcher.poll(after_step=-1)
+                if polled is not None:
+                    spec = str(polled[0])
+                    break
+                if time.monotonic() > deadline:
+                    _emit_error(
+                        f"promote: no committed checkpoint appeared in "
+                        f"{ckpt_dir} within {pcfg.idle_timeout_sec:.0f}s"
+                    )
+                    return EXIT_TRAIN_FAILURE
+                time.sleep(pcfg.poll_sec)
+
+        adapter, tokenizer, model = _build_decode_stack(cfg, logger)
+        model, params, ckpt_path, step = _load_decode_params(
+            cfg,
+            adapter,
+            model,
+            str(spec),
+            ema=args.ema,
+            decode_param_dtype=args.decode_param_dtype,
+            quantize=args.quantize,
+            logger=logger,
+            label="promote ",
+        )
+        router, registry = _build_router_backend(cfg, args, model, params, logger)
+        if len(router.replicas) < 2:
+            logger.warning(
+                "promote: a 1-replica fleet has no reference replica — "
+                "the SLO A/B gate is skipped (only failures and eval "
+                "loss gate promotion)"
+            )
+
+        def load_params(ckpt: Path) -> Any:
+            _, p, _, _ = _load_decode_params(
+                cfg,
+                adapter,
+                model,
+                str(ckpt),
+                ema=args.ema,
+                decode_param_dtype=args.decode_param_dtype,
+                quantize=args.quantize,
+                logger=logger,
+                label="candidate ",
+            )
+            return p
+
+        evaluator = None
+        if not args.no_eval:
+            from .tracking.base import NullTracker
+            from .training.trainer import Trainer
+
+            eval_trainer = Trainer(cfg, run_dir=None, tracker=NullTracker())
+
+            def evaluator(ckpt: Path) -> float | None:
+                metrics = eval_trainer.evaluate(resume_from=str(ckpt))
+                if metrics is None:
+                    return None
+                return float(metrics["val/loss"])
+
+        if cfg.telemetry.enabled and cfg.telemetry.timeline:
+            from .telemetry.timeline import EventTimeline
+
+            tdir = run_dir / "telemetry"
+            tdir.mkdir(parents=True, exist_ok=True)
+            # Separate file: appending promote segments into the
+            # trainer's timeline.jsonl would corrupt the goodput
+            # ledger's segment accounting.
+            timeline = EventTimeline(
+                tdir / "promote_timeline.jsonl",
+                max_events=cfg.telemetry.max_events,
+                xprof_annotations=False,
+            )
+
+        fleet = RouterFleet(
+            router,
+            vocab_size=model.vocab_size,
+            max_new_tokens=min(8, cfg.serving.max_new_tokens_cap),
+        )
+        try:
+            controller = PromotionController(
+                cfg=pcfg,
+                watcher=watcher,
+                fleet=fleet,
+                ledger=ledger,
+                baseline_params=params,
+                baseline_step=step,
+                baseline_checkpoint=str(ckpt_path),
+                load_params=load_params,
+                evaluator=evaluator,
+                registry=registry,
+                timeline=timeline,
+            )
+        except ValueError as exc:
+            _emit_error(str(exc))
+            return EXIT_CONFIG_ERROR
+        result = controller.run()
+
+        payload = {
+            "status": result.status,
+            "promotions": result.promotions,
+            "rollbacks": result.rollbacks,
+            "aborts": result.aborts,
+            "last_promoted_step": result.last_promoted_step,
+            "ledger": str(ledger.path),
+        }
+        if args.json:
+            print(json.dumps(payload))
+        else:
+            print(
+                f"promote: {result.status} — {result.promotions} promoted, "
+                f"{result.rollbacks} rolled back, {result.aborts} aborted "
+                f"(serving step {result.last_promoted_step}); "
+                f"ledger {ledger.path}"
+            )
+        if result.status == "training_dead":
+            # The watched run died mid-stream: surface it on the exit
+            # taxonomy so a supervisor treats promote like the trainer.
+            return EXIT_TRAIN_FAILURE
+        return EXIT_OK
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        _emit_error(f"promote failed: {exc}")
+        return exit_code_for_exception(exc)
+    finally:
+        if timeline is not None:
+            try:
+                timeline.flush()
+            except Exception:  # noqa: BLE001 — best-effort telemetry
+                pass
+        if router is not None:
+            try:
+                from .telemetry.prometheus import render_prometheus
+
+                tdir = run_dir / "telemetry"
+                tdir.mkdir(parents=True, exist_ok=True)
+                (tdir / "promote_metrics.prom").write_text(
+                    render_prometheus(
+                        dict(router.registry.latest()),
+                        router.registry.counters(),
+                        {"component": "promote"},
+                    ),
+                    encoding="utf-8",
+                )
+            except Exception:  # noqa: BLE001 — best-effort telemetry
+                pass
+            router.close()
 
 
 def _handle_serve_bench(args: argparse.Namespace) -> int:
@@ -3222,6 +3526,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_serve(args)
     if args.command == "serve-bench":
         return _handle_serve_bench(args)
+    if args.command == "promote":
+        return _handle_promote(args)
     if args.command == "eval":
         return _handle_eval(args)
     if args.command == "train-tokenizer":

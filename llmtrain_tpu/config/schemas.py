@@ -549,6 +549,9 @@ class ServingConfig(BaseModel):
     default_max_new_tokens: int = Field(48, ge=1)
     # Handler threads give up on a queued request after this long.
     request_timeout_sec: float = Field(120.0, gt=0.0)
+    # /healthz turns 503 when the scheduler loop's step beacon is older
+    # than this (or the thread is dead) — the k8s livenessProbe contract.
+    liveness_stale_sec: float = Field(30.0, gt=0.0)
 
     model_config = _STRICT
 
@@ -778,6 +781,51 @@ class TuneConfig(BaseModel):
         return self
 
 
+class PromoteConfig(BaseModel):
+    """Promotion-lifecycle knobs (llmtrain_tpu/lifecycle/, ``llmtrain
+    promote``, docs/robustness.md "Canary, promote, rollback").
+
+    The controller watches a training run's manifest stream
+    (``latest_valid_checkpoint`` polling — durable artifacts only, the
+    goodput stance), canaries every new commit on one designated replica,
+    scores it over a soak window, then promotes fleet-wide or rolls the
+    canary back. All gates are regression DELTAS against the previously
+    promoted baseline, so the loop needs no absolute SLO numbers.
+    """
+
+    # Manifest-stream poll cadence on the watched run dir.
+    poll_sec: float = Field(2.0, gt=0.0)
+    # No new commit AND no training heartbeat for this long → the run is
+    # presumed finished/dead and promote exits (taxonomy code).
+    idle_timeout_sec: float = Field(600.0, gt=0.0)
+    # Replica index that receives canary swaps (the rest keep serving
+    # the promoted params).
+    canary_replica: int = Field(0, ge=0)
+    # Live-traffic fraction the router steers to the canary during the
+    # soak (A/B split at the placement layer). 0 = synthetic soak probes
+    # only, live traffic never touches the canary.
+    traffic_split: float = Field(0.0, ge=0.0, le=1.0)
+    # Synthetic soak probes the controller sends to the canary replica to
+    # populate TTFT / per-token reservoirs before judging.
+    soak_requests: int = Field(16, ge=1)
+    soak_timeout_sec: float = Field(120.0, gt=0.0)
+    soak_seed: int = 0
+    # Gate 1 — eval regression: candidate held-out loss may exceed the
+    # promoted baseline's by at most this much.
+    max_eval_loss_delta: float = Field(0.05, ge=0.0)
+    # Gate 2 — SLO regression: canary p95 TTFT / p99 per-token latency
+    # may exceed the baseline percentile by at most this factor (2.0 =
+    # twice as slow). None disables the bound.
+    ttft_p95_slowdown: float | None = Field(2.0, gt=1.0)
+    per_token_p99_slowdown: float | None = Field(2.0, gt=1.0)
+    # Any soak-window failed/timed-out canary request fails the gate.
+    allow_failed_requests: int = Field(0, ge=0)
+    # Stop after this many promotions (0 = run until the stream ends).
+    max_promotions: int = Field(0, ge=0)
+
+    model_config = _STRICT
+
+
 class RunConfig(BaseModel):
     """Top-level schema tying every section into one executable run.
 
@@ -798,5 +846,6 @@ class RunConfig(BaseModel):
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     output: OutputConfig = Field(default_factory=OutputConfig)
     tune: TuneConfig = Field(default_factory=TuneConfig)
+    promote: PromoteConfig = Field(default_factory=PromoteConfig)
 
     model_config = _STRICT

@@ -163,6 +163,9 @@ class ServerState:
     # applies scheduler.hot_swap()/router.rolling_reload(), and returns
     # the response dict (the CLI builds the closure). None = 404.
     reloader: Any | None = None
+    # /healthz turns 503 when the scheduler loop's beacon is older than
+    # this (serving.liveness_stale_sec) — the k8s livenessProbe contract.
+    liveness_stale_sec: float = 30.0
 
     @property
     def requests_served(self) -> int:
@@ -353,6 +356,10 @@ def _handle_reload(state: ServerState, body: dict) -> tuple[int, dict]:
 
 
 def _handle_health(state: ServerState) -> tuple[int, dict]:
+    """Liveness + stats. Parity with the training watchdog: a dead or
+    wedged scheduler loop answers 503 (k8s livenessProbe restarts the
+    pod) instead of serving stale-but-200 stats forever. A router in the
+    scheduler seat is unhealthy when its whole fleet is evicted."""
     payload: dict[str, Any] = {
         "status": "ok",
         "model": type(state.model).__name__,
@@ -363,6 +370,17 @@ def _handle_health(state: ServerState) -> tuple[int, dict]:
     }
     if state.scheduler is not None:
         payload["scheduler"] = state.scheduler.stats()
+        alive_fn = getattr(state.scheduler, "alive", None)
+        if alive_fn is not None:
+            alive = bool(alive_fn(state.liveness_stale_sec))
+        else:
+            healthy = (
+                payload["scheduler"].get("router", {}).get("replicas_healthy")
+            )
+            alive = healthy is None or healthy > 0
+        if not alive:
+            payload["status"] = "unhealthy"
+            return 503, payload
     return 200, payload
 
 

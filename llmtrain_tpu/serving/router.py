@@ -38,6 +38,7 @@ path as the training gauges).
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -258,6 +259,11 @@ class _ReplicaState:
         self.evicted_at = 0.0
         self.routed = 0
         self.failures = 0
+        # Revival-probe bookkeeping: each FAILED probe doubles the wait
+        # before the next one (capped), so a dead replica is not
+        # re-probed on every placement call.
+        self.revive_backoff = 1.0
+        self.revive_probes = 0
 
 
 class ReplicaRouter:
@@ -302,6 +308,13 @@ class ReplicaRouter:
         self.requests_routed = 0
         self.affinity_routed = 0  # placements decided by a prefix match
         self.failovers = 0
+        # Canary A/B split (lifecycle/controller.py): while set, a seeded
+        # fraction of live traffic is steered to the canary replica and
+        # the rest of the fleet never sees it in placement.
+        self._canary_idx: int | None = None
+        self._canary_frac = 0.0
+        self._canary_rng = random.Random(0)
+        self.canary_routed = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -324,16 +337,42 @@ class ReplicaRouter:
                 return engine
         return None
 
+    # Failed revival probes back off exponentially up to this multiple
+    # of revive_sec — a permanently dead replica costs one probe per
+    # _REVIVE_BACKOFF_CAP * revive_sec, not one per placement call.
+    _REVIVE_BACKOFF_CAP = 16.0
+
     def _healthy_indices(self) -> list[int]:
         now = time.monotonic()
         out = []
         for i, s in enumerate(self._states):
-            if not s.healthy and now - s.evicted_at >= self.revive_sec:
-                # Revival probe: one cheap healthcheck, not a request.
+            if (
+                not s.healthy
+                and now - s.evicted_at >= self.revive_sec * s.revive_backoff
+            ):
+                # Revival probe: one cheap REAL health check (HTTP
+                # /healthz for remote replicas, scheduler-thread-alive
+                # for in-process ones), not a request. Elapsed time
+                # alone never reinstates a replica.
+                s.revive_probes += 1
                 if s.replica.healthcheck():
                     logger.info("router: replica %s revived", s.replica.name)
                     s.healthy = True
                     s.consecutive_failures = 0
+                    s.revive_backoff = 1.0
+                else:
+                    # Still dead: stay evicted, restart the clock and
+                    # widen the probe interval.
+                    s.evicted_at = now
+                    s.revive_backoff = min(
+                        s.revive_backoff * 2.0, self._REVIVE_BACKOFF_CAP
+                    )
+                    logger.warning(
+                        "router: replica %s failed revival probe %d; next "
+                        "probe in %.1fs",
+                        s.replica.name, s.revive_probes,
+                        self.revive_sec * s.revive_backoff,
+                    )
             if s.healthy:
                 out.append(i)
         return out
@@ -371,11 +410,51 @@ class ReplicaRouter:
         while len(self._affinity) > self.max_affinity_entries:
             self._affinity.popitem(last=False)
 
+    # -------------------------------------------------------------- canary
+
+    def set_canary(
+        self, idx: int, *, traffic_frac: float = 0.0, seed: int = 0
+    ) -> None:
+        """Mark replica ``idx`` as the canary: a seeded ``traffic_frac``
+        of live requests is steered to it; the rest of the fleet serves
+        everything else (the A/B split of the promote soak window).
+        With ``traffic_frac=0`` the canary is simply excluded from
+        placement — only the controller's synthetic probes reach it."""
+        if not 0 <= idx < len(self._states):
+            raise ValueError(f"router: no replica index {idx}")
+        if not 0.0 <= traffic_frac <= 1.0:
+            raise ValueError("traffic_frac must be in [0, 1]")
+        with self._lock:
+            self._canary_idx = idx
+            self._canary_frac = float(traffic_frac)
+            self._canary_rng = random.Random(seed)
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary_idx = None
+            self._canary_frac = 0.0
+
+    @property
+    def canary_index(self) -> int | None:
+        return self._canary_idx
+
     def select(self, prompt_ids: np.ndarray) -> int:
         """Pick the replica index for a prompt (placement only, no
         dispatch — exposed for tests and dry-runs). Raises RuntimeError
         when every replica is evicted."""
         healthy = self._healthy_indices()
+        canary = self._canary_idx
+        if canary is not None and canary in healthy and len(healthy) > 1:
+            if self._canary_frac > 0 and (
+                self._canary_rng.random() < self._canary_frac
+            ):
+                # A/B split: this request is the canary's.
+                with self._lock:
+                    self.requests_routed += 1
+                    self.canary_routed += 1
+                    self._states[canary].routed += 1
+                return canary
+            healthy = [i for i in healthy if i != canary]
         if not healthy:
             raise RuntimeError("router: no healthy replicas")
         hashes = chain_hashes(
@@ -441,6 +520,10 @@ class ReplicaRouter:
         self, req: ServeRequest, *, exclude: set[int], cause: Exception
     ) -> ServeRequest:
         healthy = [i for i in self._healthy_indices() if i not in exclude]
+        if self._canary_idx is not None and len(healthy) > 1:
+            # Never fail live traffic over onto an unproven canary while
+            # a proven replica remains.
+            healthy = [i for i in healthy if i != self._canary_idx] or healthy
         if not healthy:
             raise RuntimeError(
                 f"router: no healthy replica left for failover ({cause})"
@@ -497,6 +580,25 @@ class ReplicaRouter:
                 results.append({"replica": s.replica.name, "error": str(exc)})
         return results
 
+    def reload_replica(
+        self,
+        idx: int,
+        *,
+        params: Any | None = None,
+        step: int | None = None,
+        checkpoint: str | None = None,
+    ) -> dict[str, Any]:
+        """Hot-swap ONE replica (the canary path: swap a candidate in,
+        or roll it back to the promoted baseline). Raises on failure —
+        the caller decides whether that aborts a canary or triggers a
+        fleet rollback."""
+        if not 0 <= idx < len(self._states):
+            raise ValueError(f"router: no replica index {idx}")
+        s = self._states[idx]
+        result = s.replica.reload(params=params, step=step, checkpoint=checkpoint)
+        self._note_success(idx)
+        return result
+
     # ----------------------------------------------------------- telemetry
 
     def stats(self) -> dict[str, Any]:
@@ -515,7 +617,8 @@ class ReplicaRouter:
         }
         policy = None
         prefix_hits = prefix_queries = prefix_hit_queries = prefix_tokens = 0
-        for s in self._states:
+        fleet_steps: set[Any] = set()
+        for i, s in enumerate(self._states):
             rs = s.replica.stats() if s.healthy else {"evicted": True}
             policy = policy or rs.get("policy")
             for k in agg:
@@ -527,13 +630,27 @@ class ReplicaRouter:
             prefix_queries += pool.get("prefix_queries", 0)
             prefix_hit_queries += pool.get("prefix_hit_queries", 0)
             prefix_tokens += pool.get("prefix_tokens_reused", 0)
+            # Param identity: which checkpoint this replica is ADMITTING
+            # on right now. step is comparable fleet-wide; epoch is the
+            # replica-local swap counter.
+            params_blk = rs.get("params") or {}
+            param_step = params_blk.get("step")
+            param_epoch = params_blk.get("epoch")
+            if s.healthy and (param_step is not None or param_epoch is not None):
+                fleet_steps.add(
+                    param_step if param_step is not None
+                    else f"epoch:{i}:{param_epoch}"
+                )
             per_replica.append(
                 {
                     "name": s.replica.name,
                     "healthy": s.healthy,
                     "routed": s.routed,
                     "failures": s.failures,
+                    "revive_probes": s.revive_probes,
                     "load": s.replica.load() if s.healthy else None,
+                    "param_epoch": param_epoch,
+                    "param_step": param_step,
                     "stats": rs,
                 }
             )
@@ -548,6 +665,16 @@ class ReplicaRouter:
             "affinity_entries": len(self._affinity),
             "failovers": self.failovers,
             "affinity_weight": self.affinity_weight,
+            # Distinct param steps healthy replicas are serving, minus
+            # one: 0 = a converged fleet, >0 = a mixed-epoch fleet (mid
+            # rollout, or a partially failed one — the promote
+            # controller's fleet-rollback trigger).
+            "epoch_divergence": max(0, len(fleet_steps) - 1),
+            "canary": {
+                "index": self._canary_idx,
+                "traffic_frac": self._canary_frac,
+                "routed": self.canary_routed,
+            },
             "fleet_prefix": {
                 "hits": prefix_hits,
                 "queries": prefix_queries,
@@ -576,12 +703,22 @@ class ReplicaRouter:
             ),
             "router/queue_depth": float(stats["queue_depth"]),
             "router/active_sequences": float(stats["active_sequences"]),
+            "router/epoch_divergence": float(r["epoch_divergence"]),
+            "router/canary_routed": float(r["canary"]["routed"]),
         }
         for i, rep in enumerate(r["replicas"]):
             gauges[f"router/replica{i}_healthy"] = float(bool(rep["healthy"]))
             gauges[f"router/replica{i}_routed"] = float(rep["routed"])
             if rep["load"] is not None:
                 gauges[f"router/replica{i}_load"] = float(rep["load"])
+            if rep["param_epoch"] is not None:
+                gauges[f"router/replica{i}_param_epoch"] = float(
+                    rep["param_epoch"]
+                )
+            if rep["param_step"] is not None:
+                gauges[f"router/replica{i}_param_step"] = float(
+                    rep["param_step"]
+                )
             occ = rep["stats"].get("active_sequences")
             if isinstance(occ, (int, float)):
                 gauges[f"router/replica{i}_active_sequences"] = float(occ)

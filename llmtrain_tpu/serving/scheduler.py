@@ -206,6 +206,10 @@ class ContinuousBatchingScheduler:
         self._prefilling: list[_Row] = []
         self._closed = False
         self._thread: threading.Thread | None = None
+        # Liveness beacon: monotonic time of the loop thread's last
+        # iteration. /healthz turns 503 when this goes stale — the same
+        # stance the training watchdog takes on the heartbeat file.
+        self._beacon = time.monotonic()
 
         # Param epochs (checkpoint hot-swap). Epoch 0 is the params the
         # scheduler was built with; hot_swap() appends. Old epochs stay
@@ -1014,6 +1018,12 @@ class ContinuousBatchingScheduler:
             "hot_swaps": self.hot_swaps,
             "live_epochs": sorted(self._params_by_epoch),
         }
+        out["liveness"] = {
+            "thread_alive": (
+                self._thread.is_alive() if self._thread is not None else None
+            ),
+            "beacon_age_sec": round(time.monotonic() - self._beacon, 3),
+        }
         if self.engine is not None:
             out["kv_pool"] = self.engine.pool.stats()
             out["compile"] = self.engine.compile_stats()
@@ -1040,9 +1050,21 @@ class ContinuousBatchingScheduler:
             out["speculative"] = spec
         return out
 
+    def alive(self, stale_sec: float = 30.0) -> bool:
+        """Liveness truth for ``/healthz``: the loop thread is running
+        and iterated within ``stale_sec``. A scheduler that was never
+        ``start()``-ed (tests drive ``step()`` directly) counts alive —
+        there is no loop to be dead."""
+        if self._thread is None:
+            return True
+        if not self._thread.is_alive():
+            return False
+        return time.monotonic() - self._beacon <= float(stale_sec)
+
     def run_forever(self, poll_sec: float = 0.005) -> None:
         """Scheduler loop body for the background thread."""
         while True:
+            self._beacon = time.monotonic()
             with self._wake:
                 idle = (
                     not self._queue

@@ -297,7 +297,35 @@ def compute_goodput(
             "suspension_windows": len(windows),
         },
     }
+    promotions = _promotions_block(run_dir)
+    if promotions is not None:
+        ledger["promotions"] = promotions
     return ledger
+
+
+def _promotions_block(run_dir: Path) -> dict[str, Any] | None:
+    """Promotion-lifecycle attribution: when ``llmtrain promote`` watched
+    this run, its ``promotions.jsonl`` is one more durable artifact —
+    the ledger reports which committed steps were canaried and what was
+    decided, on the run's own wall-clock timeline."""
+    path = run_dir / "promotions.jsonl"
+    if not path.is_file():
+        return None
+    from ..lifecycle.ledger import PromotionLedger
+
+    ledger = PromotionLedger(path)
+    summary = ledger.summary()
+    events = [
+        {
+            "ts_unix": round(float(e.get("ts_unix", 0.0)), 3),
+            "decision": e["decision"],
+            "step": e["step"],
+            "reason": e.get("reason"),
+        }
+        for e in ledger.entries()
+    ]
+    summary["events"] = events
+    return summary
 
 
 def render_goodput_md(ledger: dict[str, Any]) -> str:
@@ -333,6 +361,22 @@ def render_goodput_md(ledger: dict[str, Any]) -> str:
             f"{c['recomputed']} | {c['restart_overhead']} | "
             f"{seg['clean_end']} |"
         )
+    promos = ledger.get("promotions")
+    if promos is not None:
+        d = promos["decisions"]
+        lines += [
+            "",
+            f"- promotions: {d['promote']} promoted, {d['rollback']} rolled "
+            f"back, {d['abort']} aborted of {d['canary_start']} canaried"
+            + (
+                f"; serving step {promos['last_promoted_step']}"
+                if promos.get("last_promoted_step") is not None
+                else ""
+            ),
+        ]
+        for e in promos.get("events", []):
+            reason = f" ({e['reason']})" if e.get("reason") else ""
+            lines.append(f"  - step {e['step']}: {e['decision']}{reason}")
     return "\n".join(lines) + "\n"
 
 
@@ -346,6 +390,12 @@ def goodput_gauges(ledger: dict[str, Any]) -> dict[str, float]:
     }
     for cat in CATEGORIES:
         out[f"goodput/{cat}_sec"] = float(ledger["categories"].get(cat, 0.0))
+    promos = ledger.get("promotions")
+    if promos is not None:
+        for decision, count in promos["decisions"].items():
+            out[f"goodput/promotions_{decision}"] = float(count)
+        if promos.get("last_promoted_step") is not None:
+            out["goodput/promoted_step"] = float(promos["last_promoted_step"])
     return out
 
 

@@ -413,6 +413,25 @@ class TestServeManifest:
             assert probe["httpGet"]["path"] == "/healthz"
         assert ctr["livenessProbe"]["initialDelaySeconds"] >= 60
 
+    def test_liveness_probe_covers_the_staleness_window(self, manifests):
+        """/healthz 503s once the scheduler beacon exceeds
+        serving.liveness_stale_sec (serving/http.py) — the probe budget
+        (period x failureThreshold) must EXCEED that window so the
+        server declares itself unhealthy before the kubelet acts, and
+        the restart is attributable to the 503, not a race."""
+        (dep,) = _by_kind(manifests["serve.yaml"], "Deployment")
+        (ctr,) = dep["spec"]["template"]["spec"]["containers"]
+        liveness = ctr["livenessProbe"]
+        budget = liveness["periodSeconds"] * liveness["failureThreshold"]
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            if "serve.yaml" in cm.get("data", {}):
+                serving = yaml.safe_load(cm["data"]["serve.yaml"])["serving"]
+                stale = serving["liveness_stale_sec"]
+                assert stale < budget, (
+                    f"liveness_stale_sec ({stale}) must be under the probe "
+                    f"kill budget ({budget}s)"
+                )
+
     def test_prometheus_annotations_point_at_the_serve_port(self, manifests):
         """The inference server exposes llmtrain_serve_* on its OWN HTTP
         port (serving/http.py /metrics) — the scrape annotation must
@@ -522,6 +541,12 @@ class TestRouterManifest:
                 assert ctr[probe_name]["httpGet"]["path"] == "/healthz"
             # Cold-cache compiles must not be probe-killed.
             assert ctr["livenessProbe"]["initialDelaySeconds"] >= 60
+            # /healthz is a real liveness signal (503 on dead/stale
+            # scheduler loop, 503 on a fully evicted router fleet) —
+            # pin the kill budget the 503 contract was sized against.
+            liveness = ctr["livenessProbe"]
+            assert liveness["failureThreshold"] >= 2
+            assert liveness["periodSeconds"] * liveness["failureThreshold"] >= 60
 
 
 class TestAssertTelemetryArtifacts:

@@ -1,0 +1,910 @@
+"""Promotion lifecycle (llmtrain_tpu/lifecycle/ + `llmtrain promote`).
+
+Tier-1 keeps to pure units — ledger append/replay/torn-tail semantics,
+checkpoint-watch edge cases against a real CheckpointManager (manifest
+published mid-poll, pre-manifest adoption, heartbeat liveness), the
+controller's full decision surface over fakes (promote, eval/SLO/soak
+rollback, abort, partial-fleet-swap fleet rollback, SIGKILL-replay
+idempotence), the /healthz 503 contract, and goodput attribution of the
+promotions ledger. The chaos drill that compiles the tiny model — a
+poisoned checkpoint canaried on a real 2-replica fleet, detected and
+rolled back under live traffic with bitwise parity on admitted params,
+then a clean checkpoint promoted fleet-wide — runs under
+``@pytest.mark.slow`` via ``make verify-promote``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from llmtrain_tpu.config.schemas import PromoteConfig
+from llmtrain_tpu.lifecycle import (
+    CheckpointWatcher,
+    PromotionController,
+    PromotionLedger,
+    RouterFleet,
+    TERMINAL_DECISIONS,
+)
+
+# ---------------------------------------------------------------------------
+# promotions.jsonl: append / replay / crash semantics
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionLedger:
+    def test_append_assigns_seq_and_fsyncs_one_line_each(self, tmp_path):
+        ledger = PromotionLedger(tmp_path / "promotions.jsonl")
+        ledger.append("canary_start", step=10, checkpoint="a.ckpt")
+        ledger.append("promote", step=10, checkpoint="a.ckpt", scores={"x": 1})
+        entries = ledger.entries()
+        assert [e["seq"] for e in entries] == [0, 1]
+        assert entries[1]["scores"] == {"x": 1}
+        # A fresh reader resumes the seq counter, never reuses one.
+        again = PromotionLedger(ledger.path)
+        again.append("canary_start", step=20)
+        assert again.entries()[-1]["seq"] == 2
+
+    def test_unknown_decision_refused(self, tmp_path):
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        with pytest.raises(ValueError, match="unknown promotion decision"):
+            ledger.append("demote", step=1)
+
+    def test_torn_tail_line_is_skipped_not_fatal(self, tmp_path):
+        """A SIGKILL mid-write leaves at worst one torn trailing line;
+        replay must skip it and keep every committed decision."""
+        path = tmp_path / "promotions.jsonl"
+        ledger = PromotionLedger(path)
+        ledger.append("canary_start", step=5)
+        ledger.append("rollback", step=5, reason="eval_regression")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"seq": 2, "decision": "prom')  # torn mid-json
+        replay = PromotionLedger(path)
+        assert [e["decision"] for e in replay.entries()] == [
+            "canary_start", "rollback",
+        ]
+        assert replay.decided_steps() == {5}
+
+    def test_decided_steps_are_terminal_only(self, tmp_path):
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        ledger.append("canary_start", step=5)
+        ledger.append("rollback", step=5, reason="slo")
+        ledger.append("canary_start", step=9)
+        ledger.append("promote", step=9)
+        ledger.append("canary_start", step=12)  # open — still being judged
+        assert ledger.decided_steps() == {5, 9}
+        assert "canary_start" not in TERMINAL_DECISIONS
+
+    def test_pending_canary_is_the_unclosed_window(self, tmp_path):
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        assert ledger.pending_canary() is None
+        ledger.append("canary_start", step=5)
+        ledger.append("abort", step=5, reason="load failed")
+        assert ledger.pending_canary() is None  # closed by a terminal
+        ledger.append("canary_start", step=9)
+        pending = ledger.pending_canary()
+        assert pending is not None and pending["step"] == 9
+
+    def test_last_promoted_and_summary(self, tmp_path):
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        ledger.append("canary_start", step=5)
+        ledger.append("promote", step=5, checkpoint="s5.ckpt")
+        ledger.append("canary_start", step=9)
+        ledger.append("rollback", step=9, reason="eval")
+        assert ledger.last_promoted()["checkpoint"] == "s5.ckpt"
+        s = ledger.summary()
+        assert s["entries"] == 4
+        assert s["decisions"] == {
+            "canary_start": 2, "promote": 1, "rollback": 1, "abort": 0,
+        }
+        assert s["last_promoted_step"] == 5
+        assert s["last_promoted_checkpoint"] == "s5.ckpt"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-stream watcher: durable-artifact edge cases
+# ---------------------------------------------------------------------------
+
+
+def _host_state() -> dict:
+    return {
+        "params": {"w": np.arange(4, dtype=np.float32)},
+        "opt_state": {"m": np.zeros(4, dtype=np.float32)},
+    }
+
+
+def _commit(ckpt_dir: Path, step: int) -> Path:
+    from llmtrain_tpu.training.checkpoint import CheckpointManager
+
+    return CheckpointManager(ckpt_dir, keep_last_k=10).save_host(
+        step, _host_state(), {}
+    )
+
+
+class TestCheckpointWatcher:
+    def test_empty_dir_polls_none(self, tmp_path):
+        watcher = CheckpointWatcher(tmp_path / "run" / "checkpoints")
+        assert watcher.poll() is None
+
+    def test_poll_sees_commits_and_respects_the_floor(self, tmp_path):
+        ckpt_dir = tmp_path / "checkpoints"
+        _commit(ckpt_dir, 10)
+        watcher = CheckpointWatcher(ckpt_dir)
+        ckpt, step = watcher.poll()
+        assert step == 10 and ckpt.name == "step_000010.ckpt"
+        assert watcher.poll(after_step=10) is None
+        # A manifest published mid-poll appears atomically on the next
+        # poll — and the HEAD of the stream wins, intermediate commits
+        # that landed while a candidate soaked are skipped.
+        _commit(ckpt_dir, 20)
+        _commit(ckpt_dir, 30)
+        ckpt, step = watcher.poll(after_step=10)
+        assert step == 30
+
+    def test_uncommitted_stage_is_invisible(self, tmp_path):
+        """A payload whose manifest rename has not landed yet (the
+        trainer mid-save, or a kill inside the write window) must never
+        be offered as a candidate."""
+        ckpt_dir = tmp_path / "checkpoints"
+        _commit(ckpt_dir, 10)
+        staged = _commit(ckpt_dir, 20)
+        from llmtrain_tpu.training.checkpoint import manifest_path
+
+        manifest_path(staged).unlink()  # 20 is now an uncommitted stage
+        watcher = CheckpointWatcher(ckpt_dir)
+        ckpt, step = watcher.poll()
+        assert step == 10, "uncommitted stage leaked into selection"
+
+    def test_pre_manifest_dir_is_adopted(self, tmp_path):
+        """A run dir holding only pre-manifest checkpoints (legacy
+        layout / hand-assembled snapshot) is adopted by its first scan
+        and its newest verifying payload becomes the candidate."""
+        from llmtrain_tpu.training.checkpoint import manifest_path
+
+        ckpt_dir = tmp_path / "checkpoints"
+        a = _commit(ckpt_dir, 5)
+        b = _commit(ckpt_dir, 8)
+        manifest_path(a).unlink()
+        manifest_path(b).unlink()
+        watcher = CheckpointWatcher(ckpt_dir)
+        ckpt, step = watcher.poll()
+        assert step == 8
+        # Adoption synthesized a manifest: the next scan is manifest-driven.
+        assert manifest_path(b).is_file()
+
+    def test_finished_and_heartbeat_liveness(self, tmp_path):
+        run_dir = tmp_path / "run"
+        ckpt_dir = run_dir / "checkpoints"
+        ckpt_dir.mkdir(parents=True)
+        watcher = CheckpointWatcher(ckpt_dir, run_dir=run_dir)
+        assert not watcher.training_finished()
+        # No heartbeat at all counts dead: a static adopted snapshot
+        # drains its head commit, then promote exits instead of waiting.
+        assert watcher.heartbeat_age_sec() is None
+        assert not watcher.training_alive(stale_sec=3600.0)
+        hb = run_dir / "heartbeat"
+        hb.write_text("1")
+        assert watcher.training_alive(stale_sec=60.0)
+        # Stale heartbeat: mtime pushed into the past.
+        old = time.time() - 120.0
+        os.utime(hb, (old, old))
+        assert not watcher.training_alive(stale_sec=60.0)
+        assert watcher.heartbeat_age_sec() >= 100.0
+        # Per-rank heartbeat.rN files count too; freshest wins.
+        (run_dir / "heartbeat.r1").write_text("1")
+        assert watcher.training_alive(stale_sec=60.0)
+        (run_dir / "report.json").write_text("{}")
+        assert watcher.training_finished()
+
+
+# ---------------------------------------------------------------------------
+# controller decision surface over fakes
+# ---------------------------------------------------------------------------
+
+
+_SOAK_OK = {
+    "requests": 4, "completed": 4, "failed": 0, "timed_out": 0,
+    "ttft_p50_ms": 8.0, "ttft_p95_ms": 10.0,
+    "per_token_p50_ms": 4.0, "per_token_p99_ms": 5.0,
+}
+
+
+class ScriptedWatcher:
+    """Head-of-stream poll over a fixed (path, step) script."""
+
+    def __init__(self, events, *, finished=True, alive=False):
+        self.events = list(events)
+        self.finished = finished
+        self.alive = alive
+
+    def poll(self, *, after_step=-1):
+        newer = [(p, s) for p, s in self.events if s > after_step]
+        if not newer:
+            return None
+        path, step = newer[-1]
+        return Path(path), step
+
+    def training_finished(self):
+        return self.finished
+
+    def training_alive(self, *, stale_sec):
+        return self.alive
+
+
+class SequentialWatcher:
+    """Commits arrive one at a time, like a live training run: the next
+    event is revealed only after the previous step has been decided."""
+
+    def __init__(self, events):
+        self.events = list(events)
+
+    def poll(self, *, after_step=-1):
+        while self.events and self.events[0][1] <= after_step:
+            self.events.pop(0)
+        if not self.events:
+            return None
+        path, step = self.events[0]
+        return Path(path), step
+
+    def training_finished(self):
+        return not self.events
+
+    def training_alive(self, *, stale_sec):
+        return True
+
+
+class FakeFleet:
+    """The controller's fleet verbs, with scriptable soak/swap outcomes."""
+
+    def __init__(self, n=2, baseline="base-params"):
+        self.replica_count = n
+        self.params = [baseline] * n
+        self.steps: list[int | None] = [None] * n
+        self.calls: list[tuple] = []
+        self.soak_by_idx: dict[int, dict] = {}
+        self.fleet_swap_errors: set[int] = set()
+        self.canary_swap_error: str | None = None
+        self.split: tuple | None = None
+
+    def canary_swap(self, idx, params, step, ckpt):
+        self.calls.append(("canary_swap", idx, step))
+        if self.canary_swap_error is not None:
+            raise RuntimeError(self.canary_swap_error)
+        self.params[idx] = params
+        self.steps[idx] = step
+
+    def fleet_swap(self, params, step, ckpt):
+        self.calls.append(("fleet_swap", step))
+        out = []
+        for i in range(self.replica_count):
+            if i in self.fleet_swap_errors:
+                out.append({"replica": f"r{i}", "error": "reload exploded"})
+            else:
+                self.params[i] = params
+                self.steps[i] = step
+                out.append({"replica": f"r{i}", "step": step})
+        return out
+
+    def set_traffic_split(self, idx, frac, seed):
+        self.split = (idx, frac, seed)
+        self.calls.append(("set_split", idx, frac))
+
+    def clear_traffic_split(self):
+        self.split = None
+        self.calls.append(("clear_split",))
+
+    def param_steps(self):
+        return list(self.steps)
+
+    def soak(self, idx, *, requests, seed, timeout_sec):
+        self.calls.append(("soak", idx, seed))
+        out = dict(_SOAK_OK)
+        out.update(self.soak_by_idx.get(idx, {}))
+        return out
+
+
+def _cfg(**kw) -> PromoteConfig:
+    base = dict(poll_sec=0.001, idle_timeout_sec=5.0, soak_requests=4)
+    base.update(kw)
+    return PromoteConfig(**base)
+
+
+def _controller(cfg, watcher, fleet, ledger, **kw):
+    kw.setdefault("baseline_params", "base-params")
+    kw.setdefault("baseline_step", 0)
+    kw.setdefault("baseline_checkpoint", "base.ckpt")
+    kw.setdefault("sleep", lambda s: None)
+    return PromotionController(
+        cfg=cfg, watcher=watcher, fleet=fleet, ledger=ledger, **kw
+    )
+
+
+class TestPromotionController:
+    def test_clean_candidate_promotes_fleet_wide(self, tmp_path):
+        from llmtrain_tpu.telemetry.prometheus import render_prometheus
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+        fleet = FakeFleet()
+        ledger = PromotionLedger(tmp_path / "promotions.jsonl")
+        registry = MetricsRegistry(None)
+        losses = {"base.ckpt": 2.0, "s10.ckpt": 1.98}
+        ctl = _controller(
+            _cfg(), ScriptedWatcher([("s10.ckpt", 10)]), fleet, ledger,
+            load_params=lambda p: f"params-{p.name}",
+            evaluator=lambda p: losses[p.name],
+            registry=registry,
+        )
+        result = ctl.run()
+        assert result.status == "training_finished"
+        assert result.promotions == 1 and result.rollbacks == 0
+        assert result.last_promoted_step == 10
+        assert fleet.params == ["params-s10.ckpt"] * 2
+        assert fleet.param_steps() == [10, 10]
+        decisions = [e["decision"] for e in ledger.entries()]
+        assert decisions == ["canary_start", "promote"]
+        promo = ledger.entries()[-1]
+        assert promo["scores"]["eval_loss"] == 1.98
+        assert promo["scores"]["baseline_eval_loss"] == 2.0
+        assert all("error" not in r for r in promo["scores"]["fleet_swap"])
+        # Soak ran on the canary AND a reference replica, same seed.
+        soaks = [c for c in fleet.calls if c[0] == "soak"]
+        assert [c[1] for c in soaks] == [0, 1]
+        assert soaks[0][2] == soaks[1][2]
+        # Gauges + counters reach Prometheus under llmtrain_promote_*.
+        text = render_prometheus(
+            dict(registry.latest()), registry.counters(), {}
+        )
+        assert "llmtrain_promote_promotions_total" in text
+        assert "llmtrain_promote_last_promoted_step 10.0" in text
+        assert "llmtrain_promote_candidates_total 1.0" in text
+
+    def test_eval_regression_rolls_the_canary_back(self, tmp_path):
+        fleet = FakeFleet()
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        losses = {"base.ckpt": 2.0, "bad.ckpt": 2.5}
+        ctl = _controller(
+            _cfg(max_eval_loss_delta=0.05),
+            ScriptedWatcher([("bad.ckpt", 10)]), fleet, ledger,
+            load_params=lambda p: f"params-{p.name}",
+            evaluator=lambda p: losses[p.name],
+        )
+        result = ctl.run()
+        assert result.promotions == 0 and result.rollbacks == 1
+        entry = ledger.entries()[-1]
+        assert entry["decision"] == "rollback"
+        assert entry["reason"].startswith("eval_regression")
+        assert entry["scores"]["eval_loss_delta"] == pytest.approx(0.5)
+        # Canary restored to the promoted baseline; fleet never swapped.
+        assert fleet.params == ["base-params"] * 2
+        assert fleet.steps[0] == 0
+        assert not any(c[0] == "fleet_swap" for c in fleet.calls)
+        # The rollback restore happened INSIDE the traffic-split window:
+        # a regressed candidate must not rejoin live placement first.
+        restore = fleet.calls.index(("canary_swap", 0, 0))
+        assert fleet.calls[restore + 1 :].count(("clear_split",)) == 1
+
+    def test_slo_regression_rolls_back(self, tmp_path):
+        fleet = FakeFleet()
+        fleet.soak_by_idx[0] = {"ttft_p95_ms": 100.0}  # reference: 10ms
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        ctl = _controller(
+            _cfg(ttft_p95_slowdown=2.0),
+            ScriptedWatcher([("slow.ckpt", 10)]), fleet, ledger,
+        )
+        result = ctl.run()
+        assert result.rollbacks == 1
+        assert ledger.entries()[-1]["reason"].startswith(
+            "slo_regression: ttft_p95_ms"
+        )
+
+    def test_soak_failures_fail_fast_before_eval(self, tmp_path):
+        fleet = FakeFleet()
+        fleet.soak_by_idx[0] = {"failed": 2, "completed": 2}
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        evals = []
+        ctl = _controller(
+            _cfg(allow_failed_requests=0),
+            ScriptedWatcher([("crashy.ckpt", 10)]), fleet, ledger,
+            evaluator=lambda p: evals.append(p) or 2.0,
+        )
+        result = ctl.run()
+        assert result.rollbacks == 1
+        assert ledger.entries()[-1]["reason"] == "canary_request_failures: 2"
+        assert evals == []  # the expensive eval never ran
+
+    def test_unloadable_checkpoint_aborts_without_touching_the_fleet(
+        self, tmp_path
+    ):
+        fleet = FakeFleet()
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+
+        def load(_path):
+            raise ValueError("truncated msgpack")
+
+        ctl = _controller(
+            _cfg(), ScriptedWatcher([("torn.ckpt", 10)]), fleet, ledger,
+            load_params=load,
+        )
+        result = ctl.run()
+        assert result.aborts == 1 and result.rollbacks == 0
+        assert ledger.entries()[-1]["decision"] == "abort"
+        assert "truncated msgpack" in ledger.entries()[-1]["reason"]
+        assert not any(
+            c[0] in ("canary_swap", "fleet_swap") for c in fleet.calls
+        )
+
+    def test_partial_fleet_swap_rolls_the_whole_fleet_back(self, tmp_path):
+        """The mixed-epoch hazard: replica 1 admits the candidate,
+        replica 0 fails its reload. The controller must converge DOWN —
+        every replica back to the promoted baseline."""
+        fleet = FakeFleet(n=3)
+        fleet.fleet_swap_errors = {0}
+        ledger = PromotionLedger(tmp_path / "p.jsonl")
+        ctl = _controller(
+            _cfg(canary_replica=1),
+            ScriptedWatcher([("s10.ckpt", 10)]), fleet, ledger,
+            load_params=lambda p: "cand-params",
+        )
+        result = ctl.run()
+        assert result.promotions == 0 and result.rollbacks == 1
+        entry = ledger.entries()[-1]
+        assert entry["decision"] == "rollback"
+        assert entry["reason"] == "partial_fleet_swap: r0"
+        assert len(entry["scores"]["fleet_swap"]) == 3
+        assert len(entry["scores"]["fleet_restore"]) == 3
+        # r0's restore also errored (scripted), but r1/r2 converged back.
+        assert fleet.params[1] == "base-params"
+        assert fleet.params[2] == "base-params"
+
+    def test_replay_is_idempotent_after_sigkill(self, tmp_path):
+        """Run, 'SIGKILL', re-run over the same stream: decided steps are
+        never re-judged and the ledger gains no duplicate entries."""
+        ledger_path = tmp_path / "promotions.jsonl"
+        events = [("s10.ckpt", 10)]
+        ctl = _controller(
+            _cfg(), ScriptedWatcher(events), FakeFleet(),
+            PromotionLedger(ledger_path),
+            load_params=lambda p: "cand",
+        )
+        assert ctl.run().promotions == 1
+        before = (tmp_path / "promotions.jsonl").read_text()
+        # A new process replays the ledger; step 10 is already decided.
+        fleet2 = FakeFleet()
+        ctl2 = _controller(
+            _cfg(), ScriptedWatcher(events), fleet2,
+            PromotionLedger(ledger_path),
+            load_params=lambda p: "cand",
+        )
+        result = ctl2.run()
+        assert result.status == "training_finished"
+        assert result.promotions == 0
+        assert (tmp_path / "promotions.jsonl").read_text() == before
+        assert fleet2.calls == []  # the fleet was never touched
+
+    def test_pending_canary_window_is_reopened_on_resume(self, tmp_path):
+        """A promote SIGKILLed between canary_start and its terminal
+        decision must re-judge that candidate, not skip it."""
+        ledger_path = tmp_path / "promotions.jsonl"
+        seed = PromotionLedger(ledger_path)
+        seed.append("canary_start", step=10, checkpoint="s10.ckpt")
+        fleet = FakeFleet()
+        ctl = _controller(
+            _cfg(), ScriptedWatcher([("s10.ckpt", 10)]), fleet,
+            PromotionLedger(ledger_path),
+            load_params=lambda p: "cand",
+            baseline_step=10,  # resume floor would otherwise skip step 10
+        )
+        result = ctl.run()
+        assert result.promotions == 1
+        decisions = [e["decision"] for e in PromotionLedger(ledger_path).entries()]
+        # The second canary_start is the resume marker.
+        assert decisions == ["canary_start", "canary_start", "promote"]
+
+    def test_training_death_exits_with_taxonomy_status(self, tmp_path):
+        now = [0.0]
+
+        def clock():
+            now[0] += 2.0
+            return now[0]
+
+        ctl = _controller(
+            _cfg(idle_timeout_sec=5.0),
+            ScriptedWatcher([], finished=False, alive=False),
+            FakeFleet(), PromotionLedger(tmp_path / "p.jsonl"),
+            clock=clock,
+        )
+        result = ctl.run()
+        assert result.status == "training_dead"
+        assert result.promotions == 0
+
+    def test_live_heartbeat_keeps_an_idle_stream_waiting(self, tmp_path):
+        """Heartbeat fresh but no commits: promote keeps polling (the
+        trainer is between save_every_steps windows), then exits cleanly
+        when report.json lands."""
+        watcher = ScriptedWatcher([], finished=False, alive=True)
+        polls = [0]
+
+        def sleep(_s):
+            polls[0] += 1
+            if polls[0] >= 3:
+                watcher.finished = True
+
+        ctl = _controller(
+            _cfg(idle_timeout_sec=0.5),
+            watcher, FakeFleet(), PromotionLedger(tmp_path / "p.jsonl"),
+            clock=lambda: polls[0] * 10.0,  # way past idle_timeout
+            sleep=sleep,
+        )
+        assert ctl.run().status == "training_finished"
+        assert polls[0] == 3
+
+    def test_max_promotions_caps_the_run(self, tmp_path):
+        ctl = _controller(
+            _cfg(max_promotions=1),
+            ScriptedWatcher([("a.ckpt", 10), ("b.ckpt", 20)], finished=False),
+            FakeFleet(), PromotionLedger(tmp_path / "p.jsonl"),
+            load_params=lambda p: "cand",
+        )
+        result = ctl.run()
+        assert result.status == "max_promotions"
+        # Head-of-stream: the single promotion judged step 20, not 10.
+        assert result.promotions == 1 and result.last_promoted_step == 20
+
+    def test_canary_replica_must_exist(self, tmp_path):
+        with pytest.raises(ValueError, match="out of range"):
+            _controller(
+                _cfg(canary_replica=2), ScriptedWatcher([]), FakeFleet(n=2),
+                PromotionLedger(tmp_path / "p.jsonl"),
+            )
+
+
+class TestPromoteConfig:
+    def test_defaults_and_strictness(self):
+        cfg = PromoteConfig()
+        assert cfg.poll_sec == 2.0 and cfg.max_promotions == 0
+        with pytest.raises(Exception):
+            PromoteConfig(promote_every=3)  # unknown key: strict schema
+
+    def test_bounds(self):
+        with pytest.raises(Exception):
+            PromoteConfig(traffic_split=1.5)
+        with pytest.raises(Exception):
+            PromoteConfig(ttft_p95_slowdown=1.0)  # must be > 1x
+        assert PromoteConfig(ttft_p95_slowdown=None).ttft_p95_slowdown is None
+
+    def test_rides_in_run_config(self):
+        from llmtrain_tpu.config.schemas import RunConfig
+
+        cfg = RunConfig.model_validate(
+            {
+                "run": {"name": "t", "seed": 0, "device": "cpu"},
+                "model": {"name": "dummy_gpt"},
+                "data": {"name": "dummy_text"},
+                "trainer": {"max_steps": 1, "warmup_steps": 0},
+                "promote": {"max_promotions": 2, "traffic_split": 0.5},
+            }
+        )
+        assert cfg.promote.max_promotions == 2
+        assert cfg.promote.traffic_split == 0.5
+
+
+# ---------------------------------------------------------------------------
+# /healthz liveness contract (serving/http.py + scheduler beacon)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthzLiveness:
+    def _state(self, scheduler, stale=30.0):
+        from llmtrain_tpu.serving.http import ServerState
+
+        return ServerState(
+            model=object(), params=None, tokenizer=None, step=0,
+            checkpoint="c", scheduler=scheduler, liveness_stale_sec=stale,
+        )
+
+    def test_scheduler_alive_predicate(self):
+        from llmtrain_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+        sched = object.__new__(ContinuousBatchingScheduler)
+        sched._thread = None
+        sched._beacon = time.monotonic()
+        assert sched.alive(0.001)  # never started: tests drive step()
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        sched._thread = dead
+        assert not sched.alive(3600.0)
+        live = threading.Thread(target=time.sleep, args=(1.0,))
+        live.start()
+        try:
+            sched._thread = live
+            sched._beacon = time.monotonic()
+            assert sched.alive(30.0)
+            sched._beacon = time.monotonic() - 100.0
+            assert not sched.alive(30.0)  # wedged: thread up, beacon stale
+        finally:
+            live.join()
+
+    def test_healthz_503_on_dead_or_stale_scheduler(self):
+        from llmtrain_tpu.serving.http import _handle_health
+
+        class Sched:
+            def __init__(self, ok):
+                self.ok = ok
+                self.asked_with = None
+
+            def stats(self):
+                return {"policy": "paged"}
+
+            def alive(self, stale_sec):
+                self.asked_with = stale_sec
+                return self.ok
+
+        ok = Sched(True)
+        code, payload = _handle_health(self._state(ok, stale=45.0))
+        assert code == 200 and payload["status"] == "ok"
+        assert ok.asked_with == 45.0  # serving.liveness_stale_sec flows in
+        code, payload = _handle_health(self._state(Sched(False)))
+        assert code == 503 and payload["status"] == "unhealthy"
+        assert "scheduler" in payload  # stats still attached for debugging
+
+    def test_healthz_503_when_the_whole_fleet_is_evicted(self):
+        from llmtrain_tpu.serving.http import _handle_health
+
+        class RouterLike:  # no alive(): health = any replica healthy
+            def stats(self):
+                return {"router": {"replicas_healthy": 0}}
+
+        code, payload = _handle_health(self._state(RouterLike()))
+        assert code == 503
+
+        class HealthyRouter:
+            def stats(self):
+                return {"router": {"replicas_healthy": 2}}
+
+        code, _ = _handle_health(self._state(HealthyRouter()))
+        assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# goodput attribution of the promotions ledger
+# ---------------------------------------------------------------------------
+
+
+class TestGoodputPromotions:
+    def _timeline(self, run_dir: Path) -> None:
+        events = [
+            {
+                "name": "segment_start", "ph": "seg", "segment_id": 0,
+                "start_unix_time": 1000.0, "process_index": 0, "pid": 1,
+            },
+            {
+                "name": "host_dispatch", "cat": "train", "ph": "X",
+                "ts_us": int(2e6), "dur_us": int(1e6), "step": 1,
+                "thread": "MainThread",
+            },
+            {
+                "name": "segment_end", "ph": "seg", "segment_id": 0,
+                "end_unix_time": 1010.0,
+            },
+        ]
+        path = run_dir / "telemetry" / "timeline.jsonl"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(
+            "".join(json.dumps(e) + "\n" for e in events), encoding="utf-8"
+        )
+
+    def test_ledger_attributed_and_rendered(self, tmp_path):
+        from llmtrain_tpu.telemetry.goodput import (
+            compute_goodput,
+            goodput_gauges,
+            render_goodput_md,
+        )
+
+        self._timeline(tmp_path)
+        ledger = PromotionLedger(tmp_path / "promotions.jsonl")
+        ledger.append("canary_start", step=10, checkpoint="a.ckpt")
+        ledger.append("rollback", step=10, reason="eval_regression: 0.5")
+        ledger.append("canary_start", step=20, checkpoint="b.ckpt")
+        ledger.append("promote", step=20, checkpoint="b.ckpt")
+        out = compute_goodput(tmp_path)
+        assert out is not None
+        block = out["promotions"]
+        assert block["decisions"]["promote"] == 1
+        assert block["decisions"]["rollback"] == 1
+        assert block["last_promoted_step"] == 20
+        assert [e["decision"] for e in block["events"]] == [
+            "canary_start", "rollback", "canary_start", "promote",
+        ]
+        gauges = goodput_gauges(out)
+        assert gauges["goodput/promotions_promote"] == 1.0
+        assert gauges["goodput/promoted_step"] == 20.0
+        md = render_goodput_md(out)
+        assert "promote" in md and "eval_regression" in md
+
+    def test_no_ledger_no_block(self, tmp_path):
+        from llmtrain_tpu.telemetry.goodput import compute_goodput
+
+        self._timeline(tmp_path)
+        out = compute_goodput(tmp_path)
+        assert out is not None and "promotions" not in out
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestPromoteCLI:
+    def test_parser_accepts_promote(self):
+        from llmtrain_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            [
+                "promote", "--config", "c.yaml", "--watch", "runs/r1",
+                "--replicas", "3", "--max-promotions", "2", "--no-eval",
+                "--json",
+            ]
+        )
+        assert args.command == "promote"
+        assert args.watch == "runs/r1"
+        assert args.replicas == 3
+        assert args.max_promotions == 2
+        assert args.no_eval is True
+
+    def test_preset_parses_with_promote_section(self):
+        from llmtrain_tpu.config import load_and_validate_config
+
+        out = load_and_validate_config(
+            "configs/presets/gpt_promote_smoke.yaml"
+        )
+        cfg = out[0]
+        assert cfg.promote.max_promotions == 1
+        assert cfg.promote.traffic_split == 0.25
+        assert cfg.serving.router.replicas == 2
+
+
+# ---------------------------------------------------------------------------
+# slow: the chaos drill — real engines, poisoned canary, live traffic
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestPromoteDrill:
+    def test_poisoned_canary_rolls_back_then_clean_promotes(self, tmp_path):
+        """The acceptance drill (ISSUE 16): while live traffic flows
+        through a real 2-replica router, a poisoned checkpoint is
+        canaried, detected by the eval gate, and rolled back — zero
+        failed live requests, bitwise parity on the params each request
+        was ADMITTED under, poisoned params never admitted for live
+        traffic. A clean checkpoint then promotes fleet-wide and the
+        fleet converges (epoch_divergence back to 0), with every
+        transition durable in promotions.jsonl and visible as
+        llmtrain_promote_* gauges."""
+        import jax
+
+        from llmtrain_tpu.serving import (
+            ContinuousBatchingScheduler,
+            InProcessReplica,
+            PagedDecodeEngine,
+            build_requests,
+            run_loadgen,
+        )
+        from llmtrain_tpu.serving.router import ReplicaRouter
+        from llmtrain_tpu.telemetry.prometheus import render_prometheus
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+        from tests.test_router import _reference, _tiny_stack
+
+        model, params, params2 = _tiny_stack()
+        # "Poisoned": structurally loadable, numerically garbage — the
+        # shape of a bad data batch or an optimizer blowup.
+        poisoned = jax.tree.map(lambda x: x * 0.0 + 1e3, params2)
+
+        def mk(i):
+            eng = PagedDecodeEngine(
+                model, params, block_tokens=4, max_batch_slots=4,
+                prompt_buckets=[8, 16], batch_buckets=[2, 4],
+                prefix_cache=True,
+            )
+            return InProcessReplica(
+                ContinuousBatchingScheduler(eng).start(), f"replica{i}"
+            )
+
+        registry = MetricsRegistry(None)
+        router = ReplicaRouter([mk(0), mk(1)], registry=registry)
+        try:
+            params_by_name = {"poison.ckpt": poisoned, "clean.ckpt": params2}
+            losses = {"base.ckpt": 2.0, "poison.ckpt": 11.0, "clean.ckpt": 1.9}
+            ledger = PromotionLedger(tmp_path / "promotions.jsonl")
+            fleet = RouterFleet(router, vocab_size=32, max_new_tokens=4)
+            ctl = PromotionController(
+                cfg=PromoteConfig(
+                    poll_sec=0.01,
+                    soak_requests=4,
+                    soak_timeout_sec=120.0,
+                    soak_seed=7,
+                    traffic_split=0.0,  # live traffic never meets the canary
+                    max_eval_loss_delta=0.05,
+                    ttft_p95_slowdown=None,  # timing gates are unit-tested;
+                    per_token_p99_slowdown=None,  # CPU CI timing is noise
+                ),
+                watcher=SequentialWatcher(
+                    [("poison.ckpt", 100), ("clean.ckpt", 200)]
+                ),
+                fleet=fleet,
+                ledger=ledger,
+                baseline_params=params,
+                baseline_step=0,
+                baseline_checkpoint="base.ckpt",
+                load_params=lambda p: params_by_name[p.name],
+                evaluator=lambda p: losses[p.name],
+                registry=registry,
+            )
+
+            live = build_requests(
+                num_requests=12, seed=3, vocab_size=32,
+                prompt_tokens_min=4, prompt_tokens_max=8, max_new_tokens=4,
+            )
+            block: dict = {}
+
+            def drive():
+                block.update(
+                    run_loadgen(router, live, rate_rps=30.0, seed=5,
+                                timeout_sec=300.0)
+                )
+
+            t = threading.Thread(target=drive)
+            t.start()
+            result = ctl.run()
+            t.join()
+
+            # Decisions: poisoned rolled back, clean promoted.
+            assert result.status == "training_finished"
+            assert result.promotions == 1 and result.rollbacks == 1
+            assert result.last_promoted_step == 200
+            entries = ledger.entries()
+            assert [(e["decision"], e["step"]) for e in entries] == [
+                ("canary_start", 100), ("rollback", 100),
+                ("canary_start", 200), ("promote", 200),
+            ]
+            assert entries[1]["reason"].startswith("eval_regression")
+            # Soak itself saw zero failures both rounds (the canary
+            # serves; it just serves garbage).
+            for e in entries:
+                for side in ("canary", "reference"):
+                    soak = e.get("scores", {}).get(side)
+                    if soak:
+                        assert soak["failed"] == 0 and soak["timed_out"] == 0
+
+            # Live traffic: zero failures, bitwise parity on admitted
+            # params, poisoned step NEVER admitted for a live request.
+            assert block["requests"]["failed"] == 0
+            assert block["requests"]["timed_out"] == 0
+            assert block["requests"]["completed"] == len(live)
+            by_step = {0: params, 200: params2, None: params}
+            for r in live:
+                assert r.params_step != 100, "poisoned params served live"
+                assert r.tokens == _reference(model, by_step[r.params_step], r)
+
+            # The fleet converged on the promoted step.
+            assert fleet.param_steps() == [200, 200]
+            stats = router.stats()
+            assert stats["router"]["epoch_divergence"] == 0
+            assert stats["router"]["canary"]["index"] is None
+            assert router.canary_index is None
+
+            text = render_prometheus(
+                dict(registry.latest()), registry.counters(), {}
+            )
+            assert "llmtrain_promote_promotions_total 1.0" in text
+            assert "llmtrain_promote_rollbacks_total 1.0" in text
+            assert "llmtrain_promote_last_promoted_step 200.0" in text
+            assert "llmtrain_router_epoch_divergence 0.0" in text
+        finally:
+            router.close()

@@ -404,6 +404,176 @@ class TestRouterPlacement:
         assert resolve_backends("127.0.0.1") == ["http://127.0.0.1:8000"]
 
 
+class TestRevivalBackoff:
+    def test_failed_probes_back_off_exponentially(self):
+        """A dead replica is probed with real healthchecks at widening
+        intervals (x2 per failure, capped) — elapsed time alone never
+        reinstates it, and a permanently dead replica doesn't cost one
+        probe per placement call."""
+        a = FakeReplica("a", fail=True)
+        b = FakeReplica("b")
+        router = _router(a, b, fail_threshold=1, revive_sec=10.0)
+        router.submit(_req(list(range(4))))  # evicts a
+        s = router._states[0]
+        a.probe_ok = False
+        router._healthy_indices()  # not due yet: no probe
+        assert s.revive_probes == 0
+        s.evicted_at = time.monotonic() - 10.0
+        router._healthy_indices()
+        assert s.revive_probes == 1 and not s.healthy
+        assert s.revive_backoff == 2.0
+        # One base interval elapsed again — but the backoff demands two.
+        s.evicted_at = time.monotonic() - 10.0
+        router._healthy_indices()
+        assert s.revive_probes == 1
+        s.evicted_at = time.monotonic() - 20.0
+        router._healthy_indices()
+        assert s.revive_probes == 2 and s.revive_backoff == 4.0
+        # A succeeding probe revives AND resets the backoff.
+        a.probe_ok = True
+        a.fail = False
+        s.evicted_at = time.monotonic() - 40.0
+        router._healthy_indices()
+        assert s.healthy and s.revive_backoff == 1.0
+        assert router.stats()["router"]["replicas"][0]["revive_probes"] == 3
+
+    def test_backoff_is_capped(self):
+        a = FakeReplica("a", fail=True)
+        b = FakeReplica("b")
+        router = _router(a, b, fail_threshold=1, revive_sec=1.0)
+        router.submit(_req(list(range(4))))
+        s = router._states[0]
+        a.probe_ok = False
+        for _ in range(8):
+            s.evicted_at = time.monotonic() - 1e6  # always due
+            router._healthy_indices()
+        assert s.revive_backoff == ReplicaRouter._REVIVE_BACKOFF_CAP
+
+
+class TestCanarySplit:
+    def test_canary_excluded_from_placement_at_zero_split(self):
+        a, b = FakeReplica("a", load=9.0), FakeReplica("b", load=0.0)
+        router = _router(a, b)
+        router.set_canary(1)  # b would otherwise win every placement
+        for base in range(0, 40, 8):
+            assert router.select(np.arange(base, base + 8, dtype=np.int32)) == 0
+        assert router.stats()["router"]["canary"] == {
+            "index": 1, "traffic_frac": 0.0, "routed": 0,
+        }
+        router.clear_canary()
+        assert router.canary_index is None
+        assert router.select(np.arange(8, dtype=np.int32)) == 1
+
+    def test_full_split_steers_all_traffic_to_the_canary(self):
+        a, b = FakeReplica("a"), FakeReplica("b", load=50.0)
+        router = _router(a, b)
+        router.set_canary(1, traffic_frac=1.0, seed=3)
+        for _ in range(5):
+            assert router.select(np.arange(8, dtype=np.int32)) == 1
+        assert router.canary_routed == 5
+        assert router.stats()["router"]["canary"]["routed"] == 5
+
+    def test_canary_validation(self):
+        router = _router(FakeReplica("a"), FakeReplica("b"))
+        with pytest.raises(ValueError, match="no replica index"):
+            router.set_canary(7)
+        with pytest.raises(ValueError, match="traffic_frac"):
+            router.set_canary(0, traffic_frac=1.5)
+
+    def test_sole_remaining_replica_serves_even_as_canary(self):
+        """With every proven replica gone the canary is the fleet —
+        refusing it would fail requests for placement hygiene."""
+        a, b = FakeReplica("a", load=0.0, fail=True), FakeReplica("b")
+        router = _router(a, b, fail_threshold=3, revive_sec=60.0)
+        router.set_canary(1)
+        r = router.submit(_req(list(range(4))))  # a fails -> failover
+        assert r.finish_reason == "length"
+        assert any(x is r for x in b.served)
+        assert router.failovers == 1
+
+    def test_failover_prefers_proven_replicas_over_the_canary(self):
+        a = FakeReplica("a", load=0.0, fail=True)
+        b, c = FakeReplica("b", load=5.0), FakeReplica("c", load=0.0)
+        router = _router(a, b, c, fail_threshold=3, revive_sec=60.0)
+        router.set_canary(2)  # c is cheapest but unproven
+        r = router.submit(_req(list(range(4))))
+        assert any(x is r for x in b.served)
+        assert not c.served
+
+
+class SteppedReplica(FakeReplica):
+    """FakeReplica whose stats carry the hot-swap params block."""
+
+    def __init__(self, name, step=None, epoch=None, **kw):
+        super().__init__(name, **kw)
+        self.step = step
+        self.epoch = epoch
+
+    def stats(self):
+        s = super().stats()
+        s["params"] = {"step": self.step, "epoch": self.epoch}
+        return s
+
+
+class TestEpochDivergence:
+    def test_converged_fleet_reports_zero(self):
+        router = _router(
+            SteppedReplica("a", step=100, epoch=1),
+            SteppedReplica("b", step=100, epoch=2),  # epochs local, steps global
+        )
+        s = router.stats()["router"]
+        assert s["epoch_divergence"] == 0
+        assert s["replicas"][0]["param_step"] == 100
+        assert s["replicas"][1]["param_epoch"] == 2
+
+    def test_mixed_steps_diverge(self):
+        router = _router(
+            SteppedReplica("a", step=100, epoch=1),
+            SteppedReplica("b", step=200, epoch=1),
+        )
+        assert router.stats()["router"]["epoch_divergence"] == 1
+
+    def test_evicted_replicas_do_not_count(self):
+        a = SteppedReplica("a", step=100, fail=True)
+        b = SteppedReplica("b", step=200)
+        router = _router(a, b, fail_threshold=1, revive_sec=60.0)
+        router.submit(_req(list(range(4))))  # evicts a
+        assert router.stats()["router"]["epoch_divergence"] == 0
+
+    def test_divergence_gauge_published(self):
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+        registry = MetricsRegistry(None)
+        router = _router(
+            SteppedReplica("a", step=1),
+            SteppedReplica("b", step=2),
+            registry=registry,
+        )
+        router.stats()
+        latest = dict(registry.latest())
+        assert latest["router/epoch_divergence"][0] == 1.0
+        assert latest["router/replica1_param_step"][0] == 2.0
+        assert latest["router/canary_routed"][0] == 0.0
+
+
+class TestReloadReplica:
+    def test_reloads_exactly_one_replica(self):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        router = _router(a, b)
+        out = router.reload_replica(1, params=object(), step=9)
+        assert out == {"replica": "b", "step": 9}
+        assert b.reloads == [9] and a.reloads == []
+
+    def test_invalid_index_and_failure_surface(self):
+        a = FakeReplica("a")
+        router = _router(a)
+        with pytest.raises(ValueError, match="no replica index"):
+            router.reload_replica(3, params=object())
+        a.reload_error = "bad payload"
+        with pytest.raises(RuntimeError, match="bad payload"):
+            router.reload_replica(0, params=object())
+
+
 # ---------------------------------------------------------------------------
 # slow: real engines — drills that compile the tiny model
 # ---------------------------------------------------------------------------
