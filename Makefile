@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-overload verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -153,6 +153,16 @@ verify-router:
 # transition durable in promotions.jsonl) that plain `make test` skips.
 verify-promote:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_promote.py -q
+
+# Overload-control drill (docs/serving.md "Overload and SLOs"): token
+# buckets, EWMA admission, weighted-class queue, brownout hysteresis,
+# retry budget, shed-mid-prefill pool accounting — plus the
+# @pytest.mark.slow seeded 10x-burst drill against a 2-replica router
+# (fast 429s with the documented reason taxonomy, bitwise parity on
+# accepted requests, brownout entry AND exit, exact pool accounting)
+# that plain `make test` skips.
+verify-overload:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

@@ -479,6 +479,42 @@ def build_parser() -> argparse.ArgumentParser:
         help="fail the run if per-token p99 latency exceeds this bound "
         "(the head-of-line-blocking SLO chunked prefill protects)",
     )
+    bench.add_argument(
+        "--arrival",
+        choices=("poisson", "burst"),
+        default="poisson",
+        help="arrival process: steady Poisson, or 'burst' (head/tail 20%% "
+        "at --rate-rps, middle 60%% at rate * --burst-factor) — the "
+        "seeded overload drill for admission control and brownout",
+    )
+    bench.add_argument(
+        "--burst-factor",
+        type=float,
+        default=10.0,
+        help="rate multiplier for the burst window of --arrival burst",
+    )
+    bench.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        help="stamp every request with this latency budget; the overload "
+        "controller rejects/sheds requests that cannot meet it "
+        "(needs serving.overload.enabled)",
+    )
+    bench.add_argument(
+        "--batch-fraction",
+        type=float,
+        default=0.0,
+        help="seeded fraction of requests submitted as priority=batch "
+        "(the mixed-class workload the weighted dequeue serves)",
+    )
+    bench.add_argument(
+        "--max-rejected-frac",
+        type=float,
+        default=None,
+        help="fail the run if (rejected+shed)/submitted exceeds this "
+        "bound — overload behavior gateable like parity",
+    )
 
     evalp = sub.add_parser(
         "eval", help="run the validation loop on a checkpoint, no training"
@@ -1642,6 +1678,7 @@ def _build_serving_backend(
     model,
     params,
     logger,
+    registry=None,
 ):
     """Continuous-batching scheduler + metrics registry for serve/serve-bench.
 
@@ -1656,7 +1693,8 @@ def _build_serving_backend(
     from .telemetry.timeline import EventTimeline
 
     scfg = cfg.serving
-    registry = MetricsRegistry(None)
+    if registry is None:
+        registry = MetricsRegistry(None)
     # Serving timeline: request-id-tagged queue-wait/prefill/decode spans
     # (scheduler.py). Memory-only here; serve-bench exports the Perfetto
     # trace next to its report.
@@ -1666,6 +1704,18 @@ def _build_serving_backend(
             None,
             max_events=cfg.telemetry.max_events,
             xprof_annotations=cfg.telemetry.xprof_annotations,
+        )
+    overload = None
+    if scfg.overload.enabled:
+        from .serving import OverloadController
+
+        overload = OverloadController.from_config(scfg.overload)
+        logger.info(
+            "overload control: queue_cap %d, classes %s, brownout %.0f/%.0f ms",
+            scfg.overload.queue_cap,
+            dict(scfg.overload.classes),
+            scfg.overload.brownout_high_ms,
+            scfg.overload.brownout_low_ms,
         )
     policy = "speculative" if args.draft_config is not None else scfg.policy
     if policy == "speculative":
@@ -1733,6 +1783,7 @@ def _build_serving_backend(
             draft_engine=draft_engine,
             gamma=args.gamma if args.gamma is not None else scfg.speculative_gamma,
             timeline=timeline,
+            overload=overload,
         )
     else:
         engine = PagedDecodeEngine(
@@ -1756,7 +1807,7 @@ def _build_serving_backend(
             engine.batch_buckets,
         )
         scheduler = ContinuousBatchingScheduler(
-            engine, registry=registry, timeline=timeline
+            engine, registry=registry, timeline=timeline, overload=overload
         )
     return scheduler, registry
 
@@ -1792,18 +1843,32 @@ def _build_router_backend(
         if not urls:
             raise ValueError("--backends must list at least one base URL")
         replicas = [
-            HTTPReplica(u, timeout_sec=cfg.serving.request_timeout_sec)
+            HTTPReplica(
+                u,
+                timeout_sec=cfg.serving.request_timeout_sec,
+                probe_timeout_sec=rcfg.probe_timeout_sec,
+            )
             for u in urls
         ]
     elif getattr(args, "discover", None):
         replicas = [
-            HTTPReplica(u, timeout_sec=cfg.serving.request_timeout_sec)
+            HTTPReplica(
+                u,
+                timeout_sec=cfg.serving.request_timeout_sec,
+                probe_timeout_sec=rcfg.probe_timeout_sec,
+            )
             for u in resolve_backends(args.discover)
         ]
     else:
         n = getattr(args, "replicas", None) or rcfg.replicas
         for i in range(n):
-            sched, _ = _build_serving_backend(cfg, args, model, params, logger)
+            # In-process replicas share the router's registry so the
+            # scheduler-level overload series (rejected{reason}, brownout,
+            # predicted wait) reach the fleet /metrics scrape; counters
+            # sum across replicas, gauges are last-writer-wins.
+            sched, _ = _build_serving_backend(
+                cfg, args, model, params, logger, registry=registry
+            )
             sched.start()
             replicas.append(InProcessReplica(sched, f"replica{i}"))
     router = ReplicaRouter(
@@ -1814,6 +1879,8 @@ def _build_router_backend(
         fail_threshold=rcfg.fail_threshold,
         revive_sec=rcfg.revive_sec,
         block_tokens=cfg.serving.block_tokens,
+        retry_budget=rcfg.retry_budget,
+        retry_window_sec=rcfg.retry_window_sec,
     )
     logger.info(
         "replica router: %d %s replicas, affinity_weight %.1f, "
@@ -1923,6 +1990,16 @@ def _handle_serve(args: argparse.Namespace) -> int:
             if args.max_new_tokens_cap is not None
             else cfg.serving.max_new_tokens_cap
         )
+        client_gate = None
+        ocfg = cfg.serving.overload
+        if ocfg.enabled and ocfg.client_rate_rps > 0:
+            from .serving import ClientRateGate
+
+            client_gate = ClientRateGate(
+                ocfg.client_rate_rps,
+                ocfg.client_burst,
+                max_clients=ocfg.max_tracked_clients,
+            )
         state = ServerState(
             model=model,
             params=params,
@@ -1936,6 +2013,7 @@ def _handle_serve(args: argparse.Namespace) -> int:
             registry=registry,
             request_timeout_sec=cfg.serving.request_timeout_sec,
             liveness_stale_sec=cfg.serving.liveness_stale_sec,
+            client_gate=client_gate,
         )
 
         if mode == "continuous":
@@ -2299,6 +2377,20 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             "--shared-prefix-count >= 1"
         )
         return EXIT_CONFIG_ERROR
+    if args.burst_factor <= 0:
+        _emit_error("--burst-factor must be > 0")
+        return EXIT_CONFIG_ERROR
+    if args.deadline_ms is not None and args.deadline_ms <= 0:
+        _emit_error("--deadline-ms must be > 0")
+        return EXIT_CONFIG_ERROR
+    if not (0.0 <= args.batch_fraction <= 1.0):
+        _emit_error("--batch-fraction must be in [0, 1]")
+        return EXIT_CONFIG_ERROR
+    if args.max_rejected_frac is not None and not (
+        0.0 <= args.max_rejected_frac <= 1.0
+    ):
+        _emit_error("--max-rejected-frac must be in [0, 1]")
+        return EXIT_CONFIG_ERROR
 
     configure_platform(cfg.run.device)
     configure_compilation_cache(cfg.run.compilation_cache_dir)
@@ -2375,12 +2467,14 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             shared_prefix_count=args.shared_prefix_count,
             long_fraction=args.long_fraction,
             long_prompt_tokens=args.long_prompt_tokens,
+            deadline_ms=args.deadline_ms,
+            batch_fraction=args.batch_fraction,
         )
         logger.info(
             "serve-bench: %d requests, prompts %d-%d tokens, %d new tokens, "
-            "%.1f rps open-loop (seed %d, policy %s)",
+            "%.1f rps %s open-loop (seed %d, policy %s)",
             len(requests), pmin, pmax, args.max_new_tokens,
-            args.rate_rps, args.seed, scheduler.policy,
+            args.rate_rps, args.arrival, args.seed, scheduler.policy,
         )
         scheduler.start()
         block = run_loadgen(
@@ -2389,6 +2483,8 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             rate_rps=args.rate_rps,
             seed=args.seed,
             timeout_sec=args.timeout_sec,
+            arrival=args.arrival,
+            burst_factor=args.burst_factor,
         )
         scheduler.close()
         block["checkpoint"] = str(ckpt_path)
@@ -2414,6 +2510,16 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
                     f"per-token p99 {p99} ms exceeds the "
                     f"--max-per-token-p99-ms bound "
                     f"({args.max_per_token_p99_ms} ms)"
+                )
+        if args.max_rejected_frac is not None:
+            reqs_blk = block["requests"]
+            frac = (
+                reqs_blk.get("rejected", 0) + reqs_blk.get("shed", 0)
+            ) / max(1, reqs_blk["submitted"])
+            if frac > args.max_rejected_frac:
+                failures.append(
+                    f"rejected+shed fraction {frac:.3f} exceeds the "
+                    f"--max-rejected-frac bound ({args.max_rejected_frac})"
                 )
 
         if args.verify_parity:

@@ -477,6 +477,94 @@ class TelemetryConfig(BaseModel):
     model_config = _STRICT
 
 
+class OverloadConfig(BaseModel):
+    """SLO-aware overload control (serving/overload.py, docs/serving.md
+    "Overload and SLOs").
+
+    Bounded deadline-aware admission, priority classes with per-class
+    token buckets, load shedding, and brownout with hysteresis. When
+    enabled the continuous-batching scheduler rejects fast (HTTP 429 +
+    Retry-After) instead of queueing requests to die, and degrades
+    predictably under sustained pressure.
+    """
+
+    enabled: bool = False
+    # Hard cap on the admission queue; submits past it reject with
+    # reason=queue_full.
+    queue_cap: int = Field(64, ge=1)
+    # Deadline applied to requests that carry none (0 = no deadline:
+    # such requests are never rejected for deadline reasons).
+    default_deadline_ms: float = Field(0.0, ge=0.0)
+    # EWMA smoothing for the per-queue-slot wait estimator, plus the
+    # prior used before any observation lands.
+    ewma_beta: float = Field(0.8, gt=0.0, lt=1.0)
+    prior_wait_ms: float = Field(50.0, gt=0.0)
+    # Priority classes and their weighted-round-robin dequeue weights.
+    # Higher weight = more dequeues per cycle; every class with queued
+    # work is visited each cycle, so batch never starves interactive
+    # and vice versa.
+    classes: dict[str, int] = Field(
+        default_factory=lambda: {"interactive": 4, "batch": 1}
+    )
+    # Class assigned to requests with an unknown/absent priority.
+    default_class: str = "interactive"
+    # Optional per-class token-bucket admission rate (requests/sec) and
+    # burst size. Classes absent from the map are not rate limited.
+    class_rate_rps: dict[str, float] = Field(default_factory=dict)
+    class_burst: dict[str, float] = Field(default_factory=dict)
+    # Per-client token buckets at the HTTP boundary, keyed by the
+    # X-Client-Id header (0 = disabled).
+    client_rate_rps: float = Field(0.0, ge=0.0)
+    client_burst: float = Field(8.0, ge=1.0)
+    max_tracked_clients: int = Field(1024, ge=1)
+    # Brownout hysteresis: enter after enter_ticks consecutive scheduler
+    # steps with predicted queue wait >= high_ms; exit after exit_ticks
+    # consecutive steps < low_ms. While active, max_new_tokens is
+    # clamped and speculative decoding is disabled to protect TTFT.
+    brownout_high_ms: float = Field(500.0, gt=0.0)
+    brownout_low_ms: float = Field(100.0, gt=0.0)
+    brownout_enter_ticks: int = Field(3, ge=1)
+    brownout_exit_ticks: int = Field(3, ge=1)
+    brownout_max_new_tokens: int = Field(16, ge=1)
+
+    model_config = _STRICT
+
+    @model_validator(mode="after")
+    def check_overload(self) -> Self:
+        if not self.classes:
+            raise ValueError("serving.overload.classes must be non-empty")
+        if any(w < 1 for w in self.classes.values()):
+            raise ValueError(
+                "serving.overload.classes weights must be >= 1"
+            )
+        if self.default_class not in self.classes:
+            raise ValueError(
+                f"serving.overload.default_class {self.default_class!r} "
+                f"not in classes {sorted(self.classes)}"
+            )
+        for field in ("class_rate_rps", "class_burst"):
+            unknown = set(getattr(self, field)) - set(self.classes)
+            if unknown:
+                raise ValueError(
+                    f"serving.overload.{field} keys {sorted(unknown)} "
+                    f"not in classes {sorted(self.classes)}"
+                )
+        if any(v <= 0 for v in self.class_rate_rps.values()):
+            raise ValueError(
+                "serving.overload.class_rate_rps values must be > 0"
+            )
+        if any(v < 1 for v in self.class_burst.values()):
+            raise ValueError(
+                "serving.overload.class_burst values must be >= 1"
+            )
+        if self.brownout_low_ms >= self.brownout_high_ms:
+            raise ValueError(
+                "serving.overload.brownout_low_ms must be < "
+                "brownout_high_ms (hysteresis needs a gap)"
+            )
+        return self
+
+
 class RouterConfig(BaseModel):
     """Replica-router knobs (serving/router.py, ``llmtrain serve
     --router``, docs/serving.md "Fleet tier").
@@ -499,6 +587,15 @@ class RouterConfig(BaseModel):
     fail_threshold: int = Field(3, ge=1)
     # Seconds before an evicted replica gets a revival probe.
     revive_sec: float = Field(10.0, gt=0.0)
+    # Timeout for health/stats probes (GET /healthz, /stats) — separate
+    # from the per-request timeout so a wedged replica can't stall the
+    # router's health sweep.
+    probe_timeout_sec: float = Field(10.0, gt=0.0)
+    # Failover retry budget: at most this many retries per window across
+    # the fleet, so an overloaded fleet is never DDoS'd by its own
+    # router. 0 = unlimited.
+    retry_budget: int = Field(16, ge=0)
+    retry_window_sec: float = Field(10.0, gt=0.0)
 
     model_config = _STRICT
 
@@ -544,6 +641,9 @@ class ServingConfig(BaseModel):
     prefill_chunk: int = Field(0, ge=0)
     # Replica-router tier (`llmtrain serve --router`).
     router: RouterConfig = Field(default_factory=RouterConfig)
+    # SLO-aware overload control (admission, priorities, shedding,
+    # brownout) for the continuous scheduler.
+    overload: OverloadConfig = Field(default_factory=OverloadConfig)
     # Request validation caps (shared by both modes).
     max_new_tokens_cap: int = Field(256, ge=1)
     default_max_new_tokens: int = Field(48, ge=1)

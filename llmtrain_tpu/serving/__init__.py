@@ -18,22 +18,51 @@ whose public names — ``ServerState``, ``make_server``, and the tested
   p50/p95/p99 TTFT + per-token SLO block for report.json / Prometheus;
 * :mod:`~.router` — the fleet tier: prefix-cache-aware + load-aware
   dispatch across N in-process or HTTP replicas, health/eviction/
-  failover, rolling zero-downtime checkpoint reloads.
+  failover, rolling zero-downtime checkpoint reloads;
+* :mod:`~.overload` — SLO-aware overload control: bounded deadline-aware
+  admission, priority classes with token buckets, load shedding,
+  brownout with hysteresis, and the router's retry budget.
 """
 
 from .engine import PagedDecodeEngine, bucket_for
 from .http import ServerState, ServerStats, _handle_generate_request, make_server
 from .loadgen import build_requests, percentiles, run_loadgen
+from .overload import (
+    REJECT_REASONS,
+    Brownout,
+    ClientRateGate,
+    EwmaWaitEstimator,
+    OverloadController,
+    RetryBudget,
+    TokenBucket,
+    WeightedClassQueue,
+    rejected_counter,
+)
 from .paged_kv import NULL_BLOCK, BlockTable, PagedKVPool, PrefixMatch, chain_hashes
-from .router import HTTPReplica, InProcessReplica, ReplicaRouter, resolve_backends
+from .router import (
+    HTTPReplica,
+    InProcessReplica,
+    ReplicaBackpressure,
+    ReplicaRouter,
+    resolve_backends,
+)
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
 
 __all__ = [
     "NULL_BLOCK",
+    "REJECT_REASONS",
     "BlockTable",
+    "Brownout",
+    "ClientRateGate",
     "ContinuousBatchingScheduler",
+    "EwmaWaitEstimator",
     "HTTPReplica",
     "InProcessReplica",
+    "OverloadController",
+    "ReplicaBackpressure",
+    "RetryBudget",
+    "TokenBucket",
+    "WeightedClassQueue",
     "PagedDecodeEngine",
     "PagedKVPool",
     "PrefixMatch",
@@ -46,6 +75,7 @@ __all__ = [
     "chain_hashes",
     "make_server",
     "percentiles",
+    "rejected_counter",
     "resolve_backends",
     "run_loadgen",
 ]

@@ -166,6 +166,9 @@ class ServerState:
     # /healthz turns 503 when the scheduler loop's beacon is older than
     # this (serving.liveness_stale_sec) — the k8s livenessProbe contract.
     liveness_stale_sec: float = 30.0
+    # Per-client token buckets at the HTTP boundary (overload.ClientRateGate,
+    # keyed by X-Client-Id). None = no per-client rate limiting.
+    client_gate: Any | None = None
 
     @property
     def requests_served(self) -> int:
@@ -177,12 +180,78 @@ def _bad_request(msg: str) -> tuple[int, dict]:
     return 400, {"error": msg}
 
 
-def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]:
-    """Pure request logic (no HTTP): validate -> decode -> respond."""
+def _header(headers: Any, name: str) -> str | None:
+    """Case-insensitive header lookup that works for both the stdlib
+    ``email.message.Message`` (real requests) and plain dicts (tests)."""
+    if headers is None:
+        return None
+    get = getattr(headers, "get", None)
+    if get is None:
+        return None
+    value = get(name)
+    if value is None and isinstance(headers, dict):
+        lowered = {k.lower(): v for k, v in headers.items()}
+        value = lowered.get(name.lower())
+    return value
+
+
+def _handle_generate_request(
+    state: ServerState, body: dict, headers: Any = None
+) -> tuple[int, dict]:
+    """Pure request logic (no HTTP): validate -> decode -> respond.
+
+    ``headers`` (optional, dict-like) carries the SLO envelope:
+    ``X-Request-Id`` (echoed end-to-end and tagged on timeline spans),
+    ``X-Deadline-Ms`` (remaining latency budget; admission rejects fast
+    when it can't plausibly be met), ``X-Priority`` (class name for the
+    weighted dequeue), and ``X-Client-Id`` (per-client token bucket).
+    """
+    code, payload = _generate_request_inner(state, body, headers)
+    # X-Request-Id echoes on EVERY response — a client correlating a 400
+    # needs it as much as one correlating a 200.
+    rid = _header(headers, "X-Request-Id")
+    if rid and isinstance(payload, dict) and "request_id" not in payload:
+        payload["request_id"] = rid
+    return code, payload
+
+
+def _generate_request_inner(
+    state: ServerState, body: dict, headers: Any = None
+) -> tuple[int, dict]:
     from ..generation import generate
 
     if not isinstance(body, dict):
         return _bad_request("request body must be a JSON object")
+
+    rid = _header(headers, "X-Request-Id")
+    echo: dict[str, Any] = {"request_id": rid} if rid else {}
+    deadline_ms: float | None = None
+    raw_deadline = _header(headers, "X-Deadline-Ms")
+    if raw_deadline is not None:
+        try:
+            deadline_ms = float(raw_deadline)
+        except (TypeError, ValueError):
+            deadline_ms = -1.0
+        if deadline_ms <= 0:
+            return 400, {
+                "error": "X-Deadline-Ms must be a positive number", **echo
+            }
+    priority = _header(headers, "X-Priority") or "interactive"
+
+    if state.client_gate is not None:
+        client = _header(headers, "X-Client-Id") or "_anon"
+        wait = state.client_gate.check(client)
+        if wait is not None:
+            if state.registry is not None:
+                from .overload import REASON_RATE_LIMITED, rejected_counter
+
+                state.registry.inc(rejected_counter(REASON_RATE_LIMITED))
+            return 429, {
+                "error": f"client {client!r} is over its request rate",
+                "reason": "rate_limited",
+                "retry_after": round(wait, 3),
+                **echo,
+            }
     unknown = set(body) - {
         "prompt", "prompt_ids", "max_new_tokens", "temperature",
         "top_k", "top_p", "seed", "eos_token_id",
@@ -277,6 +346,9 @@ def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]
             top_p=top_p,
             seed=seed,
             eos_token_id=eos,
+            deadline_ms=deadline_ms,
+            priority=priority,
+            rid=rid,
         )
         state.scheduler.submit(req)
         if not req.done.wait(timeout=state.request_timeout_sec):
@@ -286,10 +358,24 @@ def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]
             # never catch up.
             req.abandon()
             state.stats.record_error()
-            return 503, {"error": "request timed out in the serving queue"}
+            return 503, {
+                "error": "request timed out in the serving queue", **echo
+            }
+        if req.finish_reason in ("rejected", "shed"):
+            # Overload control said no — fast 429 with the reason and a
+            # Retry-After hint (do_POST lifts it into the header).
+            payload: dict[str, Any] = {
+                "error": f"request {req.finish_reason} by overload control",
+                "reason": req.reject_reason,
+                "finish_reason": req.finish_reason,
+                **echo,
+            }
+            if req.retry_after_sec is not None:
+                payload["retry_after"] = round(req.retry_after_sec, 3)
+            return 429, payload
         if req.error is not None:
             state.stats.record_error()
-            return 500, {"error": f"generation failed: {req.error}"}
+            return 500, {"error": f"generation failed: {req.error}", **echo}
         completion = list(req.tokens)
         if req.ttft_ms is not None:
             extra["ttft_ms"] = round(req.ttft_ms, 3)
@@ -333,6 +419,7 @@ def _handle_generate_request(state: ServerState, body: dict) -> tuple[int, dict]
         "prompt_tokens": int(ids.size),
         "latency_ms": round(latency_ms, 3),
         **extra,
+        **echo,
     }
 
 
@@ -406,17 +493,43 @@ class _Handler(BaseHTTPRequestHandler):
     # Set by make_server().
     state: ServerState = None  # type: ignore[assignment]
 
-    def _respond(self, code: int, payload: dict) -> None:
+    def _respond(
+        self, code: int, payload: dict, headers: dict[str, str] | None = None
+    ) -> None:
         data = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 
+    @staticmethod
+    def _slo_headers(code: int, payload: dict) -> dict[str, str]:
+        """Lift the SLO envelope out of the payload into real headers:
+        429/503 carry Retry-After (integer seconds, >= 1 per RFC 9110),
+        and X-Request-Id echoes back whenever the request carried one."""
+        out: dict[str, str] = {}
+        retry_after = payload.get("retry_after") if isinstance(payload, dict) else None
+        if code in (429, 503) and isinstance(retry_after, (int, float)):
+            out["Retry-After"] = str(max(1, int(-(-float(retry_after) // 1))))
+        rid = payload.get("request_id") if isinstance(payload, dict) else None
+        if rid:
+            out["X-Request-Id"] = str(rid)
+        return out
+
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
-            self._respond(*_handle_health(self.state))
+            code, payload = _handle_health(self.state)
+            headers = {}
+            if code == 503:
+                # An unhealthy replica tells the router/probe when to
+                # come back, mirroring the 429 backpressure contract.
+                headers["Retry-After"] = str(
+                    max(1, int(self.state.liveness_stale_sec))
+                )
+            self._respond(code, payload, headers)
         elif self.path.split("?")[0] == "/metrics":
             code, text = _handle_metrics(self.state)
             body = text.encode("utf-8")
@@ -444,7 +557,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(*_handle_reload(self.state, body))
             return
         try:
-            self._respond(*_handle_generate_request(self.state, body))
+            code, payload = _handle_generate_request(
+                self.state, body, self.headers
+            )
+            self._respond(code, payload, self._slo_headers(code, payload))
         except Exception as exc:  # noqa: BLE001 — server must not die
             self.state.stats.record_error()
             self._respond(500, {"error": f"generation failed: {exc}"})

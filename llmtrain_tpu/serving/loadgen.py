@@ -64,6 +64,8 @@ def build_requests(
     shared_prefix_count: int = 1,
     long_fraction: float = 0.0,
     long_prompt_tokens: int = 0,
+    deadline_ms: float | None = None,
+    batch_fraction: float = 0.0,
 ) -> list[ServeRequest]:
     """Seeded request population: prompt lengths/ids and per-request rng
     seeds all derive from one numpy Generator, so a run is replayable —
@@ -79,6 +81,13 @@ def build_requests(
       ``long_prompt_tokens``-token prompts (the rest stay in the
       min..max band) — the bimodal long/short mix chunked prefill
       exists for.
+
+    Overload knobs: ``deadline_ms`` stamps every request with a latency
+    budget (the admission controller's rejection signal), and
+    ``batch_fraction`` > 0 marks that seeded fraction of requests
+    ``priority="batch"`` — the mixed-class workload the weighted dequeue
+    exists for. Both draw no extra rng when unused, so pre-existing
+    seeded populations replay identically.
     """
     rng = np.random.default_rng(seed)
     prefixes = [
@@ -98,6 +107,9 @@ def build_requests(
         if prefixes:
             prefix = prefixes[int(rng.integers(0, len(prefixes)))]
             prompt = np.concatenate([prefix, prompt]).astype(np.int32)
+        priority = "interactive"
+        if batch_fraction > 0.0 and rng.random() < batch_fraction:
+            priority = "batch"
         reqs.append(
             ServeRequest(
                 prompt_ids=prompt,
@@ -107,6 +119,9 @@ def build_requests(
                 top_p=top_p,
                 seed=int(rng.integers(0, 2**31 - 1)),
                 eos_token_id=eos_token_id,
+                deadline_ms=deadline_ms,
+                priority=priority,
+                rid=f"lg-{seed}-{i}",
             )
         )
     return reqs
@@ -119,15 +134,39 @@ def run_loadgen(
     rate_rps: float,
     seed: int,
     timeout_sec: float = 300.0,
+    arrival: str = "poisson",
+    burst_factor: float = 10.0,
 ) -> dict[str, Any]:
-    """Submit ``requests`` on a seeded open-loop Poisson clock and block
+    """Submit ``requests`` on a seeded open-loop arrival clock and block
     until every one completes (or ``timeout_sec`` lapses); returns the
     ``serving`` report block. The scheduler must already be running
-    (``scheduler.start()``)."""
+    (``scheduler.start()``).
+
+    ``arrival="poisson"`` is the steady open-loop process;
+    ``arrival="burst"`` keeps the head and tail 20% of requests at
+    ``rate_rps`` but drives the middle 60% at ``rate_rps *
+    burst_factor`` — the seeded overload drill (calm → 10× burst → calm)
+    that exercises admission control, shedding, and brownout hysteresis
+    entry AND exit."""
     if rate_rps <= 0:
         raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if arrival not in ("poisson", "burst"):
+        raise ValueError(f"unknown arrival process {arrival!r}")
+    if burst_factor <= 0:
+        raise ValueError(f"burst_factor must be > 0, got {burst_factor}")
     arrival_rng = np.random.default_rng(seed ^ 0x5EED)
-    offsets = np.cumsum(arrival_rng.exponential(1.0 / rate_rps, len(requests)))
+    if arrival == "burst":
+        n = len(requests)
+        rates = np.full(n, rate_rps)
+        lo, hi = int(n * 0.2), int(n * 0.8)
+        rates[lo:hi] = rate_rps * burst_factor
+        # Unit-rate exponential gaps scaled per request: the SAME seeded
+        # gap stream as the poisson process, squeezed where the burst is.
+        offsets = np.cumsum(arrival_rng.exponential(1.0, n) / rates)
+    else:
+        offsets = np.cumsum(
+            arrival_rng.exponential(1.0 / rate_rps, len(requests))
+        )
 
     t0 = time.monotonic()
     for req, offset in zip(requests, offsets):
@@ -153,7 +192,19 @@ def run_loadgen(
         req.done.wait(timeout=30.0)
     completed = [r for r in requests if r.finish_reason in ("eos", "length")]
     failed = [r for r in requests if r.finish_reason == "error"]
-    incomplete = len(requests) - len(completed) - len(failed)
+    # Overload-control outcomes: rejected at submit (fast 429) vs shed
+    # from the queue past-deadline. Neither is a failure — they are the
+    # system degrading AS DESIGNED; serve-bench bounds their fraction
+    # separately (--max-rejected-frac).
+    rejected = [r for r in requests if r.finish_reason == "rejected"]
+    shed = [r for r in requests if r.finish_reason == "shed"]
+    incomplete = (
+        len(requests)
+        - len(completed)
+        - len(failed)
+        - len(rejected)
+        - len(shed)
+    )
     ttft = [r.ttft_ms for r in completed if r.ttft_ms is not None]
     per_token: list[float] = []
     for r in completed:
@@ -162,16 +213,21 @@ def run_loadgen(
     new_tokens = sum(len(r.tokens) for r in completed)
 
     stats = scheduler.stats()
+    arrival_block: dict[str, Any] = {
+        "process": f"{arrival}-open-loop",
+        "rate_rps": rate_rps,
+        "seed": seed,
+    }
+    if arrival == "burst":
+        arrival_block["burst_factor"] = burst_factor
     block: dict[str, Any] = {
-        "arrival": {
-            "process": "poisson-open-loop",
-            "rate_rps": rate_rps,
-            "seed": seed,
-        },
+        "arrival": arrival_block,
         "requests": {
             "submitted": len(requests),
             "completed": len(completed),
             "failed": len(failed),
+            "rejected": len(rejected),
+            "shed": len(shed),
             "timed_out": incomplete,
         },
         "slo": {
@@ -238,6 +294,32 @@ def run_loadgen(
             ],
         }
         block["prefix_cache"] = r["fleet_prefix"]
+
+    if rejected or shed or "overload" in stats:
+        # Overload-control outcomes, gateable like parity: the reason
+        # taxonomy, how FAST the rejections were (a slow rejection is a
+        # failed fast-fail), and the controller's own counters.
+        by_reason: dict[str, int] = {}
+        for r in rejected + shed:
+            key = r.reject_reason or "unknown"
+            by_reason[key] = by_reason.get(key, 0) + 1
+        rejection_latency = [
+            (r.finished_t - r.submitted_t) * 1e3
+            for r in rejected + shed
+            if r.finished_t > 0 and r.submitted_t > 0
+        ]
+        overload_block: dict[str, Any] = {
+            "rejected": len(rejected),
+            "shed": len(shed),
+            "rejected_by_reason": by_reason,
+            "rejection_latency_ms": percentiles(rejection_latency),
+        }
+        controller = stats.get("overload") or stats.get("router", {}).get(
+            "overload"
+        )
+        if controller is not None:
+            overload_block["controller"] = controller
+        block["overload"] = overload_block
 
     registry = scheduler.registry
     if registry is not None:

@@ -49,6 +49,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from ..utils.logging import get_logger
+from .overload import REASON_RETRY_BUDGET, RetryBudget, rejected_counter
 from .paged_kv import chain_hashes
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
 
@@ -57,6 +58,32 @@ logger = get_logger()
 # Cap on hashed blocks per prompt: affinity only needs the head of the
 # prompt (system prompt / template), not an unbounded hash walk.
 _MAX_AFFINITY_BLOCKS = 64
+
+# Overload-aware placement: predicted queue wait converts to load units
+# at this rate, and an in-brownout replica carries a flat penalty — a
+# browning-out replica should lose placement ties without being treated
+# as dead.
+_WAIT_MS_PER_LOAD_UNIT = 100.0
+_BROWNOUT_LOAD_PENALTY = 5.0
+# Load penalty while a replica's 429 backpressure window is open.
+_BACKPRESSURE_LOAD_PENALTY = 10.0
+
+
+class ReplicaBackpressure(Exception):
+    """A replica answered 429: overloaded, not dead. The router fails
+    the request over (budget permitting) without counting the replica
+    toward eviction."""
+
+    def __init__(
+        self, name: str, reason: str | None, retry_after: float | None
+    ) -> None:
+        super().__init__(
+            f"replica {name} backpressured"
+            + (f" ({reason})" if reason else "")
+        )
+        self.replica_name = name
+        self.reason = reason
+        self.retry_after = retry_after
 
 
 class InProcessReplica:
@@ -83,6 +110,13 @@ class InProcessReplica:
         load = float(depth + len(s._active) + len(s._prefilling))
         if s.engine is not None:
             load += s.engine.pool.stats()["utilization"]
+        ov = getattr(s, "_overload", None)
+        if ov is not None:
+            # Backpressure-aware placement: predicted queue wait and the
+            # brownout flag push traffic toward calmer replicas.
+            load += ov.predicted_wait_ms(depth) / _WAIT_MS_PER_LOAD_UNIT
+            if ov.in_brownout:
+                load += _BROWNOUT_LOAD_PENALTY
         return load
 
     def stats(self) -> dict[str, Any]:
@@ -122,37 +156,54 @@ class HTTPReplica:
     def __init__(
         self, base_url: str, name: str | None = None, *,
         timeout_sec: float = 120.0, poll_sec: float = 2.0,
+        probe_timeout_sec: float = 10.0,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.name = name or self.base_url
         self.timeout_sec = float(timeout_sec)
         self.poll_sec = float(poll_sec)
+        # Health/stats probes get their own (short) timeout so a wedged
+        # replica cannot stall the router's health sweep for the full
+        # request timeout (router.probe_timeout_sec).
+        self.probe_timeout_sec = float(probe_timeout_sec)
         self._inflight = 0
         self._lock = threading.Lock()
         self._cached_load = 0.0
         self._cached_at = 0.0
+        # monotonic deadline of the replica's open 429 window; placement
+        # penalizes it until then.
+        self._backpressure_until = 0.0
 
     engine = None  # remote: the router cannot pre-validate against it
 
     def _get(self, path: str) -> dict[str, Any]:
         with urllib.request.urlopen(
-            self.base_url + path, timeout=min(10.0, self.timeout_sec)
+            self.base_url + path,
+            timeout=min(self.probe_timeout_sec, self.timeout_sec),
         ) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
-    def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+    def _post(
+        self,
+        path: str,
+        body: dict[str, Any],
+        headers: dict[str, str] | None = None,
+    ) -> dict[str, Any]:
         data = json.dumps(body).encode("utf-8")
         request = urllib.request.Request(
             self.base_url + path,
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
         )
         with urllib.request.urlopen(request, timeout=self.timeout_sec) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
     def perform(self, req: ServeRequest) -> None:
         """Blocking POST, called on the router's submit thread; raises on
-        transport errors so the router can fail over."""
+        transport errors so the router can fail over. A 429 raises
+        :class:`ReplicaBackpressure` (request fields untouched, so a
+        failover re-perform is clean) and opens the replica's
+        backpressure window."""
         body: dict[str, Any] = {
             "prompt_ids": [int(t) for t in req.prompt_ids],
             "max_new_tokens": int(req.max_new_tokens),
@@ -165,8 +216,32 @@ class HTTPReplica:
             body["top_p"] = float(req.top_p)
         if req.eos_token_id is not None:
             body["eos_token_id"] = int(req.eos_token_id)
+        headers: dict[str, str] = {}
+        if req.rid:
+            headers["X-Request-Id"] = str(req.rid)
+        if req.priority:
+            headers["X-Priority"] = str(req.priority)
+        if req.deadline_ms is not None and req.deadline_ms > 0:
+            # Propagate the REMAINING budget: time already spent in the
+            # router must not be granted again by the replica.
+            elapsed_ms = (
+                (time.monotonic() - req.submitted_t) * 1e3
+                if req.submitted_t > 0
+                else 0.0
+            )
+            remaining = max(1.0, req.deadline_ms - elapsed_ms)
+            headers["X-Deadline-Ms"] = f"{remaining:.1f}"
         try:
-            out = self._post("/v1/generate", body)
+            out = self._post("/v1/generate", body, headers)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 429:
+                reason, retry_after = self._parse_backpressure(exc)
+                with self._lock:
+                    self._backpressure_until = time.monotonic() + retry_after
+                raise ReplicaBackpressure(
+                    self.name, reason, retry_after
+                ) from exc
+            raise
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -177,6 +252,31 @@ class HTTPReplica:
         req.finish_reason = out.get("finish_reason", "length")
         req.finished_t = now
         req.done.set()
+
+    @staticmethod
+    def _parse_backpressure(
+        exc: urllib.error.HTTPError,
+    ) -> tuple[str | None, float]:
+        """Reason + retry-after seconds from a 429 (header first, JSON
+        body as fallback, 1s when neither parses)."""
+        retry_after = 1.0
+        header = exc.headers.get("Retry-After") if exc.headers else None
+        if header is not None:
+            try:
+                retry_after = max(0.0, float(header))
+            except (TypeError, ValueError):
+                pass
+        reason = None
+        try:
+            payload = json.loads(exc.read().decode("utf-8"))
+            reason = payload.get("reason")
+            if header is None and isinstance(
+                payload.get("retry_after"), (int, float)
+            ):
+                retry_after = max(0.0, float(payload["retry_after"]))
+        except Exception:  # noqa: BLE001 — body parse is best-effort
+            pass
+        return reason, retry_after
 
     def submit(self, req: ServeRequest) -> None:
         req.submitted_t = time.monotonic()
@@ -202,22 +302,39 @@ class HTTPReplica:
     def load(self) -> float:
         with self._lock:
             inflight = self._inflight
+            backpressure_until = self._backpressure_until
         now = time.monotonic()
         if now - self._cached_at > self.poll_sec:
             try:
                 sched = self._get("/healthz").get("scheduler", {})
-                self._cached_load = float(
+                load = float(
                     sched.get("queue_depth", 0)
                     + sched.get("active_sequences", 0)
                     + sched.get("prefilling_sequences", 0)
                     + sched.get("kv_pool", {}).get("utilization", 0.0)
                 )
+                ov = sched.get("overload")
+                if isinstance(ov, dict):
+                    # The replica's own backpressure signal: predicted
+                    # queue wait + brownout flag from /healthz.
+                    load += (
+                        float(ov.get("predicted_wait_ms", 0.0))
+                        / _WAIT_MS_PER_LOAD_UNIT
+                    )
+                    if ov.get("in_brownout"):
+                        load += _BROWNOUT_LOAD_PENALTY
+                self._cached_load = load
                 self._cached_at = now
             except Exception:  # noqa: BLE001 — health probe is best-effort
                 pass
+        total = self._cached_load + inflight
+        if now < backpressure_until:
+            # The replica 429'd recently: keep traffic off it until its
+            # Retry-After window closes.
+            total += _BACKPRESSURE_LOAD_PENALTY
         # In-flight submits routed here but not yet visible in the remote
         # queue stats keep bursts from all landing on one replica.
-        return self._cached_load + inflight
+        return total
 
     def stats(self) -> dict[str, Any]:
         try:
@@ -284,6 +401,8 @@ class ReplicaRouter:
         fail_threshold: int = 3,
         revive_sec: float = 10.0,
         block_tokens: int | None = None,
+        retry_budget: int = 0,
+        retry_window_sec: float = 10.0,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -292,6 +411,16 @@ class ReplicaRouter:
         self.max_affinity_entries = int(max_affinity_entries)
         self.fail_threshold = int(fail_threshold)
         self.revive_sec = float(revive_sec)
+        # Fleet-wide failover retry budget: an overloaded fleet must not
+        # be DDoS'd by its own router re-sending every 429. 0 = unlimited
+        # (the pre-overload-control behavior).
+        self._retry_budget = (
+            RetryBudget(int(retry_budget), float(retry_window_sec))
+            if retry_budget > 0
+            else None
+        )
+        self.retry_window_sec = float(retry_window_sec)
+        self.retries_rejected = 0
         self._states = [_ReplicaState(r) for r in replicas]
         if block_tokens is None:
             block_tokens = 16
@@ -506,6 +635,20 @@ class ReplicaRouter:
         try:
             replica.perform(req)
             self._note_success(idx)
+        except ReplicaBackpressure as exc:
+            # 429 = overloaded, not dead: no eviction strike; the replica
+            # already opened its backpressure window for placement.
+            logger.warning(
+                "router: replica %s backpressured request %d (%s)",
+                replica.name, req.request_id, exc.reason,
+            )
+            try:
+                self._failover(req, exclude={idx}, cause=exc)
+            except Exception as exc2:  # noqa: BLE001 — out of replicas
+                req.error = str(exc2)
+                req.finish_reason = "error"
+                req.finished_t = time.monotonic()
+                req.done.set()
         except Exception as exc:  # noqa: BLE001 — transport error: failover
             self._note_failure(idx, exc)
             try:
@@ -516,9 +659,31 @@ class ReplicaRouter:
                 req.finished_t = time.monotonic()
                 req.done.set()
 
+    def _reject_retry(self, req: ServeRequest, cause: Exception) -> None:
+        """Retry budget exhausted: finish the request as rejected (fast,
+        honest 429 to the client) instead of re-hammering the fleet."""
+        with self._lock:
+            self.retries_rejected += 1
+        req.reject_reason = REASON_RETRY_BUDGET
+        req.retry_after_sec = (
+            getattr(cause, "retry_after", None) or self.retry_window_sec
+        )
+        req.finish_reason = "rejected"
+        req.finished_t = time.monotonic()
+        if self.registry is not None:
+            self.registry.inc(rejected_counter(REASON_RETRY_BUDGET))
+        logger.warning(
+            "router: retry budget exhausted; rejecting request %d (%s)",
+            req.request_id, cause,
+        )
+        req.done.set()
+
     def _failover(
         self, req: ServeRequest, *, exclude: set[int], cause: Exception
     ) -> ServeRequest:
+        if self._retry_budget is not None and not self._retry_budget.try_spend():
+            self._reject_retry(req, cause)
+            return req
         healthy = [i for i in self._healthy_indices() if i not in exclude]
         if self._canary_idx is not None and len(healthy) > 1:
             # Never fail live traffic over onto an unproven canary while
@@ -617,10 +782,16 @@ class ReplicaRouter:
         }
         policy = None
         prefix_hits = prefix_queries = prefix_hit_queries = prefix_tokens = 0
+        ov_rejected = ov_shed = ov_brownout = 0
         fleet_steps: set[Any] = set()
         for i, s in enumerate(self._states):
             rs = s.replica.stats() if s.healthy else {"evicted": True}
             policy = policy or rs.get("policy")
+            ov = rs.get("overload")
+            if isinstance(ov, dict):
+                ov_rejected += int(ov.get("rejected_total", 0))
+                ov_shed += int(ov.get("shed", 0))
+                ov_brownout += int(bool(ov.get("in_brownout")))
             for k in agg:
                 v = rs.get(k)
                 if isinstance(v, (int, float)):
@@ -675,6 +846,19 @@ class ReplicaRouter:
                 "traffic_frac": self._canary_frac,
                 "routed": self.canary_routed,
             },
+            "overload": {
+                # Fleet-wide overload picture: summed replica counters
+                # plus the router's own retry-budget state.
+                "rejected_total": ov_rejected,
+                "shed": ov_shed,
+                "replicas_in_brownout": ov_brownout,
+                "retries_rejected": self.retries_rejected,
+                "retry_budget_remaining": (
+                    self._retry_budget.remaining()
+                    if self._retry_budget is not None
+                    else None
+                ),
+            },
             "fleet_prefix": {
                 "hits": prefix_hits,
                 "queries": prefix_queries,
@@ -705,7 +889,19 @@ class ReplicaRouter:
             "router/active_sequences": float(stats["active_sequences"]),
             "router/epoch_divergence": float(r["epoch_divergence"]),
             "router/canary_routed": float(r["canary"]["routed"]),
+            "router/rejected_total": float(r["overload"]["rejected_total"]),
+            "router/shed_total": float(r["overload"]["shed"]),
+            "router/replicas_in_brownout": float(
+                r["overload"]["replicas_in_brownout"]
+            ),
+            "router/retries_rejected": float(
+                r["overload"]["retries_rejected"]
+            ),
         }
+        if r["overload"]["retry_budget_remaining"] is not None:
+            gauges["router/retry_budget_remaining"] = float(
+                r["overload"]["retry_budget_remaining"]
+            )
         for i, rep in enumerate(r["replicas"]):
             gauges[f"router/replica{i}_healthy"] = float(bool(rep["healthy"]))
             gauges[f"router/replica{i}_routed"] = float(rep["routed"])
@@ -759,6 +955,7 @@ def resolve_backends(discover: str) -> list[str]:
 __all__ = [
     "HTTPReplica",
     "InProcessReplica",
+    "ReplicaBackpressure",
     "ReplicaRouter",
     "resolve_backends",
 ]

@@ -65,6 +65,11 @@ import numpy as np
 
 from ..utils.logging import get_logger
 from .engine import PagedDecodeEngine
+from .overload import (
+    REASON_DEADLINE_EXCEEDED,
+    OverloadController,
+    rejected_counter,
+)
 
 logger = get_logger()
 
@@ -97,6 +102,20 @@ class ServeRequest:
     # Checkpoint step of the params this request was ADMITTED under
     # (hot-swap audit trail: parity must check against these params).
     params_step: int | None = None
+    # Overload control (serving/overload.py): the client's latency budget
+    # from submit (X-Deadline-Ms over HTTP), the priority class the
+    # weighted admission queue dequeues by, and the client-supplied
+    # correlation id (X-Request-Id) tagged on the timeline spans.
+    deadline_ms: float | None = None
+    priority: str = "interactive"
+    rid: str | None = None
+    # Queue depth seen at submit — the EWMA wait estimator's x-axis.
+    queue_depth_at_submit: int = 0
+    # Set when the overload layer rejected/shed this request: the
+    # {reason} label on llmtrain_serve_rejected_total, and the 429
+    # Retry-After hint (seconds).
+    reject_reason: str | None = None
+    retry_after_sec: float | None = None
     done: threading.Event = field(default_factory=threading.Event)
     # Set by a waiter that gave up (HTTP timeout, loadgen deadline): the
     # scheduler sheds the request — queued or in flight — instead of
@@ -153,6 +172,7 @@ class ContinuousBatchingScheduler:
         draft_engine: PagedDecodeEngine | None = None,
         gamma: int = 4,
         timeline: Any | None = None,  # telemetry EventTimeline
+        overload: OverloadController | None = None,
     ) -> None:
         if policy not in ("paged", "speculative"):
             raise ValueError(
@@ -199,7 +219,12 @@ class ContinuousBatchingScheduler:
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._queue: deque[ServeRequest] = deque()
+        # Overload control (serving/overload.py): with a controller the
+        # admission queue becomes its bounded weighted-class queue and
+        # submit() can reject synchronously; without one the original
+        # unbounded FIFO behavior is unchanged.
+        self._overload = overload
+        self._queue: Any = overload.queue if overload is not None else deque()
         self._active: list[_Row] = []
         # Rows still streaming their prompt in under chunked prefill —
         # they hold a batch slot (their KV is resident) but don't decode.
@@ -240,15 +265,63 @@ class ContinuousBatchingScheduler:
     # ----------------------------------------------------------- frontend
 
     def submit(self, req: ServeRequest) -> ServeRequest:
-        """Thread-safe enqueue; returns immediately (wait on ``req.done``)."""
+        """Thread-safe enqueue; returns immediately (wait on ``req.done``).
+
+        With an overload controller attached the admission verdict is
+        SYNCHRONOUS: a rejected request comes back with ``done`` already
+        set, ``finish_reason == "rejected"``, and a ``reject_reason`` /
+        ``retry_after_sec`` the HTTP layer maps to 429 + Retry-After —
+        the caller never waits on a request that was never admitted."""
         req.submitted_t = time.monotonic()
         req.submitted_pc = time.perf_counter()
+        verdict: tuple[str, float] | None = None
         with self._wake:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
-            self._queue.append(req)
-            self._wake.notify()
+            if self._overload is not None:
+                if req.deadline_ms is None and self._overload.default_deadline_ms:
+                    req.deadline_ms = self._overload.default_deadline_ms
+                depth = len(self._queue)
+                req.queue_depth_at_submit = depth
+                verdict = self._overload.admission_check(req, depth)
+            if verdict is None:
+                self._queue.append(req)
+                self._wake.notify()
+        if verdict is not None:
+            reason, retry_after = verdict
+            self._reject(req, reason, retry_after=retry_after)
         return req
+
+    def _reject(
+        self,
+        req: ServeRequest,
+        reason: str,
+        *,
+        retry_after: float | None = None,
+        shed: bool = False,
+    ) -> None:
+        """Finalize an overload rejection: ``rejected`` at submit time,
+        ``shed`` for a queued request dropped past its deadline. Every
+        rejection lands as a labeled counter + timeline instant."""
+        req.reject_reason = reason
+        if retry_after is not None:
+            req.retry_after_sec = retry_after
+        req.finish_reason = "shed" if shed else "rejected"
+        req.finished_t = time.monotonic()
+        if self._overload is not None:
+            self._overload.note_rejection(reason, shed=shed)
+        if self.registry is not None:
+            self.registry.inc(rejected_counter(reason))
+        if self.timeline is not None:
+            extra = {"rid": req.rid} if req.rid else {}
+            self.timeline.instant(
+                "serve/rejected",
+                cat="serve",
+                reason=reason,
+                request_id=req.request_id,
+                **extra,
+            )
+        req.done.set()
 
     def hot_swap(
         self,
@@ -279,15 +352,24 @@ class ContinuousBatchingScheduler:
     def _record_queue_wait(self, req: ServeRequest) -> None:
         """Queue-wait span from the submit stamp to now — with the
         request_id tag it abuts the same request's prefill span, so one
-        request's queue-wait → prefill → decode path reads as a track."""
+        request's queue-wait → prefill → decode path reads as a track.
+        Also the overload estimator's learning signal: the OBSERVED wait
+        at the depth the request saw is what calibrates predicted wait."""
+        if self._overload is not None and req.submitted_t > 0.0:
+            self._overload.observe_queue_wait(
+                (time.monotonic() - req.submitted_t) * 1e3,
+                req.queue_depth_at_submit,
+            )
         if self.timeline is None or req.submitted_pc <= 0.0:
             return
+        extra = {"rid": req.rid} if req.rid else {}
         self.timeline.record(
             "serve/queue_wait",
             t0=req.submitted_pc,
             t1=time.perf_counter(),
             cat="serve",
             request_id=req.request_id,
+            **extra,
         )
 
     # -------------------------------------------------------- param epochs
@@ -354,9 +436,44 @@ class ContinuousBatchingScheduler:
         """One scheduler iteration: join, advance, evict. Returns whether
         any work happened (False = idle)."""
         swapped = self._apply_pending_swap()
+        shed = self._overload_tick()
         if self.policy == "speculative":
-            return self._step_speculative() or swapped
-        return self._step_paged() or swapped
+            return self._step_speculative() or swapped or shed
+        return self._step_paged() or swapped or shed
+
+    def _overload_tick(self) -> bool:
+        """Per-step overload bookkeeping: feed the brownout hysteresis
+        one pressure sample, and under sustained overload eagerly shed
+        queued requests already past their deadline (their waiters get a
+        fast 429 instead of a slow timeout, and the queue drains toward
+        requests that can still make their SLO)."""
+        ov = self._overload
+        if ov is None:
+            return False
+        with self._lock:
+            depth = len(self._queue)
+        transition = ov.tick(depth)
+        if transition is not None:
+            logger.warning(
+                "serve: brownout %s (predicted queue wait %.1f ms, "
+                "queue depth %d)",
+                transition, ov.predicted_wait_ms(depth), depth,
+            )
+            if self.timeline is not None:
+                self.timeline.instant(
+                    f"serve/brownout_{transition}",
+                    cat="serve",
+                    predicted_wait_ms=round(ov.predicted_wait_ms(depth), 3),
+                    queue_depth=depth,
+                )
+        if not ov.shedding_active:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            expired = self._queue.sweep(lambda r: ov.past_deadline(r, now))
+        for req in expired:
+            self._reject(req, REASON_DEADLINE_EXCEEDED, shed=True)
+        return bool(expired)
 
     def _admit_paged(self, req: ServeRequest, overshoot: int = 0) -> _Row | None:
         """Reserve + prefix-bind one popped request (paged path). Returns
@@ -397,12 +514,14 @@ class ContinuousBatchingScheduler:
         slab = row.req.prompt_ids[start:end]
         final = end == row.prompt_len
         engine.pool.grow(row.table, end)
+        extra = {"rid": row.req.rid} if row.req.rid else {}
         try:
             with self._span(
                 "serve/prefill",
                 request_id=row.req.request_id,
                 prompt_tokens=end - start,
                 offset=start,
+                **extra,
             ):
                 tok = engine.prefill(
                     slab,
@@ -467,15 +586,31 @@ class ContinuousBatchingScheduler:
         # be starved by a stream of small ones slipping past it.
         admitted = 0
         while len(self._active) + len(self._prefilling) < self.max_batch_slots:
+            # Pop-first (the weighted-class queue's head is only defined
+            # by the pop itself); a pool-full admission pushes the
+            # request back to the FRONT of its own class, so ordering
+            # within a class stays head-of-line.
             with self._lock:
-                req = self._queue[0] if self._queue else None
+                req = self._queue.popleft() if self._queue else None
             if req is None:
                 break
             if req.abandoned.is_set():
-                with self._lock:
-                    self._queue.popleft()
                 self._retire_abandoned(req)
                 continue
+            if (
+                self._overload is not None
+                and self._overload.shedding_active
+                and self._overload.past_deadline(req)
+            ):
+                self._reject(req, REASON_DEADLINE_EXCEEDED, shed=True)
+                continue
+            if self._overload is not None:
+                # Brownout clamp BEFORE validation/reservation: the
+                # clamped budget is what the request decodes (and what
+                # parity re-checks) under.
+                req.max_new_tokens = self._overload.clamp_new_tokens(
+                    req.max_new_tokens
+                )
             # The HTTP layer pre-validates, but the scheduler must survive
             # direct submitters too: a request this engine can NEVER serve
             # (context bound, prompt bucket, worst-case need > whole pool)
@@ -485,15 +620,14 @@ class ContinuousBatchingScheduler:
                 int(req.prompt_ids.shape[0]), int(req.max_new_tokens)
             )
             if reason is not None:
-                with self._lock:
-                    self._queue.popleft()
                 self._fail(req, ValueError(reason))
                 continue
             row = self._admit_paged(req)
             if row is None:
-                break  # pool full: stays queued, retried next step
-            with self._lock:
-                self._queue.popleft()
+                # Pool full: back to its class head, retried next step.
+                with self._lock:
+                    self._queue.appendleft(req)
+                break
             # Shared-prefix reuse: bind cached blocks read-only BEFORE any
             # grow; prefill then runs only the unmatched suffix. A partial
             # block match needs a private copy (COW) before its divergent
@@ -577,12 +711,15 @@ class ContinuousBatchingScheduler:
                             "top_p": 0.0 if r.req.top_p is None else r.req.top_p,
                         }
                     )
+                rids = [r.req.rid for r in group if r.req.rid]
+                extra = {"rids": rids} if rids else {}
                 try:
                     with self._span(
                         "serve/decode",
                         request_ids=[r.req.request_id for r in group],
                         batch=len(rows),
                         param_epoch=ep,
+                        **extra,
                     ):
                         toks = engine.decode(
                             rows, params=self._params_by_epoch[ep]
@@ -678,6 +815,18 @@ class ContinuousBatchingScheduler:
             self._retire_abandoned(req)
             self._publish_metrics()
             return True
+        if (
+            self._overload is not None
+            and self._overload.shedding_active
+            and self._overload.past_deadline(req)
+        ):
+            self._reject(req, REASON_DEADLINE_EXCEEDED, shed=True)
+            self._publish_metrics()
+            return True
+        if self._overload is not None:
+            req.max_new_tokens = self._overload.clamp_new_tokens(
+                req.max_new_tokens
+            )
         self.peak_occupancy = max(self.peak_occupancy, 1)
         self._occupancy_samples += 1
         self._occupancy_total += 1
@@ -700,20 +849,30 @@ class ContinuousBatchingScheduler:
         epoch_guard = engine.cache_epoch
         admitted = 0
         while len(self._active) < self.max_batch_slots:
+            # Pop-first, like the paged join: the weighted-class queue's
+            # head is only defined by the pop; resource-full paths push
+            # the request back to the front of its class.
             with self._lock:
-                req = self._queue[0] if self._queue else None
+                req = self._queue.popleft() if self._queue else None
             if req is None:
                 break
             if req.abandoned.is_set():
-                with self._lock:
-                    self._queue.popleft()
                 self._retire_abandoned(req)
                 continue
+            if (
+                self._overload is not None
+                and self._overload.shedding_active
+                and self._overload.past_deadline(req)
+            ):
+                self._reject(req, REASON_DEADLINE_EXCEEDED, shed=True)
+                continue
+            if self._overload is not None:
+                req.max_new_tokens = self._overload.clamp_new_tokens(
+                    req.max_new_tokens
+                )
             if req.temperature > 0.0:
                 # Sampled: categorical draws aren't replayable across the
                 # batched slab; serve batch-1 (same results as before).
-                with self._lock:
-                    self._queue.popleft()
                 self._record_queue_wait(req)
                 self._serve_speculative_single(req)
                 admitted += 1
@@ -724,20 +883,20 @@ class ContinuousBatchingScheduler:
                 tp, need
             )
             if reason is not None:
-                with self._lock:
-                    self._queue.popleft()
                 self._fail(req, ValueError(reason))
                 continue
             row = self._admit_paged(req, overshoot=gamma)
             if row is None:
+                with self._lock:
+                    self._queue.appendleft(req)
                 break
             row.draft_table = draft.pool.try_reserve(tp + need)
             if row.draft_table is None:
                 engine.pool.release(row.table)
                 self._unpin_epoch(row.epoch)
+                with self._lock:
+                    self._queue.appendleft(req)
                 break
-            with self._lock:
-                self._queue.popleft()
             engine.pool.grow(row.table, tp)
             draft.pool.grow(row.draft_table, tp)
             self._record_queue_wait(req)
@@ -788,6 +947,18 @@ class ContinuousBatchingScheduler:
             self.peak_occupancy = max(self.peak_occupancy, occupancy)
             self._occupancy_samples += 1
             self._occupancy_total += occupancy
+            # Brownout disables speculation: zero drafts per round (the
+            # one draft-feed decode still runs so the draft KV stays
+            # position-synced for the exit), and a width-1 verify emits
+            # exactly one guaranteed-correct token per row — no device
+            # time is spent on lookahead the overloaded fleet would
+            # mostly throw away. Reservations were taken at full γ, so
+            # flipping per step is always within budget.
+            live_gamma = (
+                0
+                if self._overload is not None and self._overload.in_brownout
+                else gamma
+            )
             # ---- draft γ tokens per row, batched across rows; round γ
             # re-feeds the final draft so its K/V is resident next step.
             rows_now = list(self._active)
@@ -798,9 +969,9 @@ class ContinuousBatchingScheduler:
                 with self._span(
                     "serve/speculative_draft",
                     batch=len(rows_now),
-                    gamma=gamma,
+                    gamma=live_gamma,
                 ):
-                    for j in range(gamma + 1):
+                    for j in range(live_gamma + 1):
                         drows = []
                         for i, r in enumerate(rows_now):
                             pos = base[i] + j
@@ -820,7 +991,7 @@ class ContinuousBatchingScheduler:
                                 }
                             )
                         out = draft.decode(drows)
-                        if j < gamma:
+                        if j < live_gamma:
                             for i, t in enumerate(out):
                                 drafts[i].append(int(t))
                             prev = [int(t) for t in out]
@@ -839,7 +1010,7 @@ class ContinuousBatchingScheduler:
                 vrows = []
                 for i in idxs:
                     r = rows_now[i]
-                    engine.pool.grow(r.table, base[i] + gamma + 1)
+                    engine.pool.grow(r.table, base[i] + live_gamma + 1)
                     vrows.append(
                         {
                             "tokens": [r.req.tokens[-1]] + drafts[i],
@@ -851,12 +1022,12 @@ class ContinuousBatchingScheduler:
                     with self._span(
                         "serve/speculative_verify",
                         batch=len(vrows),
-                        width=gamma + 1,
+                        width=live_gamma + 1,
                         param_epoch=ep,
                     ):
                         outs = engine.verify(
                             vrows,
-                            width=gamma + 1,
+                            width=live_gamma + 1,
                             params=self._params_by_epoch[ep],
                         )
                 except Exception as exc:  # noqa: BLE001 — contain
@@ -870,12 +1041,12 @@ class ContinuousBatchingScheduler:
                 for i, a in zip(idxs, outs):
                     r, d = rows_now[i], drafts[i]
                     self.spec_rounds += 1
-                    self.spec_drafted += gamma
+                    self.spec_drafted += live_gamma
                     # a[j] = target argmax given drafts < j: emit a[0],
                     # then keep extending while the draft guessed it.
                     emitted = [a[0]]
                     acc = 0
-                    while acc < gamma and d[acc] == a[acc]:
+                    while acc < live_gamma and d[acc] == a[acc]:
                         emitted.append(a[acc + 1])
                         acc += 1
                     self.spec_accepted += acc
@@ -986,6 +1157,16 @@ class ContinuousBatchingScheduler:
             metrics["serve/spec_acceptance_rate"] = round(
                 self.spec_accepted / self.spec_drafted, 4
             )
+        if self._overload is not None:
+            # The SLO-facing overload gauges: predicted wait is what
+            # admission decides on, brownout is the degraded-mode flag
+            # operators alert on (llmtrain_serve_brownout).
+            metrics["serve/predicted_wait_ms"] = round(
+                self._overload.predicted_wait_ms(depth), 3
+            )
+            metrics["serve/brownout"] = (
+                1.0 if self._overload.in_brownout else 0.0
+            )
         self.registry.publish(metrics)
 
     # ----------------------------------------------------------- lifecycle
@@ -1024,6 +1205,11 @@ class ContinuousBatchingScheduler:
             ),
             "beacon_age_sec": round(time.monotonic() - self._beacon, 3),
         }
+        if self._overload is not None:
+            # Backpressure surface: /healthz exposes this block, and the
+            # router's placement penalizes replicas whose predicted wait
+            # or brownout flag says "don't send more here".
+            out["overload"] = self._overload.stats()
         if self.engine is not None:
             out["kv_pool"] = self.engine.pool.stats()
             out["compile"] = self.engine.compile_stats()

@@ -66,6 +66,19 @@ def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+_LABELED_RE = re.compile(r"^(?P<base>[^{]+)(?P<labels>\{.*\})$")
+
+
+def _split_labels(metric: str) -> tuple[str, str]:
+    """Registry keys may embed a label set (``serve/rejected{reason="x"}``
+    — how per-reason counters share one Prometheus metric family). Split
+    into (base metric, labels-or-empty); only the base gets name-folded."""
+    m = _LABELED_RE.match(metric)
+    if m is None:
+        return metric, ""
+    return m.group("base"), m.group("labels")
+
+
 def render_prometheus(
     gauges: dict[str, tuple[float, int | None]],
     counters: dict[str, float] | None = None,
@@ -86,15 +99,25 @@ def render_prometheus(
         )
         lines.append("# TYPE llmtrain_run_info gauge")
         lines.append(f"llmtrain_run_info{{{labels}}} 1")
+    # Labeled series (serve/rejected{reason="..."}) share one family:
+    # emit one TYPE line per family, however many labeled samples.
+    typed: set[str] = set()
     for metric in sorted(gauges):
         value, _step = gauges[metric]
-        name = prometheus_name(metric)
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {_fmt_value(value)}")
+        base, labels = _split_labels(metric)
+        name = prometheus_name(base)
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {_fmt_value(value)}")
+    typed.clear()
     for metric in sorted(counters or {}):
-        name = prometheus_name(metric) + "_total"
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {_fmt_value((counters or {})[metric])}")
+        base, labels = _split_labels(metric)
+        name = prometheus_name(base) + "_total"
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{labels} {_fmt_value((counters or {})[metric])}")
     return "\n".join(lines) + "\n"
 
 

@@ -396,6 +396,108 @@ class TestServingConfig:
             RunConfig.model_validate({**MINIMAL, "serving": serving})
 
 
+class TestOverloadConfig:
+    """serving.overload: section (serving/overload.py, docs/serving.md
+    "Overload and SLOs")."""
+
+    def test_defaults_off_with_sane_knobs(self):
+        cfg = RunConfig.model_validate(MINIMAL)
+        ov = cfg.serving.overload
+        assert ov.enabled is False  # opt-in: admission stays unbounded
+        assert ov.queue_cap == 64
+        assert ov.default_deadline_ms == 0.0  # 0 = no implied deadline
+        assert ov.classes == {"interactive": 4, "batch": 1}
+        assert ov.default_class == "interactive"
+        assert ov.class_rate_rps == {} and ov.class_burst == {}
+        assert ov.client_rate_rps == 0.0  # per-client gate off
+        assert ov.brownout_low_ms < ov.brownout_high_ms
+        assert ov.brownout_enter_ticks >= 1 and ov.brownout_exit_ticks >= 1
+        # Router-side overload knobs.
+        assert cfg.serving.router.probe_timeout_sec == 10.0
+        assert cfg.serving.router.retry_budget == 16
+        assert cfg.serving.router.retry_window_sec == 10.0
+
+    def test_full_overload_section_round_trips(self):
+        cfg = RunConfig.model_validate(
+            {
+                **MINIMAL,
+                "serving": {
+                    "mode": "continuous",
+                    "overload": {
+                        "enabled": True,
+                        "queue_cap": 32,
+                        "default_deadline_ms": 2000.0,
+                        "classes": {"interactive": 8, "batch": 1},
+                        "class_rate_rps": {"batch": 50.0},
+                        "class_burst": {"batch": 10},
+                        "client_rate_rps": 20.0,
+                        "brownout_high_ms": 800.0,
+                        "brownout_low_ms": 200.0,
+                        "brownout_max_new_tokens": 8,
+                    },
+                    "router": {"probe_timeout_sec": 2.5, "retry_budget": 4},
+                },
+            }
+        )
+        ov = cfg.serving.overload
+        assert ov.enabled and ov.queue_cap == 32
+        assert ov.classes["interactive"] == 8
+        assert ov.class_rate_rps == {"batch": 50.0}
+        assert cfg.serving.router.probe_timeout_sec == 2.5
+        # And the controller builds straight off the section.
+        from llmtrain_tpu.serving.overload import OverloadController
+
+        ctl = OverloadController.from_config(ov)
+        assert ctl.queue_cap == 32
+        assert set(ctl.buckets) == {"batch"}
+
+    @pytest.mark.parametrize(
+        "overload",
+        [
+            {"queue_cap": 0},
+            {"ewma_beta": 0.0},
+            {"ewma_beta": 1.0},
+            {"prior_wait_ms": -1.0},
+            {"classes": {}},  # at least one class
+            {"classes": {"interactive": 0}},  # weights >= 1
+            {"default_class": "platinum"},  # must be a declared class
+            {"class_rate_rps": {"platinum": 1.0}},  # unknown class
+            {"class_rate_rps": {"batch": 0.0}},  # rates > 0
+            {"class_burst": {"platinum": 4}},  # unknown class
+            {"class_burst": {"batch": 0}},  # burst >= 1
+            {"client_rate_rps": -1.0},
+            {"client_burst": 0},
+            {"max_tracked_clients": 0},
+            # Hysteresis needs a real gap: low must sit BELOW high.
+            {"brownout_high_ms": 100.0, "brownout_low_ms": 100.0},
+            {"brownout_high_ms": 100.0, "brownout_low_ms": 200.0},
+            {"brownout_enter_ticks": 0},
+            {"brownout_exit_ticks": 0},
+            {"brownout_max_new_tokens": 0},
+            {"bogus": 1},  # strict: typos rejected
+        ],
+    )
+    def test_rejections(self, overload):
+        with pytest.raises(Exception):
+            RunConfig.model_validate(
+                {**MINIMAL, "serving": {"overload": overload}}
+            )
+
+    @pytest.mark.parametrize(
+        "router",
+        [
+            {"probe_timeout_sec": 0},
+            {"retry_budget": -1},
+            {"retry_window_sec": 0},
+        ],
+    )
+    def test_router_overload_knob_rejections(self, router):
+        with pytest.raises(Exception):
+            RunConfig.model_validate(
+                {**MINIMAL, "serving": {"router": router}}
+            )
+
+
 class TestZeroConfig:
     """trainer.zero: section (parallel/sharding.py:opt_state_shardings,
     docs/perf.md "Sharded optimizer state")."""

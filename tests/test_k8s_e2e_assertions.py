@@ -432,6 +432,31 @@ class TestServeManifest:
                     f"kill budget ({budget}s)"
                 )
 
+    def test_overload_control_pinned_in_serve_config(self, manifests):
+        """The fleet ships with SLO-aware overload control ON
+        (serving/overload.py): bounded admission, priority classes, a
+        real brownout hysteresis gap, and the router's probe timeout /
+        retry budget. A replica under pressure answers 429/503 WITH
+        Retry-After (serving/http.py lifts it into the header), so the
+        kubelet probes and the router both know when to come back —
+        these knobs are the contract that behavior hangs off."""
+        for cm in _by_kind(manifests["configmap.yaml"], "ConfigMap"):
+            if "serve.yaml" not in cm.get("data", {}):
+                continue
+            serving = yaml.safe_load(cm["data"]["serve.yaml"])["serving"]
+            ov = serving["overload"]
+            assert ov["enabled"] is True
+            assert ov["queue_cap"] >= 1
+            assert ov["brownout_low_ms"] < ov["brownout_high_ms"]
+            assert set(ov["classes"]) >= {"interactive", "batch"}
+            router = serving["router"]
+            # The probe timeout must undercut the liveness window: a
+            # wedged replica has to fail its health sweep BEFORE the
+            # kubelet's own probe budget runs out.
+            assert router["probe_timeout_sec"] < serving["liveness_stale_sec"]
+            assert router["retry_budget"] >= 0
+            assert router["retry_window_sec"] > 0
+
     def test_prometheus_annotations_point_at_the_serve_port(self, manifests):
         """The inference server exposes llmtrain_serve_* on its OWN HTTP
         port (serving/http.py /metrics) — the scrape annotation must
