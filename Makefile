@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-overload verify-zero verify-fleet verify-profile verify-quant verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-overload verify-zero verify-fleet verify-profile verify-quant verify-fusedce verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -100,6 +100,20 @@ verify-tune:
 # own self-test (new-key/removed-key/degraded-parity matrix cases).
 verify-quant:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant_train.py -q
+	python tools/perf_gate.py --self-test
+
+# Fused lm-head + CE suite (docs/perf.md "Fused lm-head + CE"):
+# interpret-mode Pallas kernel parity (fwd per-token loss + dhidden/dW)
+# vs chunked_ce and dense across tied/untied heads, z_loss on/off and
+# non-block-multiple shapes, the fused residual-add+LayerNorm kernel,
+# loss_impl/fused_norm resolution + capability fallbacks, and the
+# planner's logits-buffer accounting — PLUS the @pytest.mark.slow fits
+# plain `make test` skips: 5-step fused-vs-dense loss parity, the
+# checkpoint resume with loss_impl flipped across the boundary, and the
+# attribution pin (no dot materializes the [B,T,V] logits under
+# fused_ce). Ends with the perf gate's self-test (fused matrix cases).
+verify-fusedce:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fused_ce.py -q
 	python tools/perf_gate.py --self-test
 
 # Activation-tier suite (docs/perf.md "Activation tiers and host

@@ -53,6 +53,12 @@ _PAR_RTOL = 2e-3
 # "Quantized training"): N quantized steps must track the f32 trajectory
 # within these relative tolerances or the scenario line fails as degraded.
 _MATRIX_RTOL = {"int8": 0.05, "int8_act": 0.05, "fp8": 0.10}
+# Loss-parity band for the CE-implementation matrix lines (chunked/fused
+# vs the dense-CE twin from the same init, docs/perf.md "Fused lm-head +
+# CE"): all three compute the SAME loss, so the band only absorbs fp
+# reduction-order noise amplified over the steps — far tighter than the
+# quantization bands above.
+_CE_PARITY_RTOL = 5e-4
 
 
 # --------------------------------------------------------------------------
@@ -889,16 +895,42 @@ def _matrix_scenarios() -> list[dict]:
     (exact-attention claim, docs/perf.md "Sequence parallelism")."""
     base = {"model": "gpt", "seq": 64, "batch": 8, "steps": 3, "extra": {}}
 
-    def spec(key: str, **kw) -> dict:
+    def spec(key: str, ce_parity: bool = False, **kw) -> dict:
         out = {**base, "key": key, **kw}
         out["extra"] = {**kw.get("extra", {})}
         prec = out["extra"].get("matmul_precision", "f32")
         out["parity_rtol"] = _MATRIX_RTOL.get(prec)
+        if ce_parity:
+            out["ce_parity_rtol"] = _CE_PARITY_RTOL
         return out
 
     return [
         spec("dense|short|dense_ce|f32", extra={"loss_impl": "dense"}),
         spec("dense|short|chunked_ce|f32", extra={"loss_impl": "chunked_ce"}),
+        # CE-implementation ladder at the 50k-vocab bench shape: dense vs
+        # chunked vs fused measured head-to-head where the logits buffer
+        # actually dominates (at V=512 the lm-head is a rounding error).
+        # The fused line runs the real Pallas kernel logic under
+        # interpret=True on CPU; big blocks keep the emulated grid small
+        # (N=512 tokens -> 1 token block, 50304/8192 -> 7 vocab blocks).
+        spec("dense|50k|dense_ce|f32", vocab=50304, extra={"loss_impl": "dense"}),
+        spec(
+            "dense|50k|chunked_ce|f32",
+            vocab=50304,
+            ce_parity=True,
+            extra={"loss_impl": "chunked_ce"},
+        ),
+        spec(
+            "dense|50k|fused_ce|f32",
+            vocab=50304,
+            ce_parity=True,
+            extra={
+                "loss_impl": "fused_ce",
+                "pallas_interpret": True,
+                "fused_ce_block_t": 512,
+                "fused_ce_block_v": 8192,
+            },
+        ),
         spec(
             "dense|short|dense_ce|int8",
             extra={"loss_impl": "dense", "matmul_precision": "int8"},
@@ -1164,7 +1196,8 @@ def _matrix_main() -> None:
         _matrix_par_main(spec)
         return
     seq, batch, steps = spec["seq"], spec["batch"], spec["steps"]
-    depth, d_model, n_heads, d_ff, vocab = 2, 128, 4, 256, 512
+    depth, d_model, n_heads, d_ff = 2, 128, 4, 256
+    vocab = spec.get("vocab", 512)
 
     def measure(extra: dict) -> dict:
         cfg = RunConfig.model_validate(
@@ -1297,6 +1330,33 @@ def _matrix_main() -> None:
             "ok": True,
             "note": f"{requested} unsupported on this backend; f32 fallback measured",
         }
+    ce_rtol = spec.get("ce_parity_rtol")
+    if ce_rtol is not None:
+        # CE-implementation parity gate: the dense-CE twin from the same
+        # init computes the IDENTICAL loss, so chunked/fused trajectories
+        # must track it to fp reduction-order noise.
+        dense_extra = {**spec["extra"], "loss_impl": "dense"}
+        ref = measure(dense_extra)
+        diffs = [
+            abs(q - f) / max(abs(f), 1e-6)
+            for q, f in zip(measured["losses"], ref["losses"])
+        ]
+        max_rel = max(diffs) if diffs else 0.0
+        ok = max_rel <= ce_rtol
+        line["parity"] = {
+            "vs": "dense CE, same init",
+            "rtol": ce_rtol,
+            "max_rel_diff": round(max_rel, 6),
+            "ok": ok,
+            "dense_losses": ref["losses"],
+            "dense_tokens_per_sec": ref["tokens_per_sec"],
+        }
+        if not ok:
+            line["degraded"] = True
+            line["fallback"] = (
+                f"loss parity vs dense CE failed: max rel diff "
+                f"{max_rel:.6f} > rtol {ce_rtol}"
+            )
     print(json.dumps({"matrix_scenario": line}), flush=True)
 
 

@@ -440,6 +440,28 @@ def estimate_param_count(
     return int(n_layers * per_layer + embed + head)
 
 
+def config_loss_impl(cfg) -> tuple[str, int]:
+    """``(loss_impl, ce_chunk)`` the planner should assume for ``cfg`` —
+    resolved by the SAME selection authority the GPT adapter family runs
+    at build time (ops/fused_ce.py:resolve_loss_impl), so an `llmtrain
+    plan` verdict charges the logits buffer the run will actually pay.
+    An invalid explicit value resolves to "dense" here: config validation
+    owns that error, and a feasibility estimate must not mask it."""
+    extra = dict(getattr(cfg.model, "extra", {}) or {})
+    from ..ops.fused_ce import resolve_loss_impl
+
+    try:
+        impl = resolve_loss_impl(
+            extra.get("loss_impl"),
+            vocab_size=int(cfg.model.vocab_size or 50257),
+            ce_auto_vocab=int(extra.get("ce_auto_vocab", 32768) or 32768),
+            interpret=bool(extra.get("pallas_interpret", False)),
+        )
+    except ValueError:
+        impl = "dense"
+    return impl, int(extra.get("ce_chunk", 8192) or 8192)
+
+
 def predict_hbm_bytes(
     plan: MeshPlan,
     *,
@@ -450,6 +472,8 @@ def predict_hbm_bytes(
     block_size: int,
     dtype_bytes: int = 4,
     param_dtype_bytes: int = 4,
+    loss_impl: str = "dense",
+    ce_chunk: int = 8192,
 ) -> dict[str, float]:
     """Predicted per-device HBM footprint of a training step under this
     plan — the feasibility half of the analytical pruning pass.
@@ -461,7 +485,11 @@ def predict_hbm_bytes(
     (batch / dp, context / sequence) and drop to the sqrt-ish remat
     checkpoint footprint with ``remat``; the logits buffer
     ``mb x T x V`` is counted separately because it dominates small
-    models and is what chunked-CE / larger vocab shards eliminate.
+    models and is what the streamed/fused CE paths shrink: ``loss_impl``
+    (resolve via :func:`config_loss_impl`) charges the full buffer under
+    "dense", a ``tokens x min(ce_chunk, V)`` block under "chunked_ce",
+    and nothing under "fused_ce" — the Pallas kernel keeps every logits
+    tile in VMEM (ops/fused_ce.py).
     """
     model_shard = plan.axes["tensor"] * plan.axes["pipeline"] * plan.axes["fsdp"]
     if plan.axes["expert"] > 1:
@@ -494,9 +522,15 @@ def predict_hbm_bytes(
         if tier == "offload":
             host_b += per_copy * OFFLOAD_HOST_COPIES
     acts_b = sum(by_tier.values())
-    logits_b = tokens * vocab_size * 4.0  # CE runs f32
+    if loss_impl == "fused_ce":
+        logits_b = 0.0
+    elif loss_impl == "chunked_ce":
+        logits_b = tokens * min(ce_chunk, vocab_size) * 4.0  # CE runs f32
+    else:
+        logits_b = tokens * vocab_size * 4.0  # CE runs f32
     total = params_b + grads_b + opt_b + acts_b + logits_b
     return {
+        "loss_impl": loss_impl,
         "params_bytes": round(params_b),
         "grads_bytes": round(grads_b),
         "opt_state_bytes": round(opt_b),
@@ -516,6 +550,7 @@ __all__ = [
     "OFFLOAD_HOST_COPIES",
     "TIER_ACT_COPIES",
     "caps_from_config",
+    "config_loss_impl",
     "estimate_param_count",
     "plan_from_config",
     "plan_layer_tiers",

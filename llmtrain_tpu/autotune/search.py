@@ -408,6 +408,12 @@ def prune_candidates(
     )
     dtype_b = 2 if m.dtype == "bfloat16" else 4
     pdtype_b = 2 if m.param_dtype == "bfloat16" else 4
+    # The HBM feasibility check must charge the logits buffer the run
+    # will actually pay (dense vs chunked vs fused CE) — same resolution
+    # the adapter performs at build time.
+    from .plan import config_loss_impl
+
+    loss_impl, ce_chunk = config_loss_impl(cfg)
 
     pruned: list[dict[str, str]] = []
     scored: list[Candidate] = []
@@ -466,6 +472,8 @@ def prune_candidates(
             block_size=m.block_size,
             dtype_bytes=dtype_b,
             param_dtype_bytes=pdtype_b,
+            loss_impl=loss_impl,
+            ce_chunk=ce_chunk,
         )
         # Rank on time PER TOKEN, not raw step time: candidates differ in
         # global batch, and a half-size microbatch "wins" raw step time

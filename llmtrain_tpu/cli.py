@@ -942,8 +942,12 @@ def _handle_plan(args: argparse.Namespace) -> int:
         collective_bytes=cost["collective_bytes"],
         peaks=peaks,
     )
-    from .autotune.plan import predict_hbm_bytes
+    from .autotune.plan import config_loss_impl, predict_hbm_bytes
 
+    # Resolve the loss implementation the run would build (dense /
+    # chunked_ce / fused_ce) so the verdict charges the right logits
+    # buffer — and say which one it assumed.
+    loss_impl, ce_chunk = config_loss_impl(cfg)
     hbm = predict_hbm_bytes(
         mesh_plan,
         n_params=int(cost["n_params"]),
@@ -953,6 +957,8 @@ def _handle_plan(args: argparse.Namespace) -> int:
         block_size=cfg.model.block_size,
         dtype_bytes=2 if cfg.model.dtype == "bfloat16" else 4,
         param_dtype_bytes=2 if cfg.model.param_dtype == "bfloat16" else 4,
+        loss_impl=loss_impl,
+        ce_chunk=ce_chunk,
     )
     hbm_limit = resolve_hbm_limit(
         str(peaks.get("device_kind", "cpu")), cfg.tune.hbm_limit_bytes
@@ -970,6 +976,7 @@ def _handle_plan(args: argparse.Namespace) -> int:
             "remat": mesh_plan.remat,
             "zero_stage": mesh_plan.zero_stage,
             "activation_tiers": mesh_plan.activation_tiers,
+            "loss_impl": loss_impl,
             "topology": mesh_plan.describe_topology(),
         },
         "roofline": roofline,
@@ -996,6 +1003,10 @@ def _handle_plan(args: argparse.Namespace) -> int:
             f"hbm       {hbm['total_bytes'] / 2**30:.3f} GiB predicted vs "
             f"{hbm_limit / 2**30:.1f} GiB limit "
             f"[{payload['device_kind']}]"
+        )
+        print(
+            f"loss      {loss_impl} "
+            f"(logits buffer {hbm['logits_bytes'] / 2**20:.1f} MiB)"
         )
         by_tier = hbm.get("activation_bytes_by_tier", {})
         if by_tier:

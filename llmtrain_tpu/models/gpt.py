@@ -67,7 +67,6 @@ def _scaled_init(n_layers: int) -> nn.initializers.Initializer:
 
 logger = logging.getLogger(__name__)
 
-_CE_AUTO_LOGGED = False
 _TIER_MIGRATION_LOGGED = False
 
 
@@ -119,17 +118,49 @@ def resolve_config_activation_tiers(cfg: RunConfig) -> tuple[str, ...] | None:
     return None
 
 
-def _log_ce_auto_select(vocab_size: int, ce_auto_vocab: int) -> None:
-    """One-time (per process) log naming the chunked_ce auto-selection."""
-    global _CE_AUTO_LOGGED
-    if not _CE_AUTO_LOGGED:
-        _CE_AUTO_LOGGED = True
-        logger.info(
-            "loss_impl auto-selected: chunked_ce (vocab_size %d >= "
-            "model.extra.ce_auto_vocab %d and loss_impl unset; pass "
-            "loss_impl: dense to override)",
-            vocab_size,
-            ce_auto_vocab,
+class FusedLayerNorm(nn.Module):
+    """nn.LayerNorm twin backed by the Pallas fused kernel
+    (ops/fused_norm.py). Same parameter names (``scale``/``bias``),
+    shapes, and logical partitioning — checkpoints are interchangeable
+    with the unfused path. The optional ``residual`` argument fuses the
+    preceding residual add into the same VMEM pass and returns
+    ``(normed, summed)``."""
+
+    dtype: Any
+    param_dtype: Any
+    epsilon: float = 1e-6
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, residual: jax.Array | None = None):
+        from ..ops.fused_norm import fused_add_layer_norm, fused_layer_norm
+
+        d = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (d,),
+            self.param_dtype,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+            (d,),
+            self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        if residual is None:
+            return fused_layer_norm(
+                x, scale, bias, self.epsilon, 256, self.interpret
+            )
+        return fused_add_layer_norm(
+            x,
+            residual.astype(self.dtype),
+            scale,
+            bias,
+            self.epsilon,
+            256,
+            self.interpret,
         )
 
 
@@ -751,6 +782,11 @@ class TransformerBlock(nn.Module):
     router_top_k: int = 1
     # Quantized training matmuls (ops/quant.py): see CausalSelfAttention.
     matmul_precision: str = "f32"
+    # Pallas fused residual-add + LayerNorm (ops/fused_norm.py): ln_1/ln_2
+    # run in one VMEM pass each, ln_2 absorbing the attention residual
+    # add. Param tree identical to the unfused path (FusedLayerNorm).
+    fused_norm: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(
@@ -770,8 +806,16 @@ class TransformerBlock(nn.Module):
             scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
         )
-        h = nn.LayerNorm(name="ln_1", **ln_kw)(x)
-        x = x + CausalSelfAttention(
+        if self.fused_norm:
+            h = FusedLayerNorm(
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                interpret=self.pallas_interpret,
+                name="ln_1",
+            )(x)
+        else:
+            h = nn.LayerNorm(name="ln_1", **ln_kw)(x)
+        attn_out = CausalSelfAttention(
             d_model=self.d_model,
             n_heads=self.n_heads,
             n_layers=self.n_layers,
@@ -799,7 +843,18 @@ class TransformerBlock(nn.Module):
             block_tables=block_tables,
         )
 
-        h = nn.LayerNorm(name="ln_2", **ln_kw)(x)
+        if self.fused_norm:
+            # One kernel: x = x + attn_out; h = LN(x). The sum is both the
+            # residual stream and the norm input, so it is read/written once.
+            h, x = FusedLayerNorm(
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                interpret=self.pallas_interpret,
+                name="ln_2",
+            )(attn_out, residual=x)
+        else:
+            x = x + attn_out
+            h = nn.LayerNorm(name="ln_2", **ln_kw)(x)
         if self.n_experts > 0:
             from .moe import MoEMLP
 
@@ -883,9 +938,22 @@ class GPT(nn.Module):
     # Loss implementation hint consumed by GPTAdapter.compute_loss_components:
     # "dense" materializes logits; "chunked_ce" streams the CE over vocab
     # chunks of ce_chunk (ops/chunked_ce.py) — the forward then returns
-    # hidden states via return_hidden and never builds [B,T,V].
+    # hidden states via return_hidden and never builds [B,T,V];
+    # "fused_ce" computes the loss in a Pallas kernel (ops/fused_ce.py)
+    # tiled (fused_ce_block_t x fused_ce_block_v) so no logits tile ever
+    # reaches HBM.
     loss_impl: str = "dense"
     ce_chunk: int = 8192
+    fused_ce_block_t: int = 256
+    fused_ce_block_v: int = 512
+    # Pallas fused residual-add + LayerNorm in every block
+    # (ops/fused_norm.py); cleared on decode clones — the kernels are
+    # trained-shape tuned and decode runs T=1 slices.
+    fused_norm: bool = False
+    # Force interpret-mode Pallas kernels (fused_ce / fused_norm) on any
+    # backend — CPU parity tests and the bench matrix run the real kernel
+    # logic under emulation (model.extra.pallas_interpret).
+    pallas_interpret: bool = False
     # PaLM z-loss coefficient: adds z_loss * log(Z)^2 per token to the LM
     # objective (both loss paths). 0 = off (reference behavior).
     z_loss: float = 0.0
@@ -956,6 +1024,7 @@ class GPT(nn.Module):
             paged=True,
             remat=False,
             activation_tiers=None,
+            fused_norm=False,
             paged_num_blocks=num_blocks,
             paged_block_tokens=block_tokens,
         )
@@ -979,6 +1048,7 @@ class GPT(nn.Module):
             decode=True,
             remat=False,
             activation_tiers=None,
+            fused_norm=False,
             decode_cache_len=min(cache_len, self.block_size),
             ring_slack=ring_slack,
         )
@@ -1094,6 +1164,8 @@ class GPT(nn.Module):
                 moe_aux_weight=self.moe_aux_weight,
                 router_top_k=self.router_top_k,
                 matmul_precision=self.matmul_precision,
+                fused_norm=self.fused_norm,
+                pallas_interpret=self.pallas_interpret,
                 name=f"block_{layer}",
             )
             if paged:
@@ -1147,7 +1219,8 @@ class GPTAdapter(ModelAdapter):
         {"tokenizer", "loss_impl", "ce_chunk", "z_loss", "n_kv_heads",
          "assume_packed", "remat_policy", "sliding_window",
          "kv_cache_dtype", "matmul_precision", "ce_auto_vocab",
-         "activation_tiers"}
+         "activation_tiers", "fused_ce_block_t", "fused_ce_block_v",
+         "fused_norm", "pallas_interpret"}
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
@@ -1159,23 +1232,29 @@ class GPTAdapter(ModelAdapter):
                 raise ValueError("GPT tokenizer must expose a positive integer n_vocab.")
             vocab_size = tokenizer_vocab_size
         ce_auto_vocab = self._positive_extra(cfg, "ce_auto_vocab", 32768)
-        if "loss_impl" in cfg.model.extra:
-            loss_impl = cfg.model.extra["loss_impl"]
-            if loss_impl not in ("dense", "chunked_ce"):
-                raise ValueError(
-                    f"model.extra.loss_impl {loss_impl!r} unknown; "
-                    "expected 'dense' or 'chunked_ce'"
-                )
-        elif vocab_size >= ce_auto_vocab:
-            # Auto-select the streamed CE at large vocab: the [B,T,V]
-            # logits tensor is the top memory-bound op in the 50k-vocab
-            # roofline table (docs/perf.md), and chunked_ce never builds
-            # it. Explicit `loss_impl: dense` always wins above.
-            loss_impl = "chunked_ce"
-            _log_ce_auto_select(vocab_size, ce_auto_vocab)
-        else:
-            loss_impl = "dense"
+        # Selection authority lives in ops/fused_ce.py (shared with the
+        # autotune planner): explicit knob wins (unknown raises, fused_ce
+        # without Pallas degrades to chunked_ce with a one-time warning);
+        # unset auto-selects a streamed CE at vocab >= ce_auto_vocab —
+        # the [B,T,V] logits tensor is the top memory-bound op in the
+        # 50k-vocab roofline table (docs/perf.md).
+        from ..ops.fused_ce import resolve_loss_impl
+        from ..ops.fused_norm import resolve_fused_norm
+
+        pallas_interpret = bool(cfg.model.extra.get("pallas_interpret", False))
+        loss_impl = resolve_loss_impl(
+            cfg.model.extra.get("loss_impl"),
+            vocab_size=vocab_size,
+            ce_auto_vocab=ce_auto_vocab,
+            interpret=pallas_interpret,
+        )
+        fused_norm = resolve_fused_norm(
+            bool(cfg.model.extra.get("fused_norm", False)),
+            interpret=pallas_interpret,
+        )
         ce_chunk = self._positive_extra(cfg, "ce_chunk", 8192)
+        fused_ce_block_t = self._positive_extra(cfg, "fused_ce_block_t", 256)
+        fused_ce_block_v = self._positive_extra(cfg, "fused_ce_block_v", 512)
         z_loss = float(cfg.model.extra.get("z_loss", 0.0))
         if z_loss < 0.0:
             raise ValueError(f"model.extra.z_loss must be >= 0, got {z_loss}")
@@ -1241,6 +1320,10 @@ class GPTAdapter(ModelAdapter):
             attention=cfg.model.attention,
             loss_impl=loss_impl,
             ce_chunk=ce_chunk,
+            fused_ce_block_t=fused_ce_block_t,
+            fused_ce_block_v=fused_ce_block_v,
+            fused_norm=fused_norm,
+            pallas_interpret=pallas_interpret,
             z_loss=z_loss,
             n_kv_heads=n_kv_heads,
             assume_packed=bool(cfg.model.extra.get("assume_packed", False)),
@@ -1284,7 +1367,7 @@ class GPTAdapter(ModelAdapter):
         rngs: dict[str, jax.Array] | None = None,
         deterministic: bool = True,
     ) -> tuple[jax.Array, jax.Array]:
-        if getattr(model, "loss_impl", "dense") == "chunked_ce":
+        if getattr(model, "loss_impl", "dense") in ("chunked_ce", "fused_ce"):
             return self._chunked_loss_components(
                 model, params, batch, rngs=rngs, deterministic=deterministic
             )
@@ -1317,9 +1400,25 @@ class GPTAdapter(ModelAdapter):
         labels: jax.Array,
         attention_mask: jax.Array | None,
     ) -> tuple[jax.Array, jax.Array]:
-        """Streamed-CE components from already-computed hidden states —
-        the single wiring point for every adapter's chunked path (gpt_moe
-        reuses it after its mutable-collection apply)."""
+        """Streamed/fused-CE components from already-computed hidden
+        states — the single wiring point for every adapter's
+        hidden-contraction loss path (gpt_moe reuses it after its
+        mutable-collection apply). Dispatches on ``model.loss_impl``:
+        fused_ce runs the Pallas kernel (ops/fused_ce.py), everything
+        else the lax.scan streamer (ops/chunked_ce.py)."""
+        if getattr(model, "loss_impl", "dense") == "fused_ce":
+            from ..ops.fused_ce import fused_ce_components
+
+            return fused_ce_components(
+                hidden,
+                cls.vocab_matrix(model, params),
+                labels,
+                attention_mask,
+                block_t=getattr(model, "fused_ce_block_t", 256),
+                block_v=getattr(model, "fused_ce_block_v", 512),
+                z_loss=getattr(model, "z_loss", 0.0),
+                interpret=bool(getattr(model, "pallas_interpret", False)),
+            )
         from ..ops.chunked_ce import chunked_ce_components
 
         return chunked_ce_components(

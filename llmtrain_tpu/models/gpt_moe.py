@@ -71,7 +71,9 @@ class GPTMoEAdapter(GPTAdapter):
         deterministic: bool = True,
     ) -> tuple[jax.Array, jax.Array]:
         input_ids, labels, attention_mask = validate_lm_batch(batch)
-        chunked = getattr(model, "loss_impl", "dense") == "chunked_ce"
+        # chunked_ce and fused_ce both contract hidden states against the
+        # vocab matrix outside the forward (return_hidden path).
+        chunked = getattr(model, "loss_impl", "dense") in ("chunked_ce", "fused_ce")
         out, mutated = model.apply(
             {"params": params},
             input_ids,

@@ -499,6 +499,15 @@ class PipelineGPTAdapter(ModelAdapter):
                 f"got {cfg.model.attention!r}"
             )
         loss_impl = cfg.model.extra.get("loss_impl", "dense")
+        if loss_impl == "fused_ce":
+            # The Pallas kernel contracts hidden states held on the last
+            # stage only; the pipeline loss runs inside the per-microbatch
+            # scan where the kernel's custom_vjp is not wired. Fail loudly
+            # rather than silently training something else.
+            raise ValueError(
+                "model.extra.loss_impl 'fused_ce' is not supported with "
+                "pipeline parallelism; use 'chunked_ce'"
+            )
         if loss_impl not in ("dense", "chunked_ce"):
             raise ValueError(
                 f"model.extra.loss_impl {loss_impl!r} unknown; "

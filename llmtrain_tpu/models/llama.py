@@ -260,6 +260,11 @@ class Llama(nn.Module):
     decode_cache_len: int = 0
     loss_impl: str = "dense"
     ce_chunk: int = 8192
+    # Fused lm-head + CE Pallas kernel knobs (models/gpt.py GPT fields;
+    # the loss machinery is shared via GPTAdapter).
+    fused_ce_block_t: int = 256
+    fused_ce_block_v: int = 512
+    pallas_interpret: bool = False
     z_loss: float = 0.0
     n_kv_heads: int = 0
     assume_packed: bool = False
@@ -491,6 +496,13 @@ class LlamaAdapter(GPTAdapter):
     )
 
     def build_model(self, cfg: RunConfig) -> nn.Module:
+        if cfg.model.extra.get("fused_norm"):
+            # The fused Pallas add+norm kernel is LayerNorm-shaped; the
+            # llama family norms are RMSNorm and are not wired to it.
+            raise ValueError(
+                "model.extra.fused_norm is not supported by the llama "
+                "family (RMSNorm blocks); it is a gpt-family knob"
+            )
         base = super().build_model(cfg)  # runs all shared validation
         rope_theta = float(cfg.model.extra.get("rope_theta", 10000.0))
         if rope_theta <= 0:
@@ -531,6 +543,9 @@ class LlamaAdapter(GPTAdapter):
             attention=base.attention,
             loss_impl=base.loss_impl,
             ce_chunk=base.ce_chunk,
+            fused_ce_block_t=base.fused_ce_block_t,
+            fused_ce_block_v=base.fused_ce_block_v,
+            pallas_interpret=base.pallas_interpret,
             z_loss=base.z_loss,
             n_kv_heads=base.n_kv_heads,
             assume_packed=base.assume_packed,

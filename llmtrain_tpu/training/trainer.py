@@ -2137,13 +2137,18 @@ class Trainer:
         same model `llmtrain plan` feasibility-checks): device-resident
         bytes plus the host-RAM bytes the offload tier stages. None when
         the plan cannot be resolved (never kills the fit it measures)."""
-        from ..autotune.plan import plan_from_config, predict_hbm_bytes
+        from ..autotune.plan import (
+            config_loss_impl,
+            plan_from_config,
+            predict_hbm_bytes,
+        )
 
         cfg = self._cfg
         try:
             plan = plan_from_config(
                 cfg, self._mesh.devices.size, adapter=self._adapter
             )
+            loss_impl, ce_chunk = config_loss_impl(cfg)
             hbm = predict_hbm_bytes(
                 plan,
                 n_params=int(self._param_count),
@@ -2153,6 +2158,8 @@ class Trainer:
                 block_size=cfg.model.block_size,
                 dtype_bytes=2 if cfg.model.dtype == "bfloat16" else 4,
                 param_dtype_bytes=2 if cfg.model.param_dtype == "bfloat16" else 4,
+                loss_impl=loss_impl,
+                ce_chunk=ce_chunk,
             )
         except Exception as exc:  # noqa: BLE001 — accounting must not kill runs
             logger.debug("activation memory accounting skipped: %s", exc)

@@ -19,6 +19,7 @@ from llmtrain_tpu.autotune.plan import (
     MeshPlanError,
     ModelCaps,
     caps_from_config,
+    config_loss_impl,
     plan_from_config,
     predict_hbm_bytes,
     resolve_axis_sizes,
@@ -237,6 +238,49 @@ class TestMeshPlanObject:
             < predict_hbm_bytes(dense, **kw)["total_bytes"]
         )
 
+    def test_predict_hbm_logits_term_per_loss_impl(self):
+        """The logits-buffer table (docs/perf.md "Fused lm-head + CE"):
+        dense charges tokens x V, chunked a tokens x min(ce_chunk, V)
+        block, fused_ce nothing — the planner's verdict must track what
+        the adapter's loss path actually allocates."""
+        plan = resolve_plan(
+            mesh_sizes={"data": 1}, device_count=1, caps=CAPS, micro_batch_size=4
+        )
+        kw = dict(n_params=1_000_000, d_model=64, n_layers=2, vocab_size=50_000,
+                  block_size=16)
+        tokens = 4 * 16
+        table = {
+            "dense": tokens * 50_000 * 4.0,
+            "chunked_ce": tokens * 8192 * 4.0,  # default ce_chunk
+            "fused_ce": 0.0,
+        }
+        for impl, want in table.items():
+            hbm = predict_hbm_bytes(plan, loss_impl=impl, **kw)
+            assert hbm["loss_impl"] == impl
+            assert hbm["logits_bytes"] == want, impl
+        # an oversized chunk clamps at the vocab — never charges more
+        # than the dense buffer
+        clamped = predict_hbm_bytes(
+            plan, loss_impl="chunked_ce", ce_chunk=1 << 20, **kw
+        )
+        assert clamped["logits_bytes"] == table["dense"]
+
+    def test_config_loss_impl_matches_adapter_resolution(self):
+        # small vocab, nothing requested -> dense
+        assert config_loss_impl(_cfg()) == ("dense", 8192)
+        # explicit fused without Pallas degrades exactly like the adapter
+        cfg = _cfg(model={"extra": {"loss_impl": "fused_ce"}})
+        assert config_loss_impl(cfg)[0] == "chunked_ce"
+        # ...and holds with the interpret escape hatch
+        cfg = _cfg(
+            model={"extra": {"loss_impl": "fused_ce", "pallas_interpret": True}}
+        )
+        assert config_loss_impl(cfg) == ("fused_ce", 8192)
+        # invalid explicit value is config validation's error to raise,
+        # not the planner's: estimate conservatively as dense
+        cfg = _cfg(model={"extra": {"loss_impl": "typo", "ce_chunk": 64}})
+        assert config_loss_impl(cfg) == ("dense", 64)
+
 
 class TestSearch:
     def test_deterministic_seeded_order(self):
@@ -418,6 +462,24 @@ class TestPlanCLI:
         assert payload["roofline"]["class"] in {"compute", "memory", "comms"}
         assert payload["predicted_hbm"]["total_bytes"] > 0
         assert payload["predicted_hbm"]["total_bytes"] <= payload["hbm_limit_bytes"]
+
+    def test_plan_prints_assumed_loss_impl(self, tmp_path, capsys):
+        from llmtrain_tpu.cli import main
+
+        cfg_path = self._write(
+            tmp_path,
+            model={"extra": {"loss_impl": "fused_ce", "pallas_interpret": True}},
+        )
+        rc = main(["plan", "--config", cfg_path, "--devices", "8", "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["plan"]["loss_impl"] == "fused_ce"
+        assert payload["predicted_hbm"]["loss_impl"] == "fused_ce"
+        assert payload["predicted_hbm"]["logits_bytes"] == 0.0
+        rc = main(["plan", "--config", cfg_path, "--devices", "8"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "loss      fused_ce (logits buffer 0.0 MiB)" in out
 
     def test_plan_infeasible_mesh_exit_two(self, tmp_path, capsys):
         from llmtrain_tpu.cli import main

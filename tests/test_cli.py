@@ -371,6 +371,7 @@ class TestGenerate:
         for proc in (cast, kept):
             assert len(json.loads(proc.stdout)["completion_ids"]) == 3
 
+    @pytest.mark.slow  # budget: tier-1 siblings test_generate_greedy_is_deterministic + test_speculative greedy exactness
     def test_speculative_generate_matches_plain_greedy(self, workdir):
         """--draft-config/--draft-from: greedy speculative output through
         the CLI is bit-identical to the plain greedy path."""

@@ -223,6 +223,20 @@ class TestUnknownExtraWarnings:
         cfg = self._cfg(model={"loss_impl": "chunked_ce", "ce_chunk": 64, "z_loss": 0.1})
         assert unknown_extra_keys(cfg) == {}
 
+    def test_fused_kernel_knobs_are_known_extra_keys(self):
+        from llmtrain_tpu.config.extras import unknown_extra_keys
+
+        cfg = self._cfg(
+            model={
+                "loss_impl": "fused_ce",
+                "fused_ce_block_t": 256,
+                "fused_ce_block_v": 512,
+                "fused_norm": True,
+                "pallas_interpret": True,
+            }
+        )
+        assert unknown_extra_keys(cfg) == {}
+
     def test_validate_cli_warns_but_exits_zero(self, tmp_path):
         import subprocess
         import sys

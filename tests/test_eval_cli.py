@@ -108,6 +108,7 @@ class TestEvalCLI:
         payload = json.loads(ev.stdout)
         assert abs(payload["metrics"]["val/loss"] - trained_val) < 1e-6
 
+    @pytest.mark.slow  # budget: tier-1 siblings test_quant TestTrainerEvalQuantized + test_cli test_generate_quantized_int8
     def test_eval_quantized_close_to_full(self, tmp_path):
         """--quantize int8 reports the serving-path quality: close to the
         full-precision loss, but not the identical number (the weights

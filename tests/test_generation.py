@@ -276,6 +276,7 @@ class TestTextHelpers:
 class TestGenerateNewModelFamilies:
     """generate() works for every registered LM family, not just gpt."""
 
+    @pytest.mark.slow  # budget: tier-1 siblings test_moe_gpt_cached_matches_windowed + test_pipeline forward parity
     def test_pipeline_gpt_windowed_path(self):
         from llmtrain_tpu.models.gpt_pipeline import PipelineGPT
 
@@ -449,6 +450,7 @@ class TestPromptsFileCLI:
         assert proc.returncode == 1
         assert "cannot read --prompts-file" in proc.stderr
 
+    @pytest.mark.slow  # budget: tier-1 sibling test_mixed_length_prompts_keep_order covers the prompts-file contract
     def test_single_line_file_still_emits_results_array(self, tmp_path):
         import json as _json
 

@@ -300,6 +300,7 @@ class TestPipelineGPT:
             out = jax.jit(lambda p, t: model.apply({"params": p}, t))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow  # budget: tier-1 sibling test_pp_tp_compose_matches_sequential; GQA compose rides test-all
     def test_gqa_pp_tp_compose_matches_sequential(self):
         """GQA under pipeline x tensor: the split q/kv sharding specs
         shard K/V heads over the tensor axis; forward equals sequential

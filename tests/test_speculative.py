@@ -78,6 +78,7 @@ class TestGreedyExactness:
                                    gamma=gamma)
         assert got.tolist() == want.tolist()
 
+    @pytest.mark.slow  # budget: tier-1 siblings test_self_draft/test_weak_draft_matches_plain + gamma_invariance
     def test_single_token_prompt(self):
         """tp == 1 skips prefill (the cursor invariant's edge case)."""
         m, p = _gpt(seed=2)

@@ -526,6 +526,48 @@ def _self_test() -> int:
     )
     assert not verdict["regressions"] and verdict["skipped"], "degraded parity line must skip"
 
+    # --- fused-CE matrix key (PR 18: key-gated from its second round) --
+    fused_key = "dense|50k|fused_ce|f32"
+    fused_old = round_({fused_key: mline(800.0)})
+    # First appearance never gates, however bad its number looks.
+    verdict = compare_matrix(
+        old_round,
+        round_(
+            {
+                "dense|short|dense_ce|f32": mline(1000.0),
+                fused_key: mline(1.0),
+            }
+        ),
+    )
+    assert not verdict["regressions"], "first fused line must never gate"
+    assert any(
+        fused_key in n and "new scenario" in n for n in verdict["notes"]
+    ), "first fused line must note"
+    # From its second round on, a collapse gates like any other key.
+    verdict = compare_matrix(fused_old, round_({fused_key: mline(300.0)}))
+    assert verdict["regressions"], "fused key collapse must gate"
+    # A wobble inside the noise bound passes but is compared.
+    verdict = compare_matrix(fused_old, round_({fused_key: mline(700.0)}))
+    assert not verdict["regressions"], "fused key wobble must pass"
+    assert any(
+        fused_key in c["scenario"] for c in verdict["compared"]
+    ), "fused key wobble must be compared"
+    # A fused line that failed the dense-CE parity gate is skipped.
+    verdict = compare_matrix(
+        fused_old,
+        round_(
+            {
+                fused_key: mline(
+                    790.0,
+                    degraded=True,
+                    fallback="loss parity vs dense CE failed: max rel diff 0.01 > rtol 0.0005",
+                    parity={"rtol": 5e-4, "max_rel_diff": 0.01, "ok": False},
+                )
+            }
+        ),
+    )
+    assert not verdict["regressions"] and verdict["skipped"], "degraded fused line must skip"
+
     # --- offload scenario gate (detail.offload) -----------------------
     def with_offload(
         result: dict[str, Any], tps: float, *, bitwise: bool = True, fits: bool = True
