@@ -1,4 +1,4 @@
-.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-overload verify-zero verify-fleet verify-profile verify-quant verify-fusedce verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
+.PHONY: test test-all lint verify-resilience verify-watchdog verify-prefetch verify-telemetry verify-elastic verify-serving verify-router verify-promote verify-overload verify-trace verify-zero verify-fleet verify-profile verify-quant verify-fusedce verify-goodput verify-tune verify-offload train-smoke train-multiproc bench \
 	chip-evidence mlflow \
 	k8s-cluster k8s-cluster-delete k8s-build k8s-train k8s-serve k8s-fleet k8s-logs k8s-clean \
 	k8s-full k8s-e2e
@@ -177,6 +177,16 @@ verify-promote:
 # that plain `make test` skips.
 verify-overload:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q
+
+# Distributed-tracing drill (docs/observability.md "Distributed request
+# tracing"): traceparent round-trips, tail-sampling decisions, tracer
+# flush, collector tree assembly — plus the @pytest.mark.slow 2-replica
+# HTTP fleet drill (one forced failover; the merged trace must
+# reconstruct the router→replica span tree via the propagated
+# traceparent, the critical path must tile the end-to-end latency, and
+# /metrics must carry exemplar trace ids) that plain `make test` skips.
+verify-trace:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py tests/test_trace_e2e.py -q
 
 # Static gate (reference: pre-commit ruff+mypy, .pre-commit-config.yaml:1-24).
 # Runs ruff+mypy when installed; otherwise the stdlib fallback checker.

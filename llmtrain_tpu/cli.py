@@ -283,6 +283,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="host[:port] DNS-resolved into one HTTP backend per A "
         "record (k8s headless Service discovery; implies --router)",
     )
+    serve.add_argument(
+        "--trace-dir",
+        default=None,
+        help="write per-process timeline.jsonl files (with tail-sampled "
+        "request traces) plus a metrics.prom textfile snapshot under "
+        "this dir, for `llmtrain trace` to merge; with --router each "
+        "in-process replica gets its own subdir",
+    )
 
     promote = sub.add_parser(
         "promote",
@@ -778,6 +786,49 @@ def build_parser() -> argparse.ArgumentParser:
     )
     goodput.add_argument(
         "--json", action="store_true", help="emit the ledger as JSON"
+    )
+
+    trace = sub.add_parser(
+        "trace",
+        help="merge per-process fleet timelines and reassemble cross-"
+        "process request traces (telemetry/trace_collect.py, docs/"
+        "observability.md 'Distributed request tracing')",
+    )
+    trace.add_argument(
+        "action",
+        choices=("slowest", "show", "summary", "merge"),
+        help="slowest: top-k traces by end-to-end latency; show: span "
+        "tree + critical-path breakdown of one trace; summary: per-span-"
+        "kind p50/p95/p99; merge: one Perfetto trace (track group per "
+        "process, flow arrows across the router→replica hop)",
+    )
+    trace.add_argument(
+        "trace_id",
+        nargs="?",
+        default=None,
+        help="trace id (or unique prefix) for 'show' — from `trace "
+        "slowest`, a response payload, or a /metrics exemplar",
+    )
+    trace.add_argument(
+        "--run-dir",
+        action="append",
+        required=True,
+        dest="run_dirs",
+        help="directory (scanned recursively for *timeline*.jsonl) or a "
+        "single timeline file; repeatable — pass every fleet process's "
+        "dir to stitch the cross-process tree together",
+    )
+    trace.add_argument(
+        "--k", type=int, default=10, help="how many traces 'slowest' lists"
+    )
+    trace.add_argument(
+        "--out",
+        default=None,
+        help="output path for 'merge' (default: merged_trace.json under "
+        "the first --run-dir; open it in ui.perfetto.dev)",
+    )
+    trace.add_argument(
+        "--json", action="store_true", help="emit machine-readable JSON"
     )
 
     plan = sub.add_parser(
@@ -1690,6 +1741,8 @@ def _build_serving_backend(
     params,
     logger,
     registry=None,
+    trace_dir=None,
+    name=None,
 ):
     """Continuous-batching scheduler + metrics registry for serve/serve-bench.
 
@@ -1698,6 +1751,10 @@ def _build_serving_backend(
     required); otherwise ``serving.policy`` from the config. Raises
     ``ValueError`` with the actionable message on a bad combination —
     callers map it to EXIT_CONFIG_ERROR.
+
+    ``trace_dir`` (``serve --trace-dir`` / serve-bench's out dir) makes
+    the timeline file-backed at ``{trace_dir}/{name}/timeline.jsonl`` so
+    ``llmtrain trace`` can merge this process into the fleet-wide view.
     """
     from .serving import ContinuousBatchingScheduler, PagedDecodeEngine
     from .telemetry.registry import MetricsRegistry
@@ -1707,12 +1764,17 @@ def _build_serving_backend(
     if registry is None:
         registry = MetricsRegistry(None)
     # Serving timeline: request-id-tagged queue-wait/prefill/decode spans
-    # (scheduler.py). Memory-only here; serve-bench exports the Perfetto
-    # trace next to its report.
+    # (scheduler.py). Memory-only here unless --trace-dir asks for JSONL;
+    # serve-bench exports the Perfetto trace next to its report.
     timeline = None
     if cfg.telemetry.enabled and cfg.telemetry.timeline:
+        tl_path = (
+            Path(trace_dir) / (name or "serve") / "timeline.jsonl"
+            if trace_dir is not None
+            else None
+        )
         timeline = EventTimeline(
-            None,
+            tl_path,
             max_events=cfg.telemetry.max_events,
             xprof_annotations=cfg.telemetry.xprof_annotations,
         )
@@ -1820,7 +1882,30 @@ def _build_serving_backend(
         scheduler = ContinuousBatchingScheduler(
             engine, registry=registry, timeline=timeline, overload=overload
         )
+    _configure_request_tracer(cfg, scheduler, timeline)
     return scheduler, registry
+
+
+def _configure_request_tracer(cfg, backend, timeline) -> None:
+    """Replace a scheduler/router's auto-created request tracer with one
+    built from ``telemetry.tracing`` (tail-sampling knobs), or strip it
+    when tracing is disabled — the backends default to a tracer whenever
+    they have a timeline, so the config gate must be applied here."""
+    tcfg = cfg.telemetry.tracing
+    if timeline is None or not tcfg.enabled:
+        backend.tracer = None
+        return
+    from .telemetry.tracing import TailSampler, Tracer
+
+    backend.tracer = Tracer(
+        timeline,
+        sampler=TailSampler(
+            slow_frac=tcfg.slow_keep_frac,
+            reservoir=tcfg.reservoir,
+            warmup=tcfg.warmup_keep,
+        ),
+        max_spans=tcfg.max_spans_per_trace,
+    )
 
 
 def _build_router_backend(
@@ -1829,6 +1914,7 @@ def _build_router_backend(
     model,
     params,
     logger,
+    trace_dir=None,
 ):
     """Replica-router tier for ``serve --router`` / ``serve-bench --router``.
 
@@ -1878,10 +1964,31 @@ def _build_router_backend(
             # predicted wait) reach the fleet /metrics scrape; counters
             # sum across replicas, gauges are last-writer-wins.
             sched, _ = _build_serving_backend(
-                cfg, args, model, params, logger, registry=registry
+                cfg,
+                args,
+                model,
+                params,
+                logger,
+                registry=registry,
+                trace_dir=trace_dir,
+                name=f"replica{i}",
             )
             sched.start()
             replicas.append(InProcessReplica(sched, f"replica{i}"))
+    # The router gets its own timeline so its placement/failover/hop
+    # spans land in a separate JSONL track (`{trace_dir}/router/`) that
+    # `llmtrain trace` stitches to the replica tracks via traceparent.
+    router_timeline = None
+    if cfg.telemetry.enabled and cfg.telemetry.timeline:
+        from .telemetry.timeline import EventTimeline
+
+        router_timeline = EventTimeline(
+            (Path(trace_dir) / "router" / "timeline.jsonl")
+            if trace_dir is not None
+            else None,
+            max_events=cfg.telemetry.max_events,
+            xprof_annotations=False,
+        )
     router = ReplicaRouter(
         replicas,
         registry=registry,
@@ -1892,7 +1999,9 @@ def _build_router_backend(
         block_tokens=cfg.serving.block_tokens,
         retry_budget=rcfg.retry_budget,
         retry_window_sec=rcfg.retry_window_sec,
+        timeline=router_timeline,
     )
+    _configure_request_tracer(cfg, router, router_timeline)
     logger.info(
         "replica router: %d %s replicas, affinity_weight %.1f, "
         "fail_threshold %d",
@@ -1952,6 +2061,8 @@ def _handle_serve(args: argparse.Namespace) -> int:
     configure_logging(level=cfg.logging.level, json_output=cfg.logging.json_output)
     logger = get_logger()
     scheduler = None
+    metrics_stop = None
+    metrics_thread = None
     try:
         from .serving import ServerState, make_server
 
@@ -1973,13 +2084,14 @@ def _handle_serve(args: argparse.Namespace) -> int:
 
         if mode == "continuous":
             try:
+                trace_dir = getattr(args, "trace_dir", None)
                 if use_router:
                     scheduler, registry = _build_router_backend(
-                        cfg, args, model, params, logger
+                        cfg, args, model, params, logger, trace_dir=trace_dir
                     )
                 else:
                     scheduler, registry = _build_serving_backend(
-                        cfg, args, model, params, logger
+                        cfg, args, model, params, logger, trace_dir=trace_dir
                     )
             except ConfigLoadError as exc:
                 _emit_error(exc.message, details=exc.details, errors=exc.errors)
@@ -2068,6 +2180,50 @@ def _handle_serve(args: argparse.Namespace) -> int:
 
             state.reloader = _reload
 
+        # Textfile fallback for serving replicas (mirrors the training
+        # facade's metrics.prom snapshot): a node-exporter textfile
+        # collector can pick up the scrape even when /metrics is behind
+        # a router or the pod network is unreachable. Histograms ride
+        # along, exemplar trace ids included.
+        serve_trace_dir = getattr(args, "trace_dir", None)
+        if (
+            serve_trace_dir
+            and cfg.telemetry.enabled
+            and cfg.telemetry.prometheus_textfile
+        ):
+            import threading
+
+            from .telemetry.prometheus import render_prometheus, write_textfile
+
+            prom_path = Path(serve_trace_dir) / "metrics.prom"
+            metrics_stop = threading.Event()
+
+            def _snapshot_metrics() -> None:
+                try:
+                    write_textfile(
+                        prom_path,
+                        render_prometheus(
+                            registry.latest(),
+                            registry.counters(),
+                            {"component": "serve"},
+                            histograms=registry.histograms(),
+                        ),
+                    )
+                except Exception:  # noqa: BLE001 — snapshot must not kill serving
+                    pass
+
+            def _metrics_loop() -> None:
+                while True:
+                    _snapshot_metrics()
+                    if metrics_stop.wait(5.0):
+                        _snapshot_metrics()
+                        return
+
+            metrics_thread = threading.Thread(
+                target=_metrics_loop, name="metrics-prom", daemon=True
+            )
+            metrics_thread.start()
+
         httpd = make_server(state, args.host, args.port)
         host, port = httpd.server_address[:2]
         # Machine-readable ready line: tests (and orchestration) read the
@@ -2098,6 +2254,10 @@ def _handle_serve(args: argparse.Namespace) -> int:
         _emit_error(f"serve failed: {exc}")
         return exit_code_for_exception(exc)
     finally:
+        if metrics_stop is not None:
+            metrics_stop.set()
+        if metrics_thread is not None:
+            metrics_thread.join(timeout=10.0)
         if scheduler is not None:
             scheduler.close()
 
@@ -2448,14 +2608,21 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             )
             return EXIT_CONFIG_ERROR
 
+        # out_dir is resolved before the backend so per-process timeline
+        # JSONL lands under {out_dir}/telemetry — `llmtrain trace
+        # --run-dir {out_dir}` merges the run after the fact.
+        out_dir = Path(args.out or (Path(cfg.output.root_dir) / "serve_bench"))
+        bench_trace_dir = out_dir / "telemetry"
         try:
             if args.router:
                 scheduler, registry = _build_router_backend(
-                    cfg, args, model, params, logger
+                    cfg, args, model, params, logger,
+                    trace_dir=bench_trace_dir,
                 )
             else:
                 scheduler, registry = _build_serving_backend(
-                    cfg, args, model, params, logger
+                    cfg, args, model, params, logger,
+                    trace_dir=bench_trace_dir,
                 )
         except ConfigLoadError as exc:
             _emit_error(exc.message, details=exc.details, errors=exc.errors)
@@ -2499,6 +2666,9 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
         )
         scheduler.close()
         block["checkpoint"] = str(ckpt_path)
+        tracer = getattr(scheduler, "tracer", None)
+        if tracer is not None:
+            block["tracing"] = tracer.stats()
 
         failures: list[str] = []
         compile_block = block.get("compile")
@@ -2560,7 +2730,7 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
                 if ref != req.tokens:
                     mismatched += 1
                     logger.warning(
-                        "parity mismatch on request %d: served %s != "
+                        "parity mismatch on request %s: served %s != "
                         "generate() %s",
                         req.request_id, req.tokens, ref,
                     )
@@ -2583,7 +2753,6 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
         from .telemetry.report import build_report, write_reports
         from .telemetry.timeline import EventTimeline
 
-        out_dir = Path(args.out or (Path(cfg.output.root_dir) / "serve_bench"))
         # The scheduler's request-id-tagged timeline (queue_wait → prefill
         # → decode spans) feeds the report AND a Perfetto-loadable trace.
         timeline = getattr(scheduler, "timeline", None) or EventTimeline(None)
@@ -2603,6 +2772,7 @@ def _handle_serve_bench(args: argparse.Namespace) -> int:
             "report_json": str(json_path) if json_path else None,
             "report_md": str(md_path) if md_path else None,
             "trace_json": str(trace_path) if trace_path else None,
+            "trace_dir": str(bench_trace_dir),
             "ok": not failures,
         }
         if failures:
@@ -3080,6 +3250,133 @@ def _handle_goodput(args: argparse.Namespace) -> int:
     else:
         print(f"# Goodput — {run_dir}\n")
         print(render_goodput_md(ledger), end="")
+    return EXIT_OK
+
+
+def _handle_trace(args: argparse.Namespace) -> int:
+    """Fleet-wide request-trace reassembly (telemetry/trace_collect.py).
+
+    Pure artifact read, like ``goodput``: scans every --run-dir for
+    ``*timeline*.jsonl``, rebuilds cross-process span trees from the
+    tail-sampled ``cat="trace"`` events (router root → traceparent-
+    propagated replica children), and answers slowest/show/summary/merge.
+    Works with every fleet process dead."""
+    from .telemetry.trace_collect import (
+        collect_traces,
+        critical_path,
+        discover_sources,
+        format_tree,
+        merge_perfetto,
+        slowest,
+        summarize,
+    )
+
+    missing = [d for d in args.run_dirs if not Path(d).exists()]
+    if missing:
+        _emit_error(f"run dir(s) not found: {', '.join(missing)}")
+        return EXIT_CONFIG_ERROR
+    sources = discover_sources(args.run_dirs)
+    if not sources:
+        _emit_error(
+            "no *timeline*.jsonl under the given --run-dir(s) — serve "
+            "with --trace-dir (or point at a serve-bench out dir) so "
+            "each process writes its timeline"
+        )
+        return EXIT_CONFIG_ERROR
+    traces = collect_traces(sources)
+
+    if args.action == "merge":
+        out = Path(
+            args.out or (Path(args.run_dirs[0]) / "merged_trace.json")
+        )
+        merge_perfetto(sources, out, traces=traces)
+        unaligned = [s.label for s in sources if s.start_unix_time is None]
+        if unaligned and any(s.start_unix_time is not None for s in sources):
+            print(
+                "warning: timeline(s) with no segment header could not be "
+                f"time-aligned with the fleet: {', '.join(unaligned)} — "
+                "their events are rebased to the merge start, so cross-"
+                "process ordering against them is not meaningful",
+                file=sys.stderr,
+            )
+        print(
+            json.dumps(
+                {
+                    "merged": str(out),
+                    "processes": [s.label for s in sources],
+                    "traces": len(traces),
+                    "unaligned": unaligned,
+                    "viewer": "https://ui.perfetto.dev",
+                },
+                indent=None if args.json else 2,
+            )
+        )
+        return EXIT_OK
+
+    if not traces:
+        _emit_error(
+            "timelines found but no sampled request traces in them — "
+            "only slow/errored/failed-over/forced requests keep full "
+            "detail (tail sampling); force one with the `X-Trace: force` "
+            "header or check telemetry.tracing.enabled"
+        )
+        return EXIT_TRAIN_FAILURE
+
+    if args.action == "summary":
+        print(json.dumps(summarize(traces), indent=None if args.json else 2))
+        return EXIT_OK
+
+    if args.action == "slowest":
+        rows = []
+        for tr in slowest(traces, k=args.k):
+            root = tr.root
+            rows.append(
+                {
+                    "trace_id": tr.trace_id,
+                    "total_ms": round(tr.duration_ms, 3),
+                    "root": root.name if root else None,
+                    "spans": len(tr.spans),
+                    "processes": tr.sources,
+                    "sampled": (root.args.get("sampled") if root else None),
+                    "request_id": (
+                        root.args.get("request_id") if root else None
+                    ),
+                }
+            )
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            print(json.dumps(rows, indent=2))
+        return EXIT_OK
+
+    # show
+    if not args.trace_id:
+        _emit_error(
+            "`trace show` needs a trace id (or unique prefix) — list "
+            "candidates with `llmtrain trace slowest`"
+        )
+        return EXIT_CONFIG_ERROR
+    matches = [
+        t for t in traces.values() if t.trace_id.startswith(args.trace_id)
+    ]
+    if not matches:
+        _emit_error(f"no trace matching {args.trace_id!r} in the run dirs")
+        return EXIT_TRAIN_FAILURE
+    if len(matches) > 1:
+        _emit_error(
+            f"trace id prefix {args.trace_id!r} is ambiguous "
+            f"({len(matches)} matches) — give more hex digits"
+        )
+        return EXIT_CONFIG_ERROR
+    tr = matches[0]
+    path = critical_path(tr)
+    if args.json:
+        print(json.dumps({"tree": format_tree(tr), "critical_path": path}))
+    else:
+        for line in format_tree(tr):
+            print(line)
+        print()
+        print(json.dumps(path, indent=2))
     return EXIT_OK
 
 
@@ -3663,6 +3960,8 @@ def main(argv: list[str] | None = None) -> int:
         return _handle_tune(args)
     if args.command == "goodput":
         return _handle_goodput(args)
+    if args.command == "trace":
+        return _handle_trace(args)
     if args.command == "validate":
         return _handle_validate(args)
     if args.command == "print-config":

@@ -427,6 +427,31 @@ class ResilienceConfig(BaseModel):
     model_config = _STRICT
 
 
+class TracingConfig(BaseModel):
+    """Distributed request tracing (telemetry/tracing.py,
+    docs/observability.md "Distributed request tracing").
+
+    Tail-based sampling keeps the hot path near-free: every request
+    buffers its spans in memory, but only slow / errored / failed-over /
+    forced (``X-Trace: force``) traces flush full-detail ``cat="trace"``
+    trees into the timeline for ``llmtrain trace`` to reassemble.
+    """
+
+    enabled: bool = True
+    # Keep the slowest fraction of requests (top percentile of a sliding
+    # latency reservoir): 0.05 = roughly the p95+ tail.
+    slow_keep_frac: float = Field(0.05, gt=0.0, le=1.0)
+    # Sliding latency reservoir sizing the slow threshold estimate.
+    reservoir: int = Field(512, ge=16)
+    # Always keep the first N traces per process so a fresh fleet has
+    # something to show before the reservoir warms up.
+    warmup_keep: int = Field(16, ge=0)
+    # Per-request span buffer cap; overflow is counted, not grown.
+    max_spans_per_trace: int = Field(256, ge=8)
+
+    model_config = _STRICT
+
+
 class TelemetryConfig(BaseModel):
     """Unified telemetry subsystem (llmtrain_tpu/telemetry/,
     docs/observability.md): step-event timeline with Perfetto export,
@@ -473,6 +498,9 @@ class TelemetryConfig(BaseModel):
     # for the detected device kind. Keys: peak_flops, hbm_bytes_per_sec,
     # ici_bytes_per_sec (values in FLOP/s and bytes/s).
     device_peaks: dict[str, float] = Field(default_factory=dict)
+    # Distributed request tracing with tail-based sampling (serving
+    # fleet + promote lifecycle; `llmtrain trace` reads the output).
+    tracing: TracingConfig = Field(default_factory=TracingConfig)
 
     model_config = _STRICT
 

@@ -262,6 +262,18 @@ class FleetSupervisor:
         self._started_at: float | None = None
         self._endpoint = None
         self._last_textfile_write = 0.0
+        # Fleet event timeline: launch/preempt/escalate instants land in
+        # work_dir/telemetry/timeline.jsonl so ``llmtrain trace`` can line
+        # supervisor disruptions up against serving and promote traces.
+        self.timeline: Any = None
+        try:
+            from ..telemetry.timeline import EventTimeline
+
+            tel_dir = self.work_dir / "telemetry"
+            tel_dir.mkdir(parents=True, exist_ok=True)
+            self.timeline = EventTimeline(tel_dir / "timeline.jsonl")
+        except Exception:  # noqa: BLE001 — telemetry must not block launch
+            self.timeline = None
 
         self.tenants: dict[str, _Tenant] = {}
         for i, tcfg in enumerate(cfg.fleet.tenants):
@@ -472,6 +484,13 @@ class FleetSupervisor:
         t.kill_deadline = None
         t.segments.append(record)
         t.sm.transition(ts.RUNNING, f"segment {segment} on {allocation} device(s)")
+        self._fleet_instant(
+            "fleet/launch",
+            tenant=t.name,
+            segment=segment,
+            allocation=allocation,
+            resume_step=expected_resume,
+        )
         logger.info(
             "fleet: tenant %s segment %d launched on %d device(s)%s",
             t.name,
@@ -479,6 +498,17 @@ class FleetSupervisor:
             allocation,
             f" (resume from step {expected_resume})" if expected_resume else "",
         )
+
+    def _fleet_instant(self, name: str, **args: Any) -> None:
+        """Timeline instant + periodic flush, never raising: the
+        supervisor's control loop must not die to a full disk."""
+        if self.timeline is None:
+            return
+        try:
+            self.timeline.instant(name, cat="fleet", **args)
+            self.timeline.flush()
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
 
     def _preempt(self, t: _Tenant, *, reason: str, kind: str = "evict") -> None:
         """Rung 1 of the escalation ladder: SIGTERM → the trainer's clean
@@ -492,6 +522,9 @@ class FleetSupervisor:
             t.proc.send_signal(signal.SIGTERM)
         except OSError:  # already gone; the reaper will classify it
             pass
+        self._fleet_instant(
+            "fleet/preempt", tenant=t.name, reason=reason, kind=kind
+        )
         logger.info("fleet: preempting tenant %s (%s)", t.name, reason)
 
     def _escalate_overdue(self, now: float) -> None:
@@ -512,6 +545,11 @@ class FleetSupervisor:
                 self.metrics.inc("fleet/escalations")
                 t.proc.kill()
                 t.kill_deadline = None
+                self._fleet_instant(
+                    "fleet/escalate",
+                    tenant=t.name,
+                    grace_sec=self._fleet.preempt_grace_sec,
+                )
 
     def _backoff_delay(self, t: _Tenant) -> float:
         # Every disruption escalates the ladder — retryable exits (75/76)
@@ -877,6 +915,11 @@ class FleetSupervisor:
         if self._endpoint is not None:
             self._endpoint.close()
             self._endpoint = None
+        if self.timeline is not None:
+            try:
+                self.timeline.flush()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
     # -------------------------------------------------------------- report
 

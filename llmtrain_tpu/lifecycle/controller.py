@@ -36,6 +36,7 @@ from typing import Any, Callable
 
 from ..config.schemas import PromoteConfig
 from ..serving.loadgen import build_requests, percentiles
+from ..telemetry.tracing import new_trace_id
 from ..utils.logging import get_logger
 from .ledger import PromotionLedger
 from .watch import CheckpointWatcher
@@ -216,6 +217,10 @@ class PromotionController:
         self.promotions = 0
         self.rollbacks = 0
         self.aborts = 0
+        # Promotion-cycle trace id: minted per candidate so the cycle's
+        # decision instants and canary-window span correlate with the
+        # serving traces that overlapped the soak (llmtrain trace show).
+        self._cycle_trace_id: str | None = None
         if cfg.canary_replica >= fleet.replica_count:
             raise ValueError(
                 f"promote.canary_replica ({cfg.canary_replica}) is out of "
@@ -226,6 +231,8 @@ class PromotionController:
 
     def _instant(self, decision: str, step: int, **args: Any) -> None:
         if self.timeline is not None:
+            if self._cycle_trace_id is not None:
+                args.setdefault("trace_id", self._cycle_trace_id)
             self.timeline.instant(
                 f"promote/{decision}", cat="promote", step=step, **args
             )
@@ -297,6 +304,30 @@ class PromotionController:
     # ----------------------------------------------------------- one cycle
 
     def _process_candidate(self, ckpt: Path, step: int) -> None:
+        self._cycle_trace_id = new_trace_id()
+        win_t0 = time.perf_counter()
+        try:
+            self._run_cycle(ckpt, step)
+        finally:
+            if self.timeline is not None:
+                try:
+                    # The whole candidate cycle (swap + soak + decision)
+                    # as one span, visible next to serving traces in the
+                    # merged Perfetto view.
+                    self.timeline.record(
+                        "promote/canary_window",
+                        t0=win_t0,
+                        t1=time.perf_counter(),
+                        cat="promote",
+                        step=step,
+                        checkpoint=str(ckpt),
+                        trace_id=self._cycle_trace_id,
+                    )
+                except Exception:  # noqa: BLE001 — telemetry best-effort
+                    pass
+            self._cycle_trace_id = None
+
+    def _run_cycle(self, ckpt: Path, step: int) -> None:
         cfg = self.cfg
         idx = cfg.canary_replica
         self.ledger.append("canary_start", step=step, checkpoint=str(ckpt))

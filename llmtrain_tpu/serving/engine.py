@@ -329,6 +329,21 @@ class PagedDecodeEngine:
         self._decode_shapes: set[int] = set()
         self._verify_shapes: set[tuple[int, int]] = set()
         self._cow_used = False
+        # Optional ``(kind, bucket) -> None`` hook, fired the first time a
+        # bucket shape is seen (= an XLA compile is about to happen). The
+        # scheduler wires it to a timeline instant: a request whose
+        # prefill span brackets a compile instant explains its own tail.
+        self.on_compile: Any = None
+
+    def _note_shape(self, shapes: set, key: Any, kind: str, bucket: int) -> None:
+        if key in shapes:
+            return
+        shapes.add(key)
+        if self.on_compile is not None:
+            try:
+                self.on_compile(kind, bucket)
+            except Exception:  # noqa: BLE001 — telemetry must not fail a step
+                pass
 
     # --------------------------------------------------------- validation
 
@@ -387,7 +402,7 @@ class PagedDecodeEngine:
         chunks — one program either way, the bounded-compile contract)."""
         tp = int(prompt_ids.shape[0])
         tb = bucket_for(tp, self.prompt_buckets)
-        self._prefill_shapes.add(tb)
+        self._note_shape(self._prefill_shapes, tb, "prefill", tb)
         prompt = np.zeros((1, tb), np.int32)
         prompt[0, :tp] = prompt_ids
         try:
@@ -424,7 +439,7 @@ class PagedDecodeEngine:
         if n == 0:
             return []
         bb = bucket_for(n, self.batch_buckets)
-        self._decode_shapes.add(bb)
+        self._note_shape(self._decode_shapes, bb, "decode", bb)
         mb = self.max_blocks_per_seq
 
         def col(key: str, fill: Any, dtype: Any) -> np.ndarray:
@@ -480,7 +495,7 @@ class PagedDecodeEngine:
         if n == 0:
             return []
         bb = bucket_for(n, self.batch_buckets)
-        self._verify_shapes.add((bb, width))
+        self._note_shape(self._verify_shapes, (bb, width), "verify", bb)
         mb = self.max_blocks_per_seq
         tokens = np.zeros((bb, width), np.int32)
         positions = np.zeros((bb,), np.int32)

@@ -33,6 +33,21 @@ from typing import Any
 import jax
 import numpy as np
 
+from ..telemetry.stats import percentile as _nearest_rank_percentile
+
+# Histogram buckets (ms) for the exemplar-carrying /metrics histograms —
+# roughly log-spaced across interactive serving SLOs. Each observation
+# may attach its trace_id as an OpenMetrics exemplar, so a scrape of a
+# slow bucket hands you a trace id to feed ``llmtrain trace show``.
+_TTFT_BUCKETS_MS = (
+    5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+)
+_LATENCY_BUCKETS_MS = (
+    10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+    10000.0, 30000.0,
+)
+_PER_TOKEN_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0)
+
 
 class ServerStats:
     """Lock-protected cross-request counters/accumulators.
@@ -94,10 +109,11 @@ class ServerStats:
 
     @staticmethod
     def _percentile(sorted_vals: list[float], q: float) -> float | None:
+        # Shared nearest-rank helper (telemetry/stats.py) so /metrics,
+        # loadgen, and the trace summary all agree on what "p95" means.
         if not sorted_vals:
             return None
-        idx = min(len(sorted_vals) - 1, round(q * (len(sorted_vals) - 1)))
-        return sorted_vals[idx]
+        return _nearest_rank_percentile(sorted_vals, q)
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
@@ -193,6 +209,72 @@ def _header(headers: Any, name: str) -> str | None:
         lowered = {k.lower(): v for k, v in headers.items()}
         value = lowered.get(name.lower())
     return value
+
+
+def _attach_trace(state: ServerState, req: Any, headers: Any) -> None:
+    """Ingress tracing: adopt a propagated ``traceparent`` (the request
+    is a hop of a router-minted trace — our spans parent under the
+    router's dispatch span) or honor ``X-Trace: force``. With neither,
+    nothing happens here: the scheduler/router mints its own root on
+    submit. Trace failures never fail a request."""
+    tracer = getattr(state.scheduler, "tracer", None)
+    if tracer is None:
+        return
+    try:
+        from ..telemetry.tracing import (
+            FORCE_HEADER,
+            TRACEPARENT_HEADER,
+            TraceContext,
+        )
+
+        parent = TraceContext.from_traceparent(
+            _header(headers, TRACEPARENT_HEADER)
+        )
+        forced = (_header(headers, FORCE_HEADER) or "").strip().lower() == "force"
+        if parent is None and not forced:
+            return
+        root_name = (
+            "router/request"
+            if getattr(state.scheduler, "policy", "") == "router"
+            else "serve/request"
+        )
+        req.trace = tracer.start(
+            parent=parent, forced=forced, root_name=root_name
+        )
+    except Exception:  # noqa: BLE001 — tracing is best-effort
+        pass
+
+
+def _observe_histograms(
+    state: ServerState,
+    *,
+    latency_ms: float,
+    tokens: int,
+    ttft_ms: float | None,
+    trace_id: str | None,
+) -> None:
+    """Feed the /metrics histograms, tagging each observation with the
+    request's trace id so slow buckets carry OpenMetrics exemplars."""
+    if state.registry is None:
+        return
+    try:
+        state.registry.observe(
+            "serve/latency_ms", latency_ms,
+            buckets=_LATENCY_BUCKETS_MS, trace_id=trace_id,
+        )
+        if ttft_ms is not None:
+            state.registry.observe(
+                "serve/ttft_ms", ttft_ms,
+                buckets=_TTFT_BUCKETS_MS, trace_id=trace_id,
+            )
+            if tokens > 1:
+                state.registry.observe(
+                    "serve/per_token_ms",
+                    (latency_ms - ttft_ms) / (tokens - 1),
+                    buckets=_PER_TOKEN_BUCKETS_MS, trace_id=trace_id,
+                )
+    except Exception:  # noqa: BLE001 — metrics are best-effort
+        pass
 
 
 def _handle_generate_request(
@@ -333,6 +415,7 @@ def _generate_request_inner(
 
     t0 = time.monotonic()
     extra: dict[str, Any] = {}
+    trace_id: str | None = None
     if state.scheduler is not None:
         # Continuous batching: enqueue and wait; the scheduler thread
         # joins this sequence into the in-flight batch.
@@ -350,6 +433,7 @@ def _generate_request_inner(
             priority=priority,
             rid=rid,
         )
+        _attach_trace(state, req, headers)
         state.scheduler.submit(req)
         if not req.done.wait(timeout=state.request_timeout_sec):
             # Tell the scheduler this waiter is gone: under sustained
@@ -361,6 +445,7 @@ def _generate_request_inner(
             return 503, {
                 "error": "request timed out in the serving queue", **echo
             }
+        trace_id = req.trace_id
         if req.finish_reason in ("rejected", "shed"):
             # Overload control said no — fast 429 with the reason and a
             # Retry-After hint (do_POST lifts it into the header).
@@ -370,16 +455,23 @@ def _generate_request_inner(
                 "finish_reason": req.finish_reason,
                 **echo,
             }
+            if trace_id is not None:
+                payload["trace_id"] = trace_id
             if req.retry_after_sec is not None:
                 payload["retry_after"] = round(req.retry_after_sec, 3)
             return 429, payload
         if req.error is not None:
             state.stats.record_error()
-            return 500, {"error": f"generation failed: {req.error}", **echo}
+            payload = {"error": f"generation failed: {req.error}", **echo}
+            if trace_id is not None:
+                payload["trace_id"] = trace_id
+            return 500, payload
         completion = list(req.tokens)
         if req.ttft_ms is not None:
             extra["ttft_ms"] = round(req.ttft_ms, 3)
         extra["finish_reason"] = req.finish_reason
+        if trace_id is not None:
+            extra["trace_id"] = trace_id
     else:
         with state.lock:
             out = generate(
@@ -401,6 +493,13 @@ def _generate_request_inner(
         latency_ms=latency_ms,
         tokens=len(completion),
         ttft_ms=extra.get("ttft_ms"),
+    )
+    _observe_histograms(
+        state,
+        latency_ms=latency_ms,
+        tokens=len(completion),
+        ttft_ms=extra.get("ttft_ms"),
+        trace_id=trace_id,
     )
     if state.registry is not None and state.scheduler is None:
         # The scheduler publishes its own serve/* metrics; the legacy
@@ -486,6 +585,7 @@ def _handle_metrics(state: ServerState) -> tuple[int, str]:
         gauges,
         state.registry.counters(),
         {"component": "serve", "checkpoint": state.checkpoint},
+        histograms=state.registry.histograms(),
     )
 
 

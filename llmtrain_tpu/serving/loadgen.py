@@ -27,25 +27,18 @@ from typing import Any
 
 import numpy as np
 
+from ..telemetry.stats import percentiles as _stats_percentiles
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
 
 
 def percentiles(samples: list[float]) -> dict[str, float | None]:
-    """p50/p95/p99/mean/max by nearest-rank on the sorted samples."""
+    """p50/p95/p99/mean/max by nearest-rank on the sorted samples —
+    thin back-compat wrapper over the shared ``telemetry.stats`` helper
+    (same math as /metrics gauges and ``llmtrain trace summary``), keeping
+    this module's explicit-None shape for empty sample sets."""
     if not samples:
         return {"p50": None, "p95": None, "p99": None, "mean": None, "max": None}
-    s = sorted(samples)
-
-    def rank(p: float) -> float:
-        return s[min(len(s) - 1, max(0, int(np.ceil(p * len(s))) - 1))]
-
-    return {
-        "p50": round(rank(0.50), 3),
-        "p95": round(rank(0.95), 3),
-        "p99": round(rank(0.99), 3),
-        "mean": round(float(np.mean(s)), 3),
-        "max": round(s[-1], 3),
-    }
+    return _stats_percentiles([float(v) for v in samples])
 
 
 def build_requests(

@@ -455,7 +455,15 @@ class OverloadController:
         """Admission verdict for one submitted request: None admits,
         otherwise ``(reason, retry_after_sec)`` rejects. Checked in
         cheapness order — the queue cap costs a comparison, the bucket a
-        refill, the deadline a multiply."""
+        refill, the deadline a multiply. The predicted wait the verdict
+        was decided on is stamped onto the request (best-effort) so a
+        rejection's distributed trace shows WHY it was turned away."""
+        try:
+            req.admission_predicted_wait_ms = round(
+                self.estimator.predicted_wait_ms(depth), 3
+            )
+        except Exception:  # noqa: BLE001 — annotation only, never reject on it
+            pass
         if depth >= self.queue_cap:
             return (
                 REASON_QUEUE_FULL,

@@ -41,7 +41,7 @@ from __future__ import annotations
 import hashlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Any, Sequence
 
 NULL_BLOCK = 0
 
@@ -167,6 +167,20 @@ class PagedKVPool:
         self.prefix_tokens_reused = 0
         self.prefix_evictions = 0
         self.cow_copies = 0
+        # Optional event observer ``(name, args) -> None`` wired by the
+        # scheduler to its timeline: pool-level events (evictions, COW,
+        # cache invalidation) that explain request latency but have no
+        # request of their own. Scheduler-thread-only, like every other
+        # pool mutation; observer failures never reach the pool.
+        self.observer: Any = None
+
+    def _observe(self, name: str, **args: Any) -> None:
+        if self.observer is None:
+            return
+        try:
+            self.observer(name, args)
+        except Exception:  # noqa: BLE001 — telemetry must not break paging
+            pass
 
     # ------------------------------------------------------------- sizing
 
@@ -219,6 +233,7 @@ class PagedKVPool:
             blk, _ = self._evictable.popitem(last=False)
             self._forget_entry(blk)
             self.prefix_evictions += 1
+            self._observe("evict", block=blk, cached_blocks=len(self._evictable))
             return blk
         raise RuntimeError(
             "paged KV pool exhausted inside a reservation — accounting bug"
@@ -389,6 +404,7 @@ class PagedKVPool:
         table.shared -= 1
         self.cow_copies += 1
         self.peak_allocated = max(self.peak_allocated, self.allocated_blocks)
+        self._observe("cow", src=src, dst=dst)
         return src, dst
 
     def register_prefix(
@@ -451,6 +467,8 @@ class PagedKVPool:
                 if not siblings:
                     del self._children[ent.parent]
             flushed += 1
+        if flushed:
+            self._observe("prefix_invalidated", blocks=flushed)
         return flushed
 
     # ------------------------------------------------------------ telemetry

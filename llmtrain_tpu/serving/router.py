@@ -48,6 +48,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from ..telemetry.tracing import TraceContext, Tracer, new_span_id
 from ..utils.logging import get_logger
 from .overload import REASON_RETRY_BUDGET, RetryBudget, rejected_counter
 from .paged_kv import chain_hashes
@@ -198,12 +199,15 @@ class HTTPReplica:
         with urllib.request.urlopen(request, timeout=self.timeout_sec) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
-    def perform(self, req: ServeRequest) -> None:
+    def perform(
+        self, req: ServeRequest, *, traceparent: str | None = None
+    ) -> None:
         """Blocking POST, called on the router's submit thread; raises on
         transport errors so the router can fail over. A 429 raises
         :class:`ReplicaBackpressure` (request fields untouched, so a
         failover re-perform is clean) and opens the replica's
-        backpressure window."""
+        backpressure window. ``traceparent`` carries the router's hop
+        span across the wire so the replica's spans parent under it."""
         body: dict[str, Any] = {
             "prompt_ids": [int(t) for t in req.prompt_ids],
             "max_new_tokens": int(req.max_new_tokens),
@@ -217,6 +221,8 @@ class HTTPReplica:
         if req.eos_token_id is not None:
             body["eos_token_id"] = int(req.eos_token_id)
         headers: dict[str, str] = {}
+        if traceparent:
+            headers["traceparent"] = traceparent
         if req.rid:
             headers["X-Request-Id"] = str(req.rid)
         if req.priority:
@@ -403,10 +409,19 @@ class ReplicaRouter:
         block_tokens: int | None = None,
         retry_budget: int = 0,
         retry_window_sec: float = 10.0,
+        timeline: Any | None = None,
+        tracer: Tracer | None = None,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.registry = registry
+        # Distributed tracing: the router mints each request's root span
+        # (``router/request``) and flushes kept traces to its own
+        # timeline; replica hops parent under it via traceparent headers.
+        self.timeline = timeline
+        self.tracer = tracer if tracer is not None else (
+            Tracer(timeline) if timeline is not None else None
+        )
         self.affinity_weight = float(affinity_weight)
         self.max_affinity_entries = int(max_affinity_entries)
         self.fail_threshold = int(fail_threshold)
@@ -609,11 +624,25 @@ class ReplicaRouter:
     # ------------------------------------------------------------ dispatch
 
     def submit(self, req: ServeRequest) -> ServeRequest:
+        t_mono = time.monotonic()
+        t_pc = time.perf_counter()
+        if self.tracer is not None and req.trace is None:
+            req.trace = self.tracer.start(root_name="router/request")
         idx = self.select(req.prompt_ids)
         replica = self._states[idx].replica
+        if req.trace is not None:
+            req.trace.add_span(
+                "router/place",
+                t0=t_pc,
+                t1=time.perf_counter(),
+                replica=replica.name,
+                request_id=req.request_id,
+            )
         if isinstance(replica, HTTPReplica):
-            req.submitted_t = time.monotonic()
-            req.submitted_pc = time.perf_counter()
+            # Stamp at router entry so the root span (and latency) cover
+            # placement, not just the HTTP hop.
+            req.submitted_t = t_mono
+            req.submitted_pc = t_pc
             with replica._lock:
                 replica._inflight += 1
             threading.Thread(
@@ -632,15 +661,36 @@ class ReplicaRouter:
 
     def _perform_http(self, req: ServeRequest, idx: int) -> None:
         replica = self._states[idx].replica
+        hop_t0 = time.perf_counter()
+        traceparent: str | None = None
+        hop_sid: str | None = None
+        if req.trace is not None:
+            # Pre-allocate the hop span id: the replica needs it in the
+            # traceparent header BEFORE the hop completes so its own
+            # spans can parent under this dispatch.
+            hop = TraceContext(
+                req.trace.trace_id,
+                new_span_id(),
+                req.trace.root_span_id,
+                req.trace.ctx.forced,
+            )
+            traceparent = hop.to_traceparent()
+            hop_sid = hop.span_id
         try:
-            replica.perform(req)
+            replica.perform(req, traceparent=traceparent)
             self._note_success(idx)
+            self._hop_done(req, hop_t0, hop_sid, replica.name)
+            self._finish_trace(req)
         except ReplicaBackpressure as exc:
             # 429 = overloaded, not dead: no eviction strike; the replica
             # already opened its backpressure window for placement.
             logger.warning(
-                "router: replica %s backpressured request %d (%s)",
+                "router: replica %s backpressured request %s (%s)",
                 replica.name, req.request_id, exc.reason,
+            )
+            self._hop_done(
+                req, hop_t0, hop_sid, replica.name,
+                error=f"backpressure:{exc.reason or 'overloaded'}",
             )
             try:
                 self._failover(req, exclude={idx}, cause=exc)
@@ -648,16 +698,67 @@ class ReplicaRouter:
                 req.error = str(exc2)
                 req.finish_reason = "error"
                 req.finished_t = time.monotonic()
+                self._finish_trace(req)
                 req.done.set()
         except Exception as exc:  # noqa: BLE001 — transport error: failover
             self._note_failure(idx, exc)
+            self._hop_done(
+                req, hop_t0, hop_sid, replica.name, error=str(exc)
+            )
             try:
                 self._failover(req, exclude={idx}, cause=exc)
             except Exception as exc2:  # noqa: BLE001 — out of replicas
                 req.error = str(exc2)
                 req.finish_reason = "error"
                 req.finished_t = time.monotonic()
+                self._finish_trace(req)
                 req.done.set()
+
+    def _hop_done(
+        self,
+        req: ServeRequest,
+        t0: float,
+        span_id: str | None,
+        replica_name: str,
+        error: str | None = None,
+    ) -> None:
+        """Buffer the router→replica HTTP hop span (failed hops too — a
+        trace that failed over shows every attempt, not just the winner)."""
+        if req.trace is None:
+            return
+        args: dict[str, Any] = {"replica": replica_name}
+        if error is not None:
+            args["error"] = error
+        req.trace.add_span(
+            "router/http_dispatch",
+            t0=t0,
+            t1=time.perf_counter(),
+            span_id=span_id,
+            **args,
+        )
+
+    def _finish_trace(self, req: ServeRequest) -> None:
+        """Resolve the request's trace on the router's completion path
+        (HTTP hops only — in-process replicas finish via their
+        scheduler; Tracer.finish is idempotent either way)."""
+        if self.tracer is None or req.trace is None:
+            return
+        t1 = time.perf_counter()
+        root_args: dict[str, Any] = {
+            "request_id": req.request_id,
+            "finish_reason": req.finish_reason,
+        }
+        if req.rid:
+            root_args["rid"] = req.rid
+        if req.ttft_ms is not None:
+            root_args["ttft_ms"] = round(req.ttft_ms, 3)
+        self.tracer.finish(
+            req.trace,
+            t0=req.submitted_pc if req.submitted_pc > 0.0 else t1,
+            t1=t1,
+            errored=req.error is not None or req.finish_reason == "error",
+            **root_args,
+        )
 
     def _reject_retry(self, req: ServeRequest, cause: Exception) -> None:
         """Retry budget exhausted: finish the request as rejected (fast,
@@ -673,9 +774,12 @@ class ReplicaRouter:
         if self.registry is not None:
             self.registry.inc(rejected_counter(REASON_RETRY_BUDGET))
         logger.warning(
-            "router: retry budget exhausted; rejecting request %d (%s)",
+            "router: retry budget exhausted; rejecting request %s (%s)",
             req.request_id, cause,
         )
+        if req.trace is not None:
+            req.trace.note(reject_reason=REASON_RETRY_BUDGET)
+        self._finish_trace(req)
         req.done.set()
 
     def _failover(
@@ -699,9 +803,20 @@ class ReplicaRouter:
             self._states[idx].routed += 1
         replica = self._states[idx].replica
         logger.warning(
-            "router: failing request %d over to %s", req.request_id,
+            "router: failing request %s over to %s", req.request_id,
             replica.name,
         )
+        if req.trace is not None:
+            # A failed-over request is always trace-worthy; forcing also
+            # propagates the keep decision to the retry hop's replica.
+            req.trace.note(failover=True)
+            req.trace.force()
+            req.trace.add_event(
+                "router/failover",
+                t=time.perf_counter(),
+                replica=replica.name,
+                cause=str(cause),
+            )
         if isinstance(replica, HTTPReplica):
             with replica._lock:
                 replica._inflight += 1
@@ -859,6 +974,9 @@ class ReplicaRouter:
                     else None
                 ),
             },
+            "tracing": (
+                self.tracer.stats() if self.tracer is not None else None
+            ),
             "fleet_prefix": {
                 "hits": prefix_hits,
                 "queries": prefix_queries,
@@ -926,6 +1044,11 @@ class ReplicaRouter:
         for s in self._states:
             try:
                 s.replica.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if self.timeline is not None:
+            try:
+                self.timeline.flush()
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
 

@@ -53,16 +53,18 @@ MetricsRegistry under ``serve/*`` (→ ``llmtrain_serve_*`` in Prometheus).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
-from contextlib import nullcontext
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
 from typing import Any
 
 import jax
 import numpy as np
 
+from ..telemetry.tracing import Tracer
 from ..utils.logging import get_logger
 from .engine import PagedDecodeEngine
 from .overload import (
@@ -74,6 +76,16 @@ from .overload import (
 logger = get_logger()
 
 _REQ_IDS = itertools.count()
+# Request ids used to be the bare process-local counter, so two replica
+# pods emitted IDENTICAL ids into merged fleet telemetry. Every id is now
+# namespaced by a per-process random token — unique fleet-wide, still
+# ordered (and greppable) within one process.
+_PROC_TOKEN = os.urandom(4).hex()
+
+
+def new_request_id() -> str:
+    """``{process_token}/{n}``: collision-free across replica processes."""
+    return f"{_PROC_TOKEN}/{next(_REQ_IDS)}"
 
 
 @dataclass
@@ -87,7 +99,7 @@ class ServeRequest:
     top_p: float | None = None
     seed: int = 0
     eos_token_id: int | None = None
-    request_id: int = field(default_factory=lambda: next(_REQ_IDS))
+    request_id: str = field(default_factory=new_request_id)
     # Measurements (scheduler-thread writes, reader waits on `done`).
     submitted_t: float = 0.0
     # perf_counter twin of submitted_t: EventTimeline spans are
@@ -109,6 +121,11 @@ class ServeRequest:
     deadline_ms: float | None = None
     priority: str = "interactive"
     rid: str | None = None
+    # Distributed trace (telemetry/tracing.py): the per-request span
+    # buffer + W3C-style context. Set by the ingress that minted the root
+    # (router, HTTP handler) or lazily by the scheduler's own submit;
+    # resolved exactly once by whichever component sets ``done``.
+    trace: Any = None
     # Queue depth seen at submit — the EWMA wait estimator's x-axis.
     queue_depth_at_submit: int = 0
     # Set when the overload layer rejected/shed this request: the
@@ -124,6 +141,10 @@ class ServeRequest:
 
     def abandon(self) -> None:
         self.abandoned.set()
+
+    @property
+    def trace_id(self) -> str | None:
+        return self.trace.trace_id if self.trace is not None else None
 
     @property
     def ttft_ms(self) -> float | None:
@@ -173,6 +194,7 @@ class ContinuousBatchingScheduler:
         gamma: int = 4,
         timeline: Any | None = None,  # telemetry EventTimeline
         overload: OverloadController | None = None,
+        tracer: Tracer | None = None,
     ) -> None:
         if policy not in ("paged", "speculative"):
             raise ValueError(
@@ -208,6 +230,17 @@ class ContinuousBatchingScheduler:
         # request ids, so one request's life is followable in Perfetto
         # (docs/observability.md). None = no tracing overhead.
         self.timeline = timeline
+        # Distributed tracing (telemetry/tracing.py): defaults on whenever
+        # a timeline exists — per-request cost is a small span buffer, and
+        # only tail-sampled traces are flushed in full detail.
+        self.tracer = tracer if tracer is not None else (
+            Tracer(timeline) if timeline is not None else None
+        )
+        if timeline is not None and engine is not None:
+            # Pool-level KV events (evictions, COW) land as timeline
+            # instants: they explain latency the per-request spans can't.
+            engine.pool.observer = self._kv_event
+            engine.on_compile = self._compile_event
         self.max_batch_slots = int(
             max_batch_slots
             or (engine.max_batch_slots if engine is not None else 1)
@@ -274,6 +307,10 @@ class ContinuousBatchingScheduler:
         the caller never waits on a request that was never admitted."""
         req.submitted_t = time.monotonic()
         req.submitted_pc = time.perf_counter()
+        if self.tracer is not None and req.trace is None:
+            # Direct submitters (loadgen, tests) get a root minted here;
+            # router/HTTP ingress attach their own before submitting.
+            req.trace = self.tracer.start()
         verdict: tuple[str, float] | None = None
         with self._wake:
             if self._closed:
@@ -314,6 +351,8 @@ class ContinuousBatchingScheduler:
             self.registry.inc(rejected_counter(reason))
         if self.timeline is not None:
             extra = {"rid": req.rid} if req.rid else {}
+            if req.trace is not None:
+                extra["trace_id"] = req.trace.trace_id
             self.timeline.instant(
                 "serve/rejected",
                 cat="serve",
@@ -321,6 +360,13 @@ class ContinuousBatchingScheduler:
                 request_id=req.request_id,
                 **extra,
             )
+        if req.trace is not None:
+            note: dict[str, Any] = {"reject_reason": reason}
+            predicted = getattr(req, "admission_predicted_wait_ms", None)
+            if predicted is not None:
+                note["predicted_wait_ms"] = predicted
+            req.trace.note(**note)
+        self._finish_trace(req)
         req.done.set()
 
     def hot_swap(
@@ -349,6 +395,93 @@ class ContinuousBatchingScheduler:
             return nullcontext()
         return self.timeline.span(name, cat="serve", **args)
 
+    @contextmanager
+    def _traced_span(self, req: ServeRequest, name: str, **args: Any):
+        """Per-request span, recorded twice: live into the timeline (with
+        a ``trace_id`` correlation arg, un-treed — the always-on view) and
+        into the request's tail-sampling buffer with true perf_counter
+        stamps, flushed as part of the span TREE only if the trace is
+        kept (telemetry/tracing.py)."""
+        trace = req.trace
+        live = args if trace is None else {**args, "trace_id": trace.trace_id}
+        t0 = time.perf_counter()
+        try:
+            with self._span(name, **live):
+                yield
+        finally:
+            if trace is not None:
+                trace.add_span(name, t0=t0, t1=time.perf_counter(), **args)
+
+    def _kv_event(self, name: str, args: dict[str, Any]) -> None:
+        """PagedKVPool observer: pool-level events (prefix evictions, COW
+        copies) become serving timeline instants."""
+        if self.timeline is not None:
+            self.timeline.instant(f"serve/kv_{name}", cat="serve", **args)
+
+    def _compile_event(self, kind: str, bucket: int) -> None:
+        """Engine first-bucket hook: the XLA compile about to happen lands
+        as an instant — a prefill span bracketing one explains its own
+        tail latency in ``llmtrain trace show``."""
+        if self.timeline is not None:
+            self.timeline.instant(
+                "serve/compile", cat="serve", kind=kind, bucket=bucket
+            )
+
+    def _finish_trace(self, req: ServeRequest) -> None:
+        """Resolve the request's distributed trace: add the decode-phase
+        span, then let the tail sampler decide whether the buffered tree
+        is flushed. Called by every path that sets ``done``; idempotent
+        (the router may also sit on a request's completion path).
+
+        Best-effort: it runs BEFORE ``req.done.set()`` on the scheduler
+        step thread, so a tracer/timeline failure (e.g. OSError flushing
+        a file-backed timeline) must not hang the client waiter or kill
+        the loop."""
+        try:
+            self._finish_trace_inner(req)
+        except Exception:  # noqa: BLE001 — tracing must never fail a request
+            logger.warning(
+                "trace finish failed for request %s", req.request_id,
+                exc_info=True,
+            )
+
+    def _finish_trace_inner(self, req: ServeRequest) -> None:
+        if self.tracer is None or req.trace is None:
+            return
+        t1 = time.perf_counter()
+        if req.submitted_pc > 0.0 and req.finished_t is not None:
+            # Map the monotonic measurement stamps onto the perf_counter
+            # timeline via the paired submit stamps (identical clocks on
+            # Linux; the offset keeps it exact elsewhere).
+            off = req.submitted_pc - req.submitted_t
+            t1 = req.finished_t + off
+            if (
+                req.first_token_t is not None
+                and req.finished_t > req.first_token_t
+            ):
+                req.trace.add_span(
+                    "serve/decode_phase",
+                    t0=req.first_token_t + off,
+                    t1=t1,
+                    request_id=req.request_id,
+                    tokens=len(req.tokens),
+                )
+        root_args: dict[str, Any] = {
+            "request_id": req.request_id,
+            "finish_reason": req.finish_reason,
+        }
+        if req.rid:
+            root_args["rid"] = req.rid
+        if req.ttft_ms is not None:
+            root_args["ttft_ms"] = round(req.ttft_ms, 3)
+        self.tracer.finish(
+            req.trace,
+            t0=req.submitted_pc if req.submitted_pc > 0.0 else t1,
+            t1=t1,
+            errored=req.error is not None or req.finish_reason == "error",
+            **root_args,
+        )
+
     def _record_queue_wait(self, req: ServeRequest) -> None:
         """Queue-wait span from the submit stamp to now — with the
         request_id tag it abuts the same request's prefill span, so one
@@ -360,13 +493,25 @@ class ContinuousBatchingScheduler:
                 (time.monotonic() - req.submitted_t) * 1e3,
                 req.queue_depth_at_submit,
             )
-        if self.timeline is None or req.submitted_pc <= 0.0:
+        if req.submitted_pc <= 0.0:
+            return
+        t1 = time.perf_counter()
+        if req.trace is not None:
+            req.trace.add_span(
+                "serve/queue_wait",
+                t0=req.submitted_pc,
+                t1=t1,
+                request_id=req.request_id,
+            )
+        if self.timeline is None:
             return
         extra = {"rid": req.rid} if req.rid else {}
+        if req.trace is not None:
+            extra["trace_id"] = req.trace.trace_id
         self.timeline.record(
             "serve/queue_wait",
             t0=req.submitted_pc,
-            t1=time.perf_counter(),
+            t1=t1,
             cat="serve",
             request_id=req.request_id,
             **extra,
@@ -516,7 +661,8 @@ class ContinuousBatchingScheduler:
         engine.pool.grow(row.table, end)
         extra = {"rid": row.req.rid} if row.req.rid else {}
         try:
-            with self._span(
+            with self._traced_span(
+                row.req,
                 "serve/prefill",
                 request_id=row.req.request_id,
                 prompt_tokens=end - start,
@@ -633,6 +779,16 @@ class ContinuousBatchingScheduler:
             # block match needs a private copy (COW) before its divergent
             # tail is written.
             match = engine.pool.match_prefix(req.prompt_ids)
+            if req.trace is not None:
+                # Prefix-cache verdict inside the request's trace: a miss
+                # that forces a full prefill is a classic p99 explanation.
+                req.trace.add_event(
+                    "serve/prefix_cache",
+                    t=time.perf_counter(),
+                    hit=match.hit,
+                    matched_tokens=match.matched_tokens,
+                    prompt_tokens=int(req.prompt_ids.shape[0]),
+                )
             if match.hit:
                 engine.pool.bind_prefix(row.table, match)
                 row.prefilled = match.matched_tokens
@@ -765,8 +921,8 @@ class ContinuousBatchingScheduler:
 
         req.params_step = self._param_meta[self._param_epoch].get("step")
         try:
-            with self._span(
-                "serve/speculative_decode", request_id=req.request_id
+            with self._traced_span(
+                req, "serve/speculative_decode", request_id=req.request_id
             ):
                 out = speculative_generate(
                     self._model,
@@ -803,6 +959,7 @@ class ContinuousBatchingScheduler:
         self.requests_finished += 1
         if self.registry is not None:
             self.registry.inc("serve/requests")
+        self._finish_trace(req)
         req.done.set()
 
     def _step_speculative_one(self) -> bool:
@@ -901,8 +1058,11 @@ class ContinuousBatchingScheduler:
             draft.pool.grow(row.draft_table, tp)
             self._record_queue_wait(req)
             try:
-                with self._span(
-                    "serve/prefill", request_id=req.request_id, prompt_tokens=tp
+                with self._traced_span(
+                    req,
+                    "serve/prefill",
+                    request_id=req.request_id,
+                    prompt_tokens=tp,
                 ):
                     tok = engine.prefill(
                         req.prompt_ids,
@@ -1097,16 +1257,22 @@ class ContinuousBatchingScheduler:
         self.requests_finished += 1
         if self.registry is not None:
             self.registry.inc("serve/requests")
+        self._finish_trace(row.req)
         row.req.done.set()
 
     def _retire_abandoned(self, req: ServeRequest) -> None:
         logger.warning(
-            "serve request %d abandoned by its waiter; shed", req.request_id
+            "serve request %s abandoned by its waiter; shed", req.request_id
         )
         req.finish_reason = "abandoned"
         req.finished_t = time.monotonic()
         if self.registry is not None:
             self.registry.inc("serve/requests_abandoned")
+        if req.trace is not None:
+            # An abandonment IS a latency incident (the waiter timed out):
+            # force-keep the trace so the post-mortem has the span tree.
+            req.trace.note(abandoned=True, error="abandoned by waiter")
+        self._finish_trace(req)
         req.done.set()
 
     def _fail_all_in_flight(self, cause: Exception) -> None:
@@ -1122,12 +1288,13 @@ class ContinuousBatchingScheduler:
         self._prefilling = []
 
     def _fail(self, req: ServeRequest, exc: Exception) -> None:
-        logger.warning("serve request %d failed: %s", req.request_id, exc)
+        logger.warning("serve request %s failed: %s", req.request_id, exc)
         req.error = str(exc)
         req.finish_reason = "error"
         req.finished_t = time.monotonic()
         if self.registry is not None:
             self.registry.inc("serve/request_errors")
+        self._finish_trace(req)
         req.done.set()
 
     def _publish_metrics(self) -> None:
@@ -1290,6 +1457,11 @@ class ContinuousBatchingScheduler:
             self._thread.join(timeout=timeout)
             if self._thread.is_alive():
                 logger.warning("serve scheduler did not drain in %.0fs", timeout)
+        if self.timeline is not None:
+            try:
+                self.timeline.flush()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
 
 __all__ = ["ContinuousBatchingScheduler", "ServeRequest"]

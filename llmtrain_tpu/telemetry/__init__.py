@@ -42,7 +42,9 @@ from .prometheus import (
 )
 from .registry import MetricsRegistry
 from .report import build_report, render_markdown, write_reports
+from .stats import percentile, percentiles
 from .timeline import EventTimeline, step_annotation
+from .tracing import TailSampler, TraceContext, Tracer
 
 logger = get_logger()
 
@@ -333,8 +335,13 @@ __all__ = [
     "MemoryMonitor",
     "MetricsRegistry",
     "PrometheusEndpoint",
+    "TailSampler",
     "Telemetry",
+    "TraceContext",
+    "Tracer",
     "build_report",
+    "percentile",
+    "percentiles",
     "prometheus_name",
     "render_markdown",
     "render_prometheus",

@@ -79,17 +79,46 @@ def _split_labels(metric: str) -> tuple[str, str]:
     return m.group("base"), m.group("labels")
 
 
+def render_histogram(name: str, histogram: Any) -> list[str]:
+    """Exposition lines for one fixed-bucket histogram with exemplars.
+
+    ``histogram`` is a :class:`~.stats.Histogram` (anything with its
+    ``snapshot()`` shape). Exemplars use the OpenMetrics suffix syntax —
+    ``..._bucket{le="250"} 17 # {trace_id="..."} 212.4`` — which links a
+    dashboard's TTFT spike straight to the distributed trace that caused
+    it (docs/observability.md); classic-format scrapers that reject the
+    suffix can strip everything after `` # ``.
+    """
+    rows, total_sum, total_count = histogram.snapshot()
+    pname = prometheus_name(name)
+    lines = [f"# TYPE {pname} histogram"]
+    for le, cum, exemplar in rows:
+        le_str = "+Inf" if math.isinf(le) else _fmt_value(le)
+        sample = f'{pname}_bucket{{le="{le_str}"}} {cum}'
+        if exemplar is not None:
+            sample += (
+                f' # {{trace_id="{_escape_label(exemplar.trace_id)}"}}'
+                f" {_fmt_value(exemplar.value)}"
+            )
+        lines.append(sample)
+    lines.append(f"{pname}_sum {_fmt_value(total_sum)}")
+    lines.append(f"{pname}_count {total_count}")
+    return lines
+
+
 def render_prometheus(
     gauges: dict[str, tuple[float, int | None]],
     counters: dict[str, float] | None = None,
     info: dict[str, str] | None = None,
+    histograms: dict[str, Any] | None = None,
 ) -> str:
     """Render the registry's state as Prometheus exposition text.
 
     ``gauges`` is ``{tracker metric name: (value, step)}`` (the registry's
     :meth:`~.registry.MetricsRegistry.latest`); ``counters`` become
     ``counter``-typed series; ``info`` renders as the conventional
-    ``llmtrain_run_info{...} 1`` labels-only metric.
+    ``llmtrain_run_info{...} 1`` labels-only metric; ``histograms`` maps
+    metric name → :class:`~.stats.Histogram` (see :func:`render_histogram`).
     """
     lines: list[str] = []
     if info:
@@ -118,11 +147,24 @@ def render_prometheus(
             typed.add(name)
             lines.append(f"# TYPE {name} counter")
         lines.append(f"{name}{labels} {_fmt_value((counters or {})[metric])}")
+    for metric in sorted(histograms or {}):
+        lines.extend(render_histogram(metric, (histograms or {})[metric]))
     return "\n".join(lines) + "\n"
 
 
+# Quote-aware label block: a `}` or `#` inside a quoted label value
+# (escapes included) doesn't terminate it, so a value that happens to
+# contain ` # {` still parses as one label set.
+_LABELS_PAT = r"\{(?:[^\"{}]|\"(?:[^\"\\]|\\.)*\")*\}"
 _SAMPLE_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{.*\})?\s+(?P<value>\S+)\s*$"
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    rf"(?P<labels>{_LABELS_PAT})?"
+    r"\s+(?P<value>\S+)"
+    # Optional OpenMetrics exemplar suffix — ` # {trace_id="..."} 212.4`
+    # (see render_histogram) — anchored AFTER the sample value so it can
+    # only ever match a real exemplar, never label-value content.
+    rf"(?:\s+#\s+{_LABELS_PAT}\s+\S+(?:\s+\S+)?)?"
+    r"\s*$"
 )
 _TYPE_RE = re.compile(r"^#\s*TYPE\s+(?P<name>\S+)\s+(?P<type>\S+)\s*$")
 
@@ -157,6 +199,10 @@ def federate_prometheus(sources: dict[str, str]) -> str:
                 if m:
                     types.setdefault(m.group("name"), m.group("type"))
                 continue
+            # Exemplar suffixes (`... # {trace_id="..."} 1.2`) are valid
+            # OpenMetrics but not part of the sample proper — _SAMPLE_RE
+            # accepts-and-ignores them so histogram buckets federate
+            # (without the exemplar) instead of being dropped.
             m = _SAMPLE_RE.match(line)
             if m is None:
                 continue
@@ -260,6 +306,7 @@ __all__ = [
     "PrometheusEndpoint",
     "federate_prometheus",
     "prometheus_name",
+    "render_histogram",
     "render_prometheus",
     "write_textfile",
 ]

@@ -61,6 +61,7 @@ class MetricsRegistry:
         self._history: deque[tuple[int | None, dict[str, float]]] = deque(
             maxlen=history_len
         )
+        self._histograms: dict[str, Any] = {}
         self._error_streak = 0
         self._total_errors = 0
 
@@ -82,6 +83,31 @@ class MetricsRegistry:
         """Monotonic event counter (rollbacks, faults, tracker errors...)."""
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + by
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        *,
+        buckets: tuple[float, ...],
+        trace_id: str | None = None,
+        unix_time: float | None = None,
+    ) -> None:
+        """Record one sample into a named fixed-bucket histogram.
+
+        First call per name creates the histogram with ``buckets``
+        (subsequent calls reuse it; changing the bucket layout of a live
+        metric mid-run is not a thing Prometheus can represent anyway).
+        ``trace_id`` attaches an exemplar to the bucket the sample lands
+        in — the dashboard→trace link (docs/observability.md).
+        """
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                from .stats import Histogram
+
+                hist = self._histograms[name] = Histogram(buckets)
+        hist.observe(value, trace_id=trace_id, unix_time=unix_time)
 
     # ---------------------------------------------------------------- flush
 
@@ -153,6 +179,12 @@ class MetricsRegistry:
     def counters(self) -> dict[str, float]:
         with self._lock:
             return dict(self._counters)
+
+    def histograms(self) -> dict[str, Any]:
+        """Live :class:`~.stats.Histogram` objects by metric name (the
+        objects are thread-safe; renderers snapshot them)."""
+        with self._lock:
+            return dict(self._histograms)
 
     def history(self) -> list[tuple[int | None, dict[str, float]]]:
         with self._lock:

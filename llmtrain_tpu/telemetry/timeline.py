@@ -264,15 +264,24 @@ class EventTimeline:
         self._append(event)
 
     def instant(
-        self, name: str, *, cat: str = "event", step: int | None = None, **args: Any
+        self,
+        name: str,
+        *,
+        cat: str = "event",
+        step: int | None = None,
+        t: float | None = None,
+        **args: Any,
     ) -> None:
+        """Point event; ``t`` (a perf_counter stamp the caller already
+        took) backdates it — the trace flush path records marks at their
+        TRUE time, not the flush time."""
         if not self._enabled:
             return
         event: dict[str, Any] = {
             "name": name,
             "cat": cat,
             "ph": "i",
-            "ts_us": self._now_us(),
+            "ts_us": self._now_us() if t is None else int((t - self._t0) * 1e6),
             "dur_us": 0,
             "thread": threading.current_thread().name,
         }

@@ -509,6 +509,31 @@ class TestSchedulerOverloadIntegration:
         _drain(sched)
         assert a.finish_reason == "length" and b.finish_reason == "length"
 
+    def test_tracer_failure_is_best_effort_not_a_hang(self):
+        # _finish_trace runs on the completion path BEFORE req.done.set();
+        # a tracer/timeline failure (full disk, broken adapter) must be
+        # swallowed, never leaving the waiter hanging or killing the loop.
+        from llmtrain_tpu.telemetry.tracing import TailSampler, Tracer
+
+        class BoomTimeline(FakeTimeline):
+            def record(self, name: str, **kw) -> None:
+                if kw.get("cat") == "trace":  # the tracer's flush records
+                    raise OSError("disk full")
+
+            def flush(self) -> None:
+                raise OSError("disk full")
+
+        tl = BoomTimeline()
+        sched = ContinuousBatchingScheduler(
+            FakeEngine(),
+            timeline=tl,
+            tracer=Tracer(tl, sampler=TailSampler(warmup=16)),
+        )
+        r = sched.submit(_req(prompt=5, max_new=3))
+        _drain(sched)
+        assert r.done.is_set()
+        assert r.finish_reason == "length" and len(r.tokens) == 3
+
     def test_submit_rejects_unmeetable_deadline(self):
         ov = OverloadController(queue_cap=64, prior_wait_ms=1000.0)
         sched = ContinuousBatchingScheduler(FakeEngine(), overload=ov)

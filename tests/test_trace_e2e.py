@@ -1,0 +1,293 @@
+"""End-to-end distributed tracing drill (``make verify-trace``).
+
+A real 2-replica HTTP fleet — each replica a continuous-batching
+scheduler behind ``make_server``, writing its own file-backed timeline —
+fronted by a :class:`ReplicaRouter` with a third, dead backend so one
+request provably fails over. Loadgen drives the router; afterwards the
+merged trace must reconstruct the full CROSS-PROCESS span tree (router
+root → pre-allocated ``router/http_dispatch`` hop → replica
+``serve/request`` parented via the propagated ``traceparent`` header →
+prefill/decode children), the critical path must tile the root interval
+exactly, and the replicas' ``/metrics`` TTFT histogram must carry
+exemplar trace ids.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+from flax.linen import meta as nn_meta
+
+from llmtrain_tpu.serving import (
+    ContinuousBatchingScheduler,
+    PagedDecodeEngine,
+    ServerState,
+    build_requests,
+    make_server,
+    run_loadgen,
+)
+from llmtrain_tpu.serving.router import HTTPReplica, ReplicaRouter
+from llmtrain_tpu.telemetry.timeline import EventTimeline
+from llmtrain_tpu.telemetry.tracing import TailSampler, Tracer
+
+pytestmark = pytest.mark.slow
+
+
+def _tiny_model():
+    from llmtrain_tpu.models.gpt import GPT
+
+    model = GPT(
+        vocab_size=64,
+        block_size=64,
+        d_model=32,
+        n_layers=1,
+        n_heads=2,
+        d_ff=64,
+        dropout=0.0,
+        tie_embeddings=True,
+    )
+    params = nn_meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+            "params"
+        ]
+    )
+    return model, params
+
+
+def _keep_all() -> TailSampler:
+    # Deterministic drill: warmup larger than the request count keeps
+    # every trace, so assertions don't depend on the latency reservoir.
+    return TailSampler(warmup=10_000)
+
+
+class _Replica:
+    """One serving process: scheduler + HTTP server + its own timeline."""
+
+    def __init__(self, model, params, trace_dir, name):
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+        self.timeline = EventTimeline(trace_dir / name / "timeline.jsonl")
+        engine = PagedDecodeEngine(
+            model,
+            params,
+            block_tokens=4,
+            max_batch_slots=4,
+            prompt_buckets=[8, 16],
+            batch_buckets=[2, 4],
+        )
+        self.registry = MetricsRegistry(None)
+        self.scheduler = ContinuousBatchingScheduler(
+            engine,
+            registry=self.registry,
+            timeline=self.timeline,
+            tracer=Tracer(self.timeline, sampler=_keep_all()),
+        ).start()
+        state = ServerState(
+            model=model,
+            params=params,
+            tokenizer=None,
+            step=1,
+            checkpoint="mem://tiny",
+            max_new_tokens_cap=16,
+            default_max_new_tokens=4,
+            scheduler=self.scheduler,
+            registry=self.registry,
+        )
+        self.httpd = make_server(state, "127.0.0.1", 0)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.scheduler.close()
+
+
+def _dead_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestDistributedTraceE2E:
+    def test_fleet_trace_reconstructs_cross_process_tree(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import (
+            collect_traces,
+            critical_path,
+            discover_sources,
+            format_tree,
+            merge_perfetto,
+        )
+
+        model, params = _tiny_model()
+        replicas = [
+            _Replica(model, params, tmp_path, f"replica{i}") for i in range(2)
+        ]
+        router_tl = EventTimeline(tmp_path / "router" / "timeline.jsonl")
+        # Backend 0 is DEAD (nothing listens): the first request placed on
+        # it fails over, forcing that trace's fleet-wide keep.
+        backends = [
+            HTTPReplica(f"http://127.0.0.1:{_dead_port()}", "dead",
+                        timeout_sec=30.0, probe_timeout_sec=1.0),
+            HTTPReplica(replicas[0].url, "replica0", timeout_sec=120.0),
+            HTTPReplica(replicas[1].url, "replica1", timeout_sec=120.0),
+        ]
+        router = ReplicaRouter(
+            backends,
+            fail_threshold=1,
+            revive_sec=600.0,
+            block_tokens=4,
+            timeline=router_tl,
+            tracer=Tracer(router_tl, sampler=_keep_all()),
+        )
+        try:
+            reqs = build_requests(
+                num_requests=8,
+                seed=3,
+                vocab_size=64,
+                prompt_tokens_min=4,
+                prompt_tokens_max=8,
+                max_new_tokens=4,
+            )
+            block = run_loadgen(
+                router, reqs, rate_rps=30.0, seed=5, timeout_sec=300.0
+            )
+            assert block["requests"]["failed"] == 0
+            assert block["requests"]["completed"] == len(reqs)
+            assert router.stats()["router"]["failovers"] >= 1
+            assert router.stats()["router"]["tracing"]["finished"] == len(
+                reqs
+            )
+            # Exemplars on the live replicas' /metrics scrape.
+            exemplar_seen = False
+            for rep in replicas:
+                with urllib.request.urlopen(
+                    rep.url + "/metrics", timeout=30
+                ) as resp:
+                    text = resp.read().decode()
+                if "llmtrain_serve_ttft_ms_bucket" in text:
+                    exemplar_seen = exemplar_seen or '# {trace_id="' in text
+            assert exemplar_seen
+        finally:
+            router.close()
+            for rep in replicas:
+                rep.close()
+
+        sources = discover_sources([tmp_path])
+        assert len(sources) == 3
+        traces = collect_traces(sources)
+        assert len(traces) == len(reqs)
+
+        failovers = 0
+        for trace in traces.values():
+            root = trace.root
+            assert root is not None and root.name == "router/request"
+            assert "router/timeline" in root.source
+            # Hop spans under the root, replica tree under the hop —
+            # linked purely by the traceparent the router sent.
+            hops = [
+                s
+                for s in trace.children(root.span_id)
+                if s.name == "router/http_dispatch"
+            ]
+            assert hops, format_tree(trace)
+            served = [
+                c
+                for h in hops
+                for c in trace.children(h.span_id)
+                if c.name == "serve/request"
+            ]
+            assert len(served) == 1, format_tree(trace)
+            replica_root = served[0]
+            assert "replica" in replica_root.source
+            child_names = {
+                c.name for c in trace.children(replica_root.span_id)
+            }
+            assert "serve/prefill" in child_names
+            assert "serve/decode_phase" in child_names
+            # Critical path tiles the root interval exactly.
+            cp = critical_path(trace)
+            assert sum(cp["breakdown"].values()) == pytest.approx(
+                cp["total_ms"], abs=0.05
+            )
+            if any(s.args.get("error") for s in hops):
+                failovers += 1
+                assert any(
+                    s.name == "router/failover" for s in trace.spans
+                ), format_tree(trace)
+        assert failovers >= 1
+
+        # The merged Perfetto file: one track group per process, flow
+        # arrows for every cross-process hop link.
+        out = tmp_path / "merged_trace.json"
+        merge_perfetto(sources, out, traces=traces)
+        doc = json.loads(out.read_text())
+        names = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert len(names) == 3
+        flows = [
+            e for e in doc["traceEvents"] if e["name"] == "trace_link"
+        ]
+        assert len(flows) >= 2 * len(reqs)  # one s/f pair per hop link
+
+    def test_force_header_keeps_a_fast_trace(self, tmp_path):
+        """``X-Trace: force`` on the ingress keeps the trace even when the
+        sampler would drop everything."""
+        model, params = _tiny_model()
+        rep = _Replica(model, params, tmp_path, "replica0")
+        # Replace the keep-all drill sampler with a drop-everything one.
+        rep.scheduler.tracer = Tracer(
+            rep.timeline,
+            sampler=TailSampler(slow_frac=0.01, reservoir=16, warmup=0),
+        )
+        for _ in range(20):  # saturate the reservoir with slow latencies
+            rep.scheduler.tracer.sampler.decide(60_000.0)
+        try:
+            body = json.dumps(
+                {"prompt_ids": [1, 2, 3], "max_new_tokens": 2,
+                 "temperature": 0.0}
+            ).encode()
+            plain = urllib.request.Request(
+                rep.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(plain, timeout=120) as resp:
+                assert resp.status == 200
+            forced = urllib.request.Request(
+                rep.url + "/v1/generate", data=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Trace": "force",
+                },
+            )
+            with urllib.request.urlopen(forced, timeout=120) as resp:
+                out = json.loads(resp.read())
+            assert resp.status == 200
+            forced_trace_id = out["trace_id"]
+        finally:
+            rep.close()
+
+        from llmtrain_tpu.telemetry.trace_collect import (
+            collect_traces,
+            discover_sources,
+        )
+
+        traces = collect_traces(discover_sources([tmp_path]))
+        assert list(traces) == [forced_trace_id]
+        root = traces[forced_trace_id].root
+        assert root is not None and root.args.get("sampled") == "forced"

@@ -1,0 +1,725 @@
+"""Distributed request tracing (telemetry/tracing.py, trace_collect.py,
+stats.py) plus the EventTimeline concurrency contract.
+
+Tier-1 keeps to pure units: traceparent round-trips, tail-sampling
+decisions, tracer flush/idempotency, the shared nearest-rank percentile
+helper, exemplar-carrying histograms and their Prometheus rendering, the
+cross-process trace collector over synthetic JSONL, and timeline
+thread-safety. The 2-replica fleet drill with a forced failover lives in
+``tests/test_trace_e2e.py`` under ``@pytest.mark.slow``
+(``make verify-trace``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from llmtrain_tpu.telemetry.stats import (
+    Histogram,
+    percentile,
+    percentiles,
+)
+from llmtrain_tpu.telemetry.timeline import EventTimeline
+from llmtrain_tpu.telemetry.tracing import (
+    TailSampler,
+    TraceContext,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
+
+# ---------------------------------------------------------------------------
+# shared percentile helper (the ONE implementation every caller uses)
+# ---------------------------------------------------------------------------
+
+
+class TestSharedPercentiles:
+    def test_nearest_rank_known_values(self):
+        xs = sorted(float(v) for v in range(1, 101))  # 1..100
+        assert percentile(xs, 0.50) == 50.0
+        assert percentile(xs, 0.95) == 95.0
+        assert percentile(xs, 0.99) == 99.0
+        assert percentile(xs, 1.0) == 100.0
+
+    def test_small_samples_clamp_to_extremes(self):
+        assert percentile([7.0], 0.5) == 7.0
+        assert percentile([7.0], 0.99) == 7.0
+        assert percentile([1.0, 2.0], 0.01) == 1.0
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            percentile([], 0.5)
+
+    def test_percentiles_dict_shape(self):
+        out = percentiles([1.0, 2.0, 3.0, 10.0])
+        assert out == {
+            "p50": 2.0,
+            "p95": 10.0,
+            "p99": 10.0,
+            "mean": 4.0,
+            "max": 10.0,
+        }
+        assert percentiles([]) == {}
+
+    def test_loadgen_wrapper_keeps_explicit_none_contract(self):
+        # lifecycle/controller.py indexes ["p50"] on possibly-empty
+        # samples — the serving wrapper must keep the keys-with-None
+        # shape rather than the {} the shared helper returns.
+        from llmtrain_tpu.serving.loadgen import percentiles as lg_pct
+
+        empty = lg_pct([])
+        assert empty["p50"] is None and empty["p99"] is None
+        assert lg_pct([1.0, 2.0, 3.0, 10.0])["p50"] == 2.0
+
+
+class TestHistogram:
+    def test_cumulative_buckets_and_inf_row(self):
+        h = Histogram((10.0, 100.0))
+        for v in (5.0, 50.0, 500.0):
+            h.observe(v)
+        rows, total, count = h.snapshot()
+        assert [(le, cum) for le, cum, _ in rows] == [
+            (10.0, 1),
+            (100.0, 2),
+            (math.inf, 3),
+        ]
+        assert total == 555.0 and count == 3
+
+    def test_exemplar_lands_in_its_bucket(self):
+        h = Histogram((10.0, 100.0))
+        h.observe(50.0, trace_id="aa" * 16, unix_time=123.0)
+        rows, _, _ = h.snapshot()
+        by_le = {le: ex for le, _, ex in rows}
+        assert by_le[10.0] is None
+        assert by_le[100.0] is not None
+        assert by_le[100.0].trace_id == "aa" * 16
+        assert by_le[100.0].value == 50.0
+
+
+# ---------------------------------------------------------------------------
+# trace context / traceparent header
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_header_round_trip(self):
+        ctx = TraceContext.root()
+        parsed = TraceContext.from_traceparent(ctx.to_traceparent())
+        assert parsed is not None
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+        assert parsed.forced is False
+
+    def test_forced_flag_survives_the_wire(self):
+        ctx = TraceContext.root(forced=True)
+        parsed = TraceContext.from_traceparent(ctx.to_traceparent())
+        assert parsed is not None and parsed.forced is True
+
+    @pytest.mark.parametrize(
+        "header",
+        [
+            "",
+            "garbage",
+            "00-zz-bb-01",
+            "01-" + "a" * 32 + "-" + "b" * 16,  # missing flags
+            "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
+            "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        ],
+    )
+    def test_malformed_headers_parse_to_none(self, header):
+        assert TraceContext.from_traceparent(header) is None
+
+    def test_child_links_to_parent(self):
+        root = TraceContext.root()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.span_id != root.span_id
+
+    def test_ids_are_well_formed(self):
+        assert len(new_trace_id()) == 32
+        assert len(new_span_id()) == 16
+        int(new_trace_id(), 16)  # hex
+
+
+# ---------------------------------------------------------------------------
+# tail sampling
+# ---------------------------------------------------------------------------
+
+
+class TestTailSampler:
+    def test_keep_reason_priority(self):
+        s = TailSampler(warmup=0)
+        assert (
+            s.decide(1.0, errored=True, failover=True, forced=True)
+            == "forced"
+        )
+        assert s.decide(1.0, errored=True, failover=True) == "error"
+        assert s.decide(1.0, failover=True) == "failover"
+
+    def test_warmup_keeps_the_first_traces(self):
+        s = TailSampler(warmup=3)
+        assert [s.decide(1.0) for _ in range(3)] == ["warmup"] * 3
+
+    def test_fast_requests_drop_and_slow_keep(self):
+        s = TailSampler(slow_frac=0.05, reservoir=64, warmup=0)
+        for _ in range(64):
+            s.decide(100.0)
+        assert s.decide(1.0) is None
+        assert s.decide(500.0) == "slow"
+
+    def test_slow_frac_validated(self):
+        with pytest.raises(ValueError):
+            TailSampler(slow_frac=0.0)
+
+
+# ---------------------------------------------------------------------------
+# tracer: buffer -> sample -> flush
+# ---------------------------------------------------------------------------
+
+
+def _trace_events(tl: EventTimeline) -> list[dict]:
+    return [e for e in tl.events() if e.get("cat") == "trace"]
+
+
+class TestTracer:
+    def test_kept_trace_flushes_the_whole_tree(self, tmp_path):
+        tl = EventTimeline(tmp_path / "timeline.jsonl")
+        tracer = Tracer(tl, sampler=TailSampler(warmup=16))
+        t0 = time.perf_counter()
+        tr = tracer.start(root_name="serve/request")
+        tr.add_span("serve/prefill", t0=t0 + 0.001, t1=t0 + 0.002, step=3)
+        tr.add_event("serve/prefix_cache", t=t0 + 0.0015, hit=True)
+        reason = tracer.finish(
+            tr, t0=t0, t1=t0 + 0.01, request_id="r1", finish_reason="eos"
+        )
+        assert reason == "warmup"
+
+        evs = _trace_events(tl)
+        assert [e["name"] for e in evs] == [
+            "serve/request",
+            "serve/prefill",
+            "serve/prefix_cache",
+        ]
+        root, child, mark = evs
+        assert root["args"]["trace_id"] == tr.trace_id
+        assert root["args"]["span_id"] == tr.root_span_id
+        assert root["args"]["sampled"] == "warmup"
+        assert root["args"]["request_id"] == "r1"
+        assert child["args"]["parent_span_id"] == tr.root_span_id
+        # A buffered `step` arg rides the record() keyword, landing as the
+        # event's own step field like every other timeline span.
+        assert child["step"] == 3
+        assert mark["args"]["hit"] is True and mark["dur_us"] == 0
+        # Flushed to JSONL too (the collector reads the file).
+        lines = (tmp_path / "timeline.jsonl").read_text().splitlines()
+        assert sum(1 for ln in lines if '"cat": "trace"' in ln) == 3
+
+    def test_dropped_trace_writes_nothing(self):
+        tl = EventTimeline(None)
+        sampler = TailSampler(slow_frac=0.05, reservoir=64, warmup=0)
+        for _ in range(64):
+            sampler.decide(100.0)
+        tracer = Tracer(tl, sampler=sampler)
+        tr = tracer.start()
+        t0 = time.perf_counter()
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.0001) is None
+        assert _trace_events(tl) == []
+        assert tracer.stats() == {"finished": 1, "kept": {}}
+
+    def test_finish_is_first_caller_wins(self):
+        tl = EventTimeline(None)
+        tracer = Tracer(tl)
+        tr = tracer.start()
+        t0 = time.perf_counter()
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.001) == "warmup"
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.001) is None
+        assert len(_trace_events(tl)) == 1
+        assert tracer.stats()["finished"] == 1
+
+    def test_error_note_keeps_the_trace(self):
+        tl = EventTimeline(None)
+        sampler = TailSampler(slow_frac=0.05, reservoir=64, warmup=0)
+        for _ in range(64):
+            sampler.decide(100.0)
+        tracer = Tracer(tl, sampler=sampler)
+        tr = tracer.start()
+        tr.note(error="boom")
+        t0 = time.perf_counter()
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.0001) == "error"
+        root = _trace_events(tl)[0]
+        assert root["args"]["error"] == "boom"
+
+    def test_span_cap_drops_detail_not_the_trace(self):
+        tl = EventTimeline(None)
+        tracer = Tracer(tl, max_spans=4)
+        tr = tracer.start(forced=True)
+        t0 = time.perf_counter()
+        for i in range(10):
+            tr.add_span(f"s{i}", t0=t0, t1=t0 + 0.001)
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.01) == "forced"
+        evs = _trace_events(tl)
+        assert len(evs) == 5  # root + max_spans
+        assert evs[0]["args"]["dropped_spans"] == 6
+
+    def test_finish_tolerates_flushless_duck_typed_timeline(self):
+        # Scheduler/router auto-create a Tracer for ANY timeline-shaped
+        # object (tests pass fakes with only instant/record/span) — a
+        # kept trace must degrade to record() calls, not crash on the
+        # missing flush().
+        class RecordOnly:
+            def __init__(self):
+                self.records = []
+
+            def record(self, name, **kw):
+                self.records.append(name)
+
+            def instant(self, name, **kw):
+                pass
+
+            def span(self, name, **kw):
+                from contextlib import nullcontext
+
+                return nullcontext()
+
+        tl = RecordOnly()
+        tracer = Tracer(tl)
+        tr = tracer.start(forced=True)
+        t0 = time.perf_counter()
+        tr.add_span("serve/prefill", t0=t0, t1=t0 + 0.001)
+        assert tracer.finish(tr, t0=t0, t1=t0 + 0.01) == "forced"
+        assert tl.records == ["serve/request", "serve/prefill"]
+
+    def test_remote_parent_becomes_parent_span_id(self):
+        tl = EventTimeline(None)
+        tracer = Tracer(tl)
+        parent = TraceContext.root()
+        tr = tracer.start(parent=parent, root_name="serve/request")
+        assert tr.trace_id == parent.trace_id
+        t0 = time.perf_counter()
+        tracer.finish(tr, t0=t0, t1=t0 + 0.001)
+        root = _trace_events(tl)[0]
+        assert root["args"]["parent_span_id"] == parent.span_id
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering: exemplars out, federation strips them
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusExemplars:
+    def _registry(self):
+        from llmtrain_tpu.telemetry.registry import MetricsRegistry
+
+        reg = MetricsRegistry(None)
+        reg.observe(
+            "serve/ttft_ms",
+            42.0,
+            buckets=(10.0, 100.0),
+            trace_id="ab" * 16,
+        )
+        return reg
+
+    def test_histogram_renders_with_exemplar_suffix(self):
+        from llmtrain_tpu.telemetry.prometheus import render_prometheus
+
+        reg = self._registry()
+        text = render_prometheus(
+            reg.latest(), reg.counters(), histograms=reg.histograms()
+        )
+        assert "# TYPE llmtrain_serve_ttft_ms histogram" in text
+        assert (
+            'llmtrain_serve_ttft_ms_bucket{le="100.0"} 1 '
+            '# {trace_id="' + "ab" * 16 + '"} 42.0'
+        ) in text
+        assert 'le="+Inf"' in text
+        assert "llmtrain_serve_ttft_ms_count 1" in text
+
+    def test_federation_strips_exemplars(self):
+        from llmtrain_tpu.telemetry.prometheus import (
+            federate_prometheus,
+            render_prometheus,
+        )
+
+        reg = self._registry()
+        text = render_prometheus(
+            reg.latest(), reg.counters(), histograms=reg.histograms()
+        )
+        fed = federate_prometheus({"replica0": text})
+        assert "# {" not in fed
+        # Bucket survives (tenant label injected) rather than being
+        # dropped as unparseable because of the exemplar suffix.
+        assert (
+            'llmtrain_serve_ttft_ms_bucket{tenant="replica0",le="100.0"} 1'
+            in fed
+        )
+
+    def test_exemplar_lookalike_inside_label_value_parses_whole(self):
+        # The exemplar suffix is only recognized AFTER the sample value;
+        # a label value that happens to contain ` # {` must not be
+        # truncated mid-sample.
+        from llmtrain_tpu.telemetry.prometheus import federate_prometheus
+
+        text = (
+            "# TYPE g gauge\n"
+            'g{path="a # {weird} b"} 3\n'
+            'g{q="esc\\" # {x"} 5\n'
+        )
+        fed = federate_prometheus({"t0": text})
+        assert 'g{tenant="t0",path="a # {weird} b"} 3' in fed
+        assert 'g{tenant="t0",q="esc\\" # {x"} 5' in fed
+
+
+# ---------------------------------------------------------------------------
+# EventTimeline under contention (satellite: concurrency contract)
+# ---------------------------------------------------------------------------
+
+
+class TestTimelineConcurrency:
+    def test_producer_threads_against_flush_lose_nothing(self, tmp_path):
+        # Bounded well under max_events: overflow is a separate contract
+        # (oldest dropped + counted); here we pin exactly-once flushing.
+        tl = EventTimeline(tmp_path / "timeline.jsonl")
+        per_thread = 2000
+
+        def produce(tag: str):
+            for i in range(per_thread):
+                t0 = time.perf_counter()
+                tl.record(f"{tag}/span", t0=t0, t1=t0, seq=i)
+                tl.instant(f"{tag}/mark", seq=i)
+
+        threads = [
+            threading.Thread(target=produce, args=(f"w{k}",)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for _ in range(20):  # flush racing the producers
+            tl.flush()
+        for t in threads:
+            t.join()
+        tl.flush()
+        mem = [e for e in tl.events() if "seq" in (e.get("args") or {})]
+        lines = [
+            json.loads(ln)
+            for ln in (tmp_path / "timeline.jsonl").read_text().splitlines()
+        ]
+        disk = [e for e in lines if "seq" in (e.get("args") or {})]
+        # Exactly-once persistence: no event duplicated or lost.
+        assert len(disk) == len(mem) > 0
+        # Per-producer sequence order survives interleaving.
+        for k in range(4):
+            seqs = [
+                e["args"]["seq"]
+                for e in disk
+                if e["name"] == f"w{k}/span"
+            ]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_concurrent_spans_keep_thread_attribution(self):
+        tl = EventTimeline(None)
+        barrier = threading.Barrier(3)
+
+        def worker():
+            barrier.wait()
+            with tl.span("work", cat="serve"):
+                time.sleep(0.001)
+
+        threads = [
+            threading.Thread(target=worker, name=f"producer-{i}")
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        names = {
+            e["thread"] for e in tl.events() if e.get("name") == "work"
+        }
+        assert names == {f"producer-{i}" for i in range(3)}
+
+    def test_tag_rollback_after_flush_tags_memory_not_disk(self, tmp_path):
+        tl = EventTimeline(tmp_path / "timeline.jsonl")
+        t0 = time.perf_counter()
+        tl.record("train/step", t0=t0, t1=t0, step=5)
+        tl.flush()
+        tl.tag_rollback(5, 5)
+        # Already-flushed JSONL lines keep their shape (the paired
+        # rollback instant gives post-processing the window)...
+        line = json.loads(
+            (tmp_path / "timeline.jsonl").read_text().splitlines()[-1]
+        )
+        assert "rolled_back" not in line
+        # ...while the retained in-memory event carries the tag for
+        # span_totals/report consumers.
+        ev = [e for e in tl.events() if e.get("step") == 5][0]
+        assert ev["rolled_back"] is True
+        # A re-flush must not duplicate the line.
+        tl.flush()
+        stepped = [
+            ln
+            for ln in (tmp_path / "timeline.jsonl").read_text().splitlines()
+            if '"step": 5' in ln
+        ]
+        assert len(stepped) == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-process collector (trace_collect.py) over synthetic fleet JSONL
+# ---------------------------------------------------------------------------
+
+_T = "ab" * 16  # trace id
+_R, _H, _S, _P, _D = ("1" * 16, "2" * 16, "3" * 16, "4" * 16, "5" * 16)
+
+
+def _write_fleet(tmp_path):
+    """Two-process fleet: router roots the trace, a replica continues it
+    via the traceparent hop span id. Same wall-clock anchor, 100ms request."""
+
+    def _ev(name, ts_us, dur_us, **args):
+        return {
+            "name": name,
+            "cat": "trace",
+            "ph": "X",
+            "ts_us": ts_us,
+            "dur_us": dur_us,
+            "thread": "MainThread",
+            "args": args,
+        }
+
+    router_dir = tmp_path / "router" / "telemetry"
+    replica_dir = tmp_path / "replica0" / "telemetry"
+    router_dir.mkdir(parents=True)
+    replica_dir.mkdir(parents=True)
+    seg = {
+        "name": "segment_start",
+        "ph": "seg",
+        "segment_id": 0,
+        "start_unix_time": 1000.0,
+    }
+    router = [
+        seg,
+        _ev(
+            "router/request", 0, 100_000,
+            trace_id=_T, span_id=_R, sampled="slow", request_id="proc/1",
+        ),
+        _ev(
+            "router/http_dispatch", 10_000, 80_000,
+            trace_id=_T, span_id=_H, parent_span_id=_R, replica="replica0",
+        ),
+    ]
+    replica = [
+        seg,
+        _ev(
+            "serve/request", 15_000, 70_000,
+            trace_id=_T, span_id=_S, parent_span_id=_H, sampled="forced",
+        ),
+        _ev("serve/prefill", 20_000, 30_000,
+            trace_id=_T, span_id=_P, parent_span_id=_S),
+        _ev("serve/decode_phase", 50_000, 30_000,
+            trace_id=_T, span_id=_D, parent_span_id=_S),
+        "this line is mid-write garbage {",
+    ]
+    for path, evs in (
+        (router_dir / "timeline.jsonl", router),
+        (replica_dir / "timeline.jsonl", replica),
+    ):
+        path.write_text(
+            "\n".join(
+                e if isinstance(e, str) else json.dumps(e) for e in evs
+            )
+            + "\n"
+        )
+    return tmp_path
+
+
+class TestTraceCollect:
+    def _load(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import (
+            collect_traces,
+            discover_sources,
+        )
+
+        sources = discover_sources([_write_fleet(tmp_path)])
+        return sources, collect_traces(sources)
+
+    def test_discovery_and_assembly(self, tmp_path):
+        sources, traces = self._load(tmp_path)
+        assert sorted(s.label for s in sources) == [
+            "replica0/timeline",
+            "router/timeline",
+        ]
+        assert list(traces) == [_T]
+        tr = traces[_T]
+        assert len(tr.spans) == 5
+        assert sorted(tr.sources) == ["replica0/timeline", "router/timeline"]
+
+    def test_cross_process_parentage(self, tmp_path):
+        _, traces = self._load(tmp_path)
+        tr = traces[_T]
+        root = tr.root
+        assert root is not None and root.name == "router/request"
+        assert [c.name for c in tr.children(root.span_id)] == [
+            "router/http_dispatch"
+        ]
+        # The replica's root hangs off the PRE-ALLOCATED hop span id the
+        # router sent in the traceparent header.
+        assert [c.name for c in tr.children(_H)] == ["serve/request"]
+        assert [c.name for c in tr.children(_S)] == [
+            "serve/prefill",
+            "serve/decode_phase",
+        ]
+
+    def test_critical_path_sums_to_end_to_end(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import critical_path
+
+        _, traces = self._load(tmp_path)
+        cp = critical_path(traces[_T])
+        assert cp["total_ms"] == 100.0
+        assert cp["root"] == "router/request"
+        assert sum(cp["breakdown"].values()) == pytest.approx(100.0)
+        # Leaf spans own their full windows; ancestors keep only gaps.
+        assert cp["breakdown"]["serve/prefill"] == 30.0
+        assert cp["breakdown"]["serve/decode_phase"] == 30.0
+        assert cp["breakdown"]["router/request"] == 20.0
+
+    def test_format_tree_shows_offsets_and_processes(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import format_tree
+
+        _, traces = self._load(tmp_path)
+        lines = format_tree(traces[_T])
+        assert lines[0].startswith(f"trace {_T}")
+        assert "2 processes" in lines[0]
+        assert any(
+            "serve/prefill" in ln and "(replica0/timeline)" in ln
+            for ln in lines
+        )
+        assert any("[slow]" in ln for ln in lines)
+
+    def test_summarize_per_span_kind(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import summarize
+
+        _, traces = self._load(tmp_path)
+        out = summarize(traces)
+        assert out["traces"] == 1
+        assert out["end_to_end_ms"]["p50"] == 100.0
+        assert out["spans"]["serve/prefill"]["count"] == 1
+        assert out["spans"]["serve/prefill"]["p99"] == 30.0
+
+    def test_merge_draws_cross_process_flow_arrows(self, tmp_path):
+        from llmtrain_tpu.telemetry.trace_collect import merge_perfetto
+
+        sources, traces = self._load(tmp_path)
+        out = tmp_path / "merged_trace.json"
+        merge_perfetto(sources, out, traces=traces)
+        doc = json.loads(out.read_text())
+        evs = doc["traceEvents"]
+        proc_names = {
+            e["args"]["name"] for e in evs if e["name"] == "process_name"
+        }
+        assert proc_names == {"router/timeline", "replica0/timeline"}
+        flows = [e for e in evs if e["name"] == "trace_link"]
+        # Exactly one cross-source link (hop→replica-root): an s/f pair.
+        assert sorted(e["ph"] for e in flows) == ["f", "s"]
+        assert flows[0]["id"] == flows[1]["id"]
+        # In-process parent→child links (router→hop) draw NO arrow.
+        assert len(flows) == 2
+
+    def test_merge_rebases_headerless_sources_to_the_base(self, tmp_path):
+        """A timeline with no segment header carries relative stamps;
+        the merge must rebase it to the fleet base (and flag it) rather
+        than fling its events ~1.7e9 s before everything else."""
+        from llmtrain_tpu.telemetry.trace_collect import (
+            discover_sources,
+            merge_perfetto,
+        )
+
+        _write_fleet(tmp_path)
+        bare = tmp_path / "bare" / "telemetry"
+        bare.mkdir(parents=True)
+        (bare / "timeline.jsonl").write_text(
+            json.dumps(
+                {
+                    "name": "x",
+                    "ph": "X",
+                    "ts_us": 2000,
+                    "dur_us": 500,
+                    "cat": "serve",
+                }
+            )
+            + "\n"
+        )
+        sources = discover_sources([tmp_path])
+        out = tmp_path / "merged_trace.json"
+        merge_perfetto(sources, out)
+        doc = json.loads(out.read_text())
+        assert doc["otherData"]["unaligned"] == ["bare/timeline"]
+        assert all(
+            e["ts"] >= 0 for e in doc["traceEvents"] if e.get("ph") == "X"
+        )
+
+    def test_orphaned_subtree_surfaces_as_extra_root(self, tmp_path):
+        """When only the replica kept the trace (tail sampling disagreed),
+        its subtree must still show up instead of being dropped."""
+        from llmtrain_tpu.telemetry.trace_collect import (
+            collect_traces,
+            discover_sources,
+        )
+
+        _write_fleet(tmp_path)
+        (tmp_path / "router" / "telemetry" / "timeline.jsonl").unlink()
+        traces = collect_traces(discover_sources([tmp_path]))
+        tr = traces[_T]
+        assert [r.name for r in tr.roots] == ["serve/request"]
+        assert tr.duration_ms == pytest.approx(70.0)
+
+
+class TestTraceCLI:
+    def _ns(self, tmp_path, action, trace_id=None, **kw):
+        import argparse
+
+        return argparse.Namespace(
+            action=action,
+            trace_id=trace_id,
+            run_dirs=[str(tmp_path)],
+            k=kw.get("k", 10),
+            out=kw.get("out"),
+            json=kw.get("json", False),
+        )
+
+    def test_slowest_show_summary_merge(self, tmp_path, capsys):
+        from llmtrain_tpu.cli import _handle_trace
+
+        _write_fleet(tmp_path)
+        assert _handle_trace(self._ns(tmp_path, "slowest")) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows[0]["trace_id"] == _T
+        assert rows[0]["total_ms"] == 100.0
+        assert rows[0]["request_id"] == "proc/1"
+
+        # Unique-prefix match is enough for `show`.
+        assert _handle_trace(self._ns(tmp_path, "show", _T[:8])) == 0
+        out = capsys.readouterr().out
+        assert "router/request" in out and "serve/prefill" in out
+        assert '"breakdown"' in out  # critical-path block follows the tree
+
+        assert _handle_trace(self._ns(tmp_path, "summary")) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["traces"] == 1
+
+        assert _handle_trace(self._ns(tmp_path, "merge")) == 0
+        merged = json.loads(capsys.readouterr().out)
+        assert (tmp_path / "merged_trace.json").exists()
+        assert merged["traces"] == 1
+
+    def test_empty_dir_is_a_config_error(self, tmp_path, capsys):
+        from llmtrain_tpu.cli import EXIT_CONFIG_ERROR, _handle_trace
+
+        assert (
+            _handle_trace(self._ns(tmp_path, "slowest")) == EXIT_CONFIG_ERROR
+        )
+        capsys.readouterr()
